@@ -1,0 +1,11 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication engine.
+
+A ground-up rebuild of the Tendermint Core capability set (reference:
+yayajacky/tendermint, pure Go) designed TPU-first: the crypto data plane
+(batch Ed25519 verification, hashing) runs as JAX/XLA programs on device,
+sharded over a `jax.sharding.Mesh` for large validator sets, while the
+host runtime (consensus FSM, gossip, stores) is an asyncio actor system
+replacing the reference's goroutine architecture.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .tmhash import sum_sha256, sum_truncated, ADDRESS_SIZE
+from .keys import PrivKey, PubKey, gen_priv_key, priv_key_from_seed
+from .batch import BatchVerifier, CPUBatchVerifier, new_batch_verifier

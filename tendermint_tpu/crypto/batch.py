@@ -1,0 +1,105 @@
+"""Pluggable batch signature verification — the framework's north star.
+
+The reference has *no* BatchVerifier: every consensus/light-client/fast-sync
+signature is verified one at a time (reference: crypto/ed25519/ed25519.go:149-156
+and the call-site census in SURVEY §2.9).  Here every verification surface
+(VoteSet.add_vote, ValidatorSet.verify_commit*, fast sync, light client)
+funnels into this interface, and the default backend aggregates the whole
+batch into a single JAX/XLA device call.
+
+Backends:
+  * "cpu"  — sequential pure-Python ZIP-215 (reference semantics; baseline)
+  * "jax"  — vmapped TPU/XLA verifier (tendermint_tpu.ops.ed25519_jax)
+  * "auto" — jax if importable, else cpu
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+from . import ed25519 as _ed
+
+
+def _pub_bytes(pub) -> bytes:
+    return pub.bytes_() if hasattr(pub, "bytes_") else bytes(pub)
+
+
+@runtime_checkable
+class BatchVerifier(Protocol):
+    def add(self, pub_key, msg: bytes, sig: bytes) -> None: ...
+
+    def count(self) -> int: ...
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        """Returns (all_valid, per-item validity).  Resets the batch."""
+        ...
+
+
+class _BaseBatch:
+    def __init__(self) -> None:
+        self._pubs: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def add(self, pub_key, msg: bytes, sig: bytes) -> None:
+        self._pubs.append(_pub_bytes(pub_key))
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(sig))
+
+    def count(self) -> int:
+        return len(self._pubs)
+
+    def _take(self):
+        batch = (self._pubs, self._msgs, self._sigs)
+        self._pubs, self._msgs, self._sigs = [], [], []
+        return batch
+
+
+class CPUBatchVerifier(_BaseBatch):
+    """Sequential ZIP-215 loop — bit-exact reference semantics."""
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        pubs, msgs, sigs = self._take()
+        oks = _ed.verify_batch_reference(pubs, msgs, sigs)
+        return all(oks) if oks else False, oks
+
+
+class JAXBatchVerifier(_BaseBatch):
+    """One XLA device program verifies the entire batch (vmapped, bucketed)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        from tendermint_tpu.ops import ed25519_jax  # lazy: jax import
+
+        self._impl = ed25519_jax
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        pubs, msgs, sigs = self._take()
+        if not pubs:
+            return False, []
+        oks = self._impl.verify_batch(pubs, msgs, sigs)
+        return bool(all(oks)), [bool(v) for v in oks]
+
+
+_DEFAULT_BACKEND = "auto"
+
+
+def set_default_backend(name: str) -> None:
+    global _DEFAULT_BACKEND
+    if name not in ("auto", "jax", "cpu"):
+        raise ValueError(f"unknown batch-verifier backend {name!r}")
+    _DEFAULT_BACKEND = name
+
+
+def new_batch_verifier(backend: str | None = None) -> BatchVerifier:
+    backend = backend or _DEFAULT_BACKEND
+    if backend not in ("auto", "jax", "cpu"):
+        raise ValueError(f"unknown batch-verifier backend {backend!r}")
+    if backend == "cpu":
+        return CPUBatchVerifier()
+    if backend == "jax":
+        return JAXBatchVerifier()
+    try:
+        return JAXBatchVerifier()
+    except Exception:
+        return CPUBatchVerifier()

@@ -1,0 +1,124 @@
+"""RFC 6962 Merkle tree (SHA-256) — roots for txs, validator sets, commits,
+headers, evidence.
+
+Parity target: reference crypto/merkle/{tree.go:9-21,hash.go,proof.go} —
+leaf prefix 0x00, inner prefix 0x01, empty hash = SHA-256(""), split point =
+largest power of two strictly smaller than n.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+_LEAF_PREFIX = b"\x00"
+_INNER_PREFIX = b"\x01"
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def empty_hash() -> bytes:
+    return _sha256(b"")
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(_LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(_INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n."""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    """Merkle root of the list (bottom-up, iteration-friendly)."""
+    n = len(items)
+    if n == 0:
+        return empty_hash()
+    hashes = [leaf_hash(it) for it in items]
+    return _root_from_leaf_hashes(hashes)
+
+
+def _root_from_leaf_hashes(hashes: list[bytes]) -> bytes:
+    n = len(hashes)
+    if n == 1:
+        return hashes[0]
+    k = _split_point(n)
+    return inner_hash(_root_from_leaf_hashes(hashes[:k]), _root_from_leaf_hashes(hashes[k:]))
+
+
+@dataclass
+class Proof:
+    """Merkle inclusion proof (reference: crypto/merkle/proof.go, wire form
+    proto/tendermint/crypto/proof.proto Proof{total,index,leaf_hash,aunts})."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: list[bytes] = field(default_factory=list)
+
+    def compute_root(self) -> bytes:
+        return _root_from_proof(self.leaf_hash, self.index, self.total, self.aunts)
+
+    def verify(self, root: bytes, leaf: bytes) -> bool:
+        if self.total < 0 or self.index < 0 or self.index >= self.total:
+            return False
+        if leaf_hash(leaf) != self.leaf_hash:
+            return False
+        computed = self.compute_root()
+        return computed is not None and computed == root
+
+
+def _root_from_proof(lh: bytes, index: int, total: int, aunts: list[bytes]) -> bytes | None:
+    if total == 0 or index >= total:
+        return None
+    if total == 1:
+        return lh if not aunts else None
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _root_from_proof(lh, index, k, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _root_from_proof(lh, index - k, total - k, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+    """Root plus an inclusion proof per item."""
+    n = len(items)
+    if n == 0:
+        return empty_hash(), []
+    leaf_hashes = [leaf_hash(it) for it in items]
+    proofs = [Proof(total=n, index=i, leaf_hash=leaf_hashes[i]) for i in range(n)]
+
+    def build(lo: int, hi: int) -> bytes:
+        cnt = hi - lo
+        if cnt == 1:
+            return leaf_hashes[lo]
+        k = _split_point(cnt)
+        left = build(lo, lo + k)
+        right = build(lo + k, hi)
+        for i in range(lo, lo + k):
+            proofs[i].aunts.append(right)
+        for i in range(lo + k, hi):
+            proofs[i].aunts.append(left)
+        return inner_hash(left, right)
+
+    root = build(0, n)
+    # aunts are appended child-level first as the recursion unwinds, so each
+    # list is already ordered leaf→root, matching _root_from_proof consumption.
+    return root, proofs

@@ -1,0 +1,18 @@
+"""SHA-256 and the 20-byte truncated variant used for addresses.
+
+Parity target: reference crypto/tmhash/hash.go:27,37-40.
+"""
+
+import hashlib
+
+SIZE = 32
+TRUNCATED_SIZE = 20
+ADDRESS_SIZE = TRUNCATED_SIZE
+
+
+def sum_sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def sum_truncated(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]

@@ -1,0 +1,12 @@
+from .proto import (
+    ProtoWriter,
+    encode_uvarint,
+    decode_uvarint,
+    encode_delimited,
+    decode_delimited,
+    parse_message,
+    WT_VARINT,
+    WT_FIXED64,
+    WT_BYTES,
+    WT_FIXED32,
+)

@@ -1,0 +1,205 @@
+"""Minimal deterministic protobuf wire codec.
+
+Hand-rolled rather than generated: the sign-bytes of votes/proposals and the
+header field hashes are consensus-critical byte strings, so the framework owns
+the exact bytes it emits instead of trusting a codegen layer.  Field numbers
+and wire semantics follow the reference protocol definitions
+(reference: proto/tendermint/types/canonical.proto, types.proto) and gogoproto
+proto3 emission rules: scalar fields are omitted when zero, pointer (nullable)
+message fields are omitted when nil, non-nullable embedded messages are always
+emitted.
+
+Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
+"""
+
+from __future__ import annotations
+
+import struct
+
+WT_VARINT = 0
+WT_FIXED64 = 1
+WT_BYTES = 2
+WT_FIXED32 = 5
+
+_U64_MASK = (1 << 64) - 1
+
+
+def encode_uvarint(n: int) -> bytes:
+    """Unsigned LEB128 varint."""
+    if n < 0:
+        raise ValueError("uvarint cannot encode negative values")
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_uvarint(data: bytes, pos: int = 0) -> tuple[int, int]:
+    """Returns (value, new_pos).  Matches Go binary.Uvarint strictness: at most
+    10 bytes, value must fit in 64 bits (10th byte <= 0x01).  Non-minimal
+    (overlong) encodings are accepted, as Go accepts them; canonical byte
+    strings are only guaranteed for bytes *we* emit."""
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        if shift == 63 and b > 0x01:
+            raise ValueError("varint overflows 64 bits")
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint exceeds 10 bytes")
+
+
+def encode_varint_signed(n: int) -> bytes:
+    """Protobuf int32/int64 encoding: negatives as 64-bit two's complement."""
+    return encode_uvarint(n & _U64_MASK)
+
+
+def decode_varint_signed(data: bytes, pos: int = 0) -> tuple[int, int]:
+    v, pos = decode_uvarint(data, pos)
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v, pos
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return encode_uvarint((field << 3) | wire_type)
+
+
+class ProtoWriter:
+    """Accumulates protobuf fields; proto3 zero-value omission by default."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    # -- scalar fields -------------------------------------------------
+    def varint(self, field: int, value: int, omit_zero: bool = True) -> "ProtoWriter":
+        if value == 0 and omit_zero:
+            return self
+        self._buf += _tag(field, WT_VARINT)
+        self._buf += encode_varint_signed(value)
+        return self
+
+    def bool_(self, field: int, value: bool, omit_zero: bool = True) -> "ProtoWriter":
+        return self.varint(field, 1 if value else 0, omit_zero)
+
+    def sfixed64(self, field: int, value: int, omit_zero: bool = True) -> "ProtoWriter":
+        if value == 0 and omit_zero:
+            return self
+        self._buf += _tag(field, WT_FIXED64)
+        self._buf += struct.pack("<q", value)
+        return self
+
+    def fixed64(self, field: int, value: int, omit_zero: bool = True) -> "ProtoWriter":
+        if value == 0 and omit_zero:
+            return self
+        self._buf += _tag(field, WT_FIXED64)
+        self._buf += struct.pack("<Q", value)
+        return self
+
+    def double(self, field: int, value: float, omit_zero: bool = True) -> "ProtoWriter":
+        if value == 0.0 and omit_zero:
+            return self
+        self._buf += _tag(field, WT_FIXED64)
+        self._buf += struct.pack("<d", value)
+        return self
+
+    # -- length-delimited fields --------------------------------------
+    def bytes_(self, field: int, value: bytes, omit_empty: bool = True) -> "ProtoWriter":
+        if not value and omit_empty:
+            return self
+        self._buf += _tag(field, WT_BYTES)
+        self._buf += encode_uvarint(len(value))
+        self._buf += value
+        return self
+
+    def string(self, field: int, value: str, omit_empty: bool = True) -> "ProtoWriter":
+        return self.bytes_(field, value.encode("utf-8"), omit_empty)
+
+    def message(self, field: int, encoded: bytes | None, always: bool = False) -> "ProtoWriter":
+        """Embedded message.  None = nil pointer (omitted unless `always`);
+        b"" = present-but-empty message (emitted as tag + length 0, matching
+        gogoproto's non-nil-pointer emission).  `always=True` mirrors
+        gogoproto nullable=false emission (written even when None/empty)."""
+        if encoded is None and not always:
+            return self
+        body = encoded or b""
+        self._buf += _tag(field, WT_BYTES)
+        self._buf += encode_uvarint(len(body))
+        self._buf += body
+        return self
+
+    def repeated_bytes(self, field: int, values) -> "ProtoWriter":
+        for v in values:
+            self._buf += _tag(field, WT_BYTES)
+            self._buf += encode_uvarint(len(v))
+            self._buf += v
+        return self
+
+    def bytes_out(self) -> bytes:
+        return bytes(self._buf)
+
+
+def encode_delimited(msg: bytes) -> bytes:
+    """Varint-length-prefixed message — the framing used for sign-bytes and
+    wire packets (reference: libs/protoio, types/vote.go:93-101)."""
+    return encode_uvarint(len(msg)) + msg
+
+
+def decode_delimited(data: bytes, pos: int = 0) -> tuple[bytes, int]:
+    n, pos = decode_uvarint(data, pos)
+    if pos + n > len(data):
+        raise ValueError("truncated delimited message")
+    return data[pos : pos + n], pos + n
+
+
+def parse_message(data: bytes) -> list[tuple[int, int, object]]:
+    """Parse a protobuf message into a list of (field, wire_type, value).
+
+    Values: int for varint/fixed; bytes for length-delimited.
+    """
+    fields: list[tuple[int, int, object]] = []
+    pos = 0
+    while pos < len(data):
+        key, pos = decode_uvarint(data, pos)
+        field, wt = key >> 3, key & 7
+        if wt == WT_VARINT:
+            v, pos = decode_uvarint(data, pos)
+            fields.append((field, wt, v))
+        elif wt == WT_FIXED64:
+            if pos + 8 > len(data):
+                raise ValueError("truncated fixed64")
+            fields.append((field, wt, struct.unpack_from("<Q", data, pos)[0]))
+            pos += 8
+        elif wt == WT_BYTES:
+            n, pos = decode_uvarint(data, pos)
+            if pos + n > len(data):
+                raise ValueError("truncated bytes field")
+            fields.append((field, wt, data[pos : pos + n]))
+            pos += n
+        elif wt == WT_FIXED32:
+            if pos + 4 > len(data):
+                raise ValueError("truncated fixed32")
+            fields.append((field, wt, struct.unpack_from("<I", data, pos)[0]))
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+    return fields
+
+
+def fields_to_dict(data: bytes) -> dict[int, list[object]]:
+    out: dict[int, list[object]] = {}
+    for field, _wt, v in parse_message(data):
+        out.setdefault(field, []).append(v)
+    return out

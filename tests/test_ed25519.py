@@ -1,0 +1,116 @@
+import os
+import secrets
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.crypto.keys import PrivKey, PubKey, gen_priv_key
+
+# RFC 8032 §7.1 test vector 1 (empty message)
+RFC_SEED = bytes.fromhex("9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60")
+RFC_PUB = bytes.fromhex("d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a")
+RFC_SIG = bytes.fromhex(
+    "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+    "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+)
+
+
+def test_rfc8032_vector1():
+    assert ed.pubkey_from_seed(RFC_SEED) == RFC_PUB
+    assert ed.sign(RFC_SEED, b"") == RFC_SIG
+    assert ed.verify(RFC_PUB, b"", RFC_SIG)
+
+
+def test_sign_verify_roundtrip():
+    seed = secrets.token_bytes(32)
+    pub = ed.pubkey_from_seed(seed)
+    msg = b"consensus is hard"
+    sig = ed.sign(seed, msg)
+    assert ed.verify(pub, msg, sig)
+    assert not ed.verify(pub, msg + b"!", sig)
+    assert not ed.verify(pub, msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+
+
+def test_libcrypto_agreement():
+    """Pure-Python signing must match libcrypto signing bit-for-bit."""
+    cryptography = pytest.importorskip("cryptography")
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    for _ in range(8):
+        seed = secrets.token_bytes(32)
+        msg = secrets.token_bytes(40)
+        csigner = Ed25519PrivateKey.from_private_bytes(seed)
+        assert csigner.public_key().public_bytes_raw() == ed.pubkey_from_seed(seed)
+        assert csigner.sign(msg) == ed.sign(seed, msg)
+
+
+def test_noncanonical_s_rejected():
+    seed = secrets.token_bytes(32)
+    pub = ed.pubkey_from_seed(seed)
+    msg = b"m"
+    sig = ed.sign(seed, msg)
+    s = int.from_bytes(sig[32:], "little")
+    s_nc = s + ed.L
+    if s_nc < 1 << 256:
+        bad = sig[:32] + s_nc.to_bytes(32, "little")
+        assert not ed.verify(pub, msg, bad)
+
+
+def test_zip215_small_order_and_noncanonical_accepted():
+    """With s = 0 and A, R of small order, the cofactored equation holds for
+    any message: [8]0*B == [8]R + [8]k*A collapses to O == O.  Every ZIP-215
+    legal encoding (incl. y >= p non-canonical forms) must therefore verify;
+    cofactorless RFC 8032 verifiers reject many of these."""
+    torsion = ed.eight_torsion_points()
+    assert len(torsion) == 8
+    s0 = (0).to_bytes(32, "little")
+    checked = 0
+    for pt in torsion:
+        for enc_a in ed.noncanonical_encodings(pt):
+            for enc_r in ed.noncanonical_encodings(pt):
+                assert ed.verify(enc_a, b"any message", enc_r + s0), (
+                    enc_a.hex(),
+                    enc_r.hex(),
+                )
+                checked += 1
+    assert checked >= 16
+
+
+def test_decode_rejects_off_curve():
+    # y = 2 is not on the curve (x^2 = (y^2-1)/(dy^2+1) has no sqrt)
+    bad = (2).to_bytes(32, "little")
+    assert ed.decode_point_zip215(bad) is None
+
+
+def test_keys_api():
+    pk = gen_priv_key()
+    pub = pk.pub_key()
+    assert len(pk.bytes_()) == 64
+    assert len(pub.address()) == 20
+    msg = b"vote"
+    sig = pk.sign(msg)
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(b"other", sig)
+    # 64-byte round-trip
+    pk2 = PrivKey(pk.bytes_())
+    assert pk2.pub_key() == pub
+
+
+def test_cpu_batch_verifier():
+    from tendermint_tpu.crypto.batch import CPUBatchVerifier
+
+    bv = CPUBatchVerifier()
+    keys = [gen_priv_key() for _ in range(4)]
+    msgs = [f"msg-{i}".encode() for i in range(4)]
+    for k, m in zip(keys, msgs):
+        bv.add(k.pub_key(), m, k.sign(m))
+    ok, oks = bv.verify()
+    assert ok and oks == [True] * 4
+    # mixed-validity batch
+    for i, (k, m) in enumerate(zip(keys, msgs)):
+        sig = k.sign(m)
+        if i == 2:
+            sig = sig[:-1] + bytes([sig[-1] ^ 0xFF])
+        bv.add(k.pub_key(), m, sig)
+    ok, oks = bv.verify()
+    assert not ok and oks == [True, True, False, True]

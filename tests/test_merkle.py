@@ -1,0 +1,42 @@
+import hashlib
+
+from tendermint_tpu.crypto import merkle
+
+
+def test_empty_root():
+    # RFC 6962: hash of empty tree = SHA-256 of the empty string
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+
+def test_single_leaf():
+    item = b"tx1"
+    assert merkle.hash_from_byte_slices([item]) == hashlib.sha256(b"\x00" + item).digest()
+
+
+def test_two_leaves():
+    a, b = b"a", b"b"
+    la = hashlib.sha256(b"\x00" + a).digest()
+    lb = hashlib.sha256(b"\x00" + b).digest()
+    expected = hashlib.sha256(b"\x01" + la + lb).digest()
+    assert merkle.hash_from_byte_slices([a, b]) == expected
+
+
+def test_split_point_unbalanced():
+    # 5 leaves: split 4/1 at the top per RFC 6962
+    items = [bytes([i]) for i in range(5)]
+    left = merkle.hash_from_byte_slices(items[:4])
+    right = merkle.hash_from_byte_slices(items[4:])
+    assert merkle.hash_from_byte_slices(items) == merkle.inner_hash(left, right)
+
+
+def test_proofs_verify_all_sizes():
+    for n in [1, 2, 3, 5, 8, 13]:
+        items = [f"item-{i}".encode() for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, pr in enumerate(proofs):
+            assert pr.verify(root, items[i]), (n, i)
+            assert not pr.verify(root, b"tampered")
+        # proof for item i must not verify at another index's position
+        if n > 1:
+            assert not proofs[0].verify(root, items[1])

@@ -1,0 +1,68 @@
+from tendermint_tpu.wire import proto
+
+
+def test_uvarint_roundtrip():
+    for n in [0, 1, 127, 128, 300, 2**32, 2**63, 2**64 - 1]:
+        enc = proto.encode_uvarint(n)
+        dec, pos = proto.decode_uvarint(enc)
+        assert dec == n and pos == len(enc)
+
+
+def test_uvarint_known():
+    assert proto.encode_uvarint(0) == b"\x00"
+    assert proto.encode_uvarint(1) == b"\x01"
+    assert proto.encode_uvarint(300) == b"\xac\x02"
+
+
+def test_signed_varint_negative():
+    enc = proto.encode_varint_signed(-1)
+    assert len(enc) == 10  # 64-bit two's complement
+    dec, _ = proto.decode_varint_signed(enc)
+    assert dec == -1
+
+
+def test_writer_and_parser():
+    w = (
+        proto.ProtoWriter()
+        .varint(1, 7)
+        .sfixed64(2, 42)
+        .string(3, "chain-x")
+        .bytes_(4, b"\x01\x02")
+        .varint(5, 0)  # omitted
+    )
+    data = w.bytes_out()
+    fields = proto.parse_message(data)
+    assert (1, proto.WT_VARINT, 7) in fields
+    assert any(f == 2 and v == 42 for f, _w, v in fields)
+    assert (3, proto.WT_BYTES, b"chain-x") in fields
+    assert (4, proto.WT_BYTES, b"\x01\x02") in fields
+    assert not any(f == 5 for f, _w, _v in fields)
+
+
+def test_message_field_emission():
+    # nullable=false embedded message: emitted even when empty
+    w = proto.ProtoWriter().message(1, b"", always=True)
+    assert w.bytes_out() == b"\x0a\x00"
+    # nil pointer: omitted
+    assert proto.ProtoWriter().message(1, None).bytes_out() == b""
+    # present-but-empty (non-nil pointer to empty msg): emitted as tag+len 0
+    assert proto.ProtoWriter().message(1, b"").bytes_out() == b"\x0a\x00"
+
+
+def test_uvarint_overflow_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        proto.decode_uvarint(b"\xff" * 9 + b"\x7f")  # > 2^64-1
+    with pytest.raises(ValueError):
+        proto.decode_uvarint(b"\x80" * 10 + b"\x01")  # > 10 bytes
+    # max u64 round-trips
+    v, _ = proto.decode_uvarint(proto.encode_uvarint(2**64 - 1))
+    assert v == 2**64 - 1
+
+
+def test_delimited():
+    msg = b"hello"
+    framed = proto.encode_delimited(msg)
+    out, pos = proto.decode_delimited(framed)
+    assert out == msg and pos == len(framed)
