@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched Ed25519 signature verification throughput.
+
+Metric (BASELINE.json): Ed25519 sig-verifies/sec.  The reference verifies
+sequentially on CPU (crypto/ed25519/ed25519.go:149-156, no BatchVerifier);
+this framework verifies the whole batch as one XLA device program.
+
+vs_baseline: ratio against a sequential single-core libcrypto (OpenSSL)
+verify loop measured in the same process — a *harder* baseline than the
+reference's Go ed25519consensus path (OpenSSL's cofactorless verify is
+roughly 2-3x faster per signature than Go's ZIP-215 batch-equation code),
+so the ratio understates the advantage over the actual reference.
+
+Prints exactly one JSON line on stdout.
+"""
+
+import json
+import secrets
+import statistics
+import sys
+import time
+
+N = 8192
+TIMED_RUNS = 5
+BASELINE_SAMPLE = 2048
+
+
+def main() -> None:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    signers = [Ed25519PrivateKey.from_private_bytes(secrets.token_bytes(32)) for _ in range(N)]
+    pubs = [s.public_key().public_bytes_raw() for s in signers]
+    msgs = [b"block-commit-sig-%d" % i for i in range(N)]
+    sigs = [s.sign(m) for s, m in zip(signers, msgs)]
+
+    from tendermint_tpu.ops import ed25519_jax as dev
+
+    # warmup: pays one-time XLA compile for this bucket
+    ok = dev.verify_batch(pubs, msgs, sigs)
+    assert ok.all(), "warmup verification failed"
+
+    times = []
+    for _ in range(TIMED_RUNS):
+        t0 = time.perf_counter()
+        ok = dev.verify_batch(pubs, msgs, sigs)
+        times.append(time.perf_counter() - t0)
+        assert ok.all()
+    ours = N / statistics.median(times)
+
+    # baseline: sequential single-core libcrypto verify
+    pub_objs = [Ed25519PublicKey.from_public_bytes(p) for p in pubs[:BASELINE_SAMPLE]]
+    t0 = time.perf_counter()
+    for po, m, s in zip(pub_objs, msgs, sigs):
+        po.verify(s, m)
+    base = BASELINE_SAMPLE / (time.perf_counter() - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_sig_verifies_per_sec",
+                "value": round(ours, 1),
+                "unit": "sigs/s",
+                "vs_baseline": round(ours / base, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,204 @@
+"""Batched ZIP-215 Ed25519 verification as one XLA device program.
+
+This is the framework's north star (SURVEY §2.9, BASELINE.md): the reference
+verifies every consensus signature sequentially on CPU; here the entire batch
+— all commit signatures for a height, a whole fast-sync window, a light-client
+header range — becomes a single jitted program of elementwise limb arithmetic
+over the batch axis, shaped for the TPU VPU and shardable over a device mesh
+(tendermint_tpu.parallel).
+
+Pipeline per batch:
+  host:   parse sig/pubkey bytes, check s < L (ZIP-215 rule 1), hash
+          k = SHA-512(R||A||M) mod L (variable-length messages stay on host),
+          convert to limb/bit tensors.
+  device: permissive point decompression for A and R (ZIP-215 rule 2 —
+          y >= p accepted, x=0/sign=1 accepted, small order accepted),
+          W = [s]B + [k](-A) by joint (Shamir) double-and-add with a 4-entry
+          window table, Q = W - R, and the cofactored check
+          [8]Q == identity (ZIP-215 rule 3).
+
+Note: -[k]A is computed as [k](-A), never as [L-k]A — the latter is wrong for
+points with a torsion component (L·A ≠ O), exactly the inputs ZIP-215 admits.
+
+Static batch sizes: inputs are padded to power-of-two buckets so XLA compiles
+one program per bucket (first call per bucket pays compile; consensus reuses
+steady-state buckets).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tendermint_tpu.crypto import ed25519 as _ref
+from . import fe25519 as fe
+from .fe25519 import Pt
+
+L = _ref.L
+SCALAR_BITS = 253  # s, k < L < 2^253
+
+
+# ---------------------------------------------------------------------------
+# Device program
+# ---------------------------------------------------------------------------
+
+def decompress(y: jnp.ndarray, sign: jnp.ndarray) -> tuple[Pt, jnp.ndarray]:
+    """Permissive (ZIP-215/dalek) decompression.
+
+    y: [..., 15] limbs of the 255-bit y encoding (possibly >= p — arithmetic
+    tolerates unreduced input); sign: [...] in {0,1}.
+    Returns (point, on_curve).
+    """
+    yy = fe.fe_sq(y)
+    u = fe.fe_sub(yy, jnp.asarray(fe.ONE))
+    v = fe.fe_carry(fe.fe_add(fe.fe_mul(yy, jnp.asarray(fe.D_CONST)), jnp.asarray(fe.ONE)))
+    v2 = fe.fe_sq(v)
+    v3 = fe.fe_mul(v2, v)
+    v7 = fe.fe_mul(fe.fe_sq(v3), v)
+    t = fe.fe_pow_p58(fe.fe_mul(u, v7))
+    x = fe.fe_mul(fe.fe_mul(u, v3), t)  # candidate sqrt(u/v)
+    vx2 = fe.fe_mul(v, fe.fe_sq(x))
+    is_pos = fe.fe_eq(vx2, u)
+    is_neg = fe.fe_eq(vx2, fe.fe_carry(fe.fe_neg(fe.fe_canonical(u))))
+    ok = is_pos | is_neg
+    x = jnp.where(is_neg[..., None], fe.fe_mul(x, jnp.asarray(fe.SQRT_M1_CONST)), x)
+    # sign-bit adjustment on the canonical representative; x=0/sign=1 is
+    # accepted and stays 0 mod p (fe_neg(0) = 4p ≡ 0) — dalek semantics.
+    cx = fe.fe_canonical(x)
+    flip = (cx[..., 0] & 1) != sign
+    x = jnp.where(flip[..., None], fe.fe_carry(fe.fe_neg(cx)), cx)
+    yr = fe.fe_canonical(y)
+    return Pt(x, yr, jnp.broadcast_to(jnp.asarray(fe.ONE), yr.shape), fe.fe_mul(x, yr)), ok
+
+
+def _shamir(s_bits: jnp.ndarray, k_bits: jnp.ndarray, neg_a: Pt) -> Pt:
+    """W = [s]B + [k]negA, joint double-and-add, MSB first.
+
+    s_bits/k_bits: [..., 253] in {0,1}; neg_a: batch point.
+    """
+    shape = s_bits.shape[:-1]
+    base = fe.pt_base(shape)
+    ident = fe.pt_identity(shape)
+    t3 = fe.pt_add(base, neg_a)  # B + (-A)
+
+    def body(i, acc: Pt) -> Pt:
+        bit_s = jnp.take(s_bits, SCALAR_BITS - 1 - i, axis=-1)
+        bit_k = jnp.take(k_bits, SCALAR_BITS - 1 - i, axis=-1)
+        acc = fe.pt_add(acc, acc)  # complete formulas: doubling included
+        # 4-way window select: {O, B, -A, B-A}
+        sel_k = fe.pt_select(bit_k, neg_a, ident)
+        sel_k1 = fe.pt_select(bit_k, t3, base)
+        addend = fe.pt_select(bit_s, sel_k1, sel_k)
+        return fe.pt_add(acc, addend)
+
+    return lax.fori_loop(0, SCALAR_BITS, body, ident)
+
+
+def _verify_core(y_a, sign_a, y_r, sign_r, s_bits, k_bits, valid):
+    a_pt, ok_a = decompress(y_a, sign_a)
+    r_pt, ok_r = decompress(y_r, sign_r)
+    w = _shamir(s_bits, k_bits, fe.pt_neg(a_pt))
+    q = fe.pt_add(w, fe.pt_neg(r_pt))
+    q2 = fe.pt_add(q, q)
+    q4 = fe.pt_add(q2, q2)
+    q8 = fe.pt_add(q4, q4)
+    return valid & ok_a & ok_r & fe.pt_is_identity(q8)
+
+
+@functools.cache
+def _compiled(n: int):
+    return jax.jit(_verify_core)
+
+
+# ---------------------------------------------------------------------------
+# Host preprocessing
+# ---------------------------------------------------------------------------
+
+# 255 = 15 limbs x 17 bits exactly, so byte strings convert to limb tensors
+# with one unpackbits + reshape + weighted sum — no per-element Python.
+_BIT_WEIGHTS = (1 << np.arange(fe.LIMB_BITS, dtype=np.int64))
+
+
+def _bytes32_to_bits(rows: np.ndarray) -> np.ndarray:
+    """rows: [N, 32] uint8 → [N, 256] bits, little-endian bit order."""
+    return np.unpackbits(rows, axis=1, bitorder="little")
+
+
+def _bits_to_limbs(bits255: np.ndarray) -> np.ndarray:
+    """bits: [N, 255] → [N, 15] int64 limbs (17 bits each)."""
+    n = bits255.shape[0]
+    return bits255.reshape(n, fe.NLIMBS, fe.LIMB_BITS).astype(np.int64) @ _BIT_WEIGHTS
+
+
+def prepare_batch(pubs, msgs, sigs):
+    """Parse/validate on host; returns the device input tensors (numpy).
+
+    Vectorized except the per-message SHA-512 (variable-length; hashlib C)."""
+    n = len(pubs)
+    valid = np.ones(n, dtype=bool)
+    pub_rows = np.zeros((n, 32), dtype=np.uint8)
+    r_rows = np.zeros((n, 32), dtype=np.uint8)
+    s_rows = np.zeros((n, 32), dtype=np.uint8)
+    k_rows = np.zeros((n, 32), dtype=np.uint8)
+    for i, (pub, msg, sig) in enumerate(zip(pubs, msgs, sigs)):
+        if len(pub) != 32 or len(sig) != 64:
+            valid[i] = False
+            continue
+        r_bytes = sig[:32]
+        s = int.from_bytes(sig[32:], "little")
+        if s >= L:  # ZIP-215 rule 1: s must be canonical
+            valid[i] = False
+            continue
+        pub_rows[i] = np.frombuffer(pub, dtype=np.uint8)
+        r_rows[i] = np.frombuffer(r_bytes, dtype=np.uint8)
+        s_rows[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+        k = int.from_bytes(hashlib.sha512(r_bytes + pub + msg).digest(), "little") % L
+        k_rows[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+    pub_bits = _bytes32_to_bits(pub_rows)
+    r_bits = _bytes32_to_bits(r_rows)
+    return (
+        _bits_to_limbs(pub_bits[:, :255]),
+        pub_bits[:, 255].astype(np.int32),
+        _bits_to_limbs(r_bits[:, :255]),
+        r_bits[:, 255].astype(np.int32),
+        _bytes32_to_bits(s_rows)[:, :SCALAR_BITS].astype(np.int32),
+        _bytes32_to_bits(k_rows)[:, :SCALAR_BITS].astype(np.int32),
+        valid,
+    )
+
+
+def _bucket(n: int) -> int:
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+def verify_batch(pubs, msgs, sigs) -> np.ndarray:
+    """ZIP-215 verification of the whole batch in one device call.
+
+    Returns bool[N].  Inputs are bytes-like sequences of equal length N.
+    """
+    n = len(pubs)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    y_a, sign_a, y_r, sign_r, s_bits, k_bits, valid = prepare_batch(pubs, msgs, sigs)
+    b = _bucket(n)
+    if b != n:
+        pad = b - n
+
+        def p2(x):
+            return np.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+
+        y_a, y_r = p2(y_a), p2(y_r)
+        sign_a, sign_r = p2(sign_a), p2(sign_r)
+        s_bits, k_bits = p2(s_bits), p2(k_bits)
+        valid = np.pad(valid, (0, pad))
+    ok = _compiled(b)(y_a, sign_a, y_r, sign_r, s_bits, k_bits, valid)
+    return np.asarray(ok)[:n]

@@ -1,0 +1,1 @@
+from .sharding import make_mesh, sharded_verify_fn, pad_to_multiple

@@ -1,0 +1,69 @@
+"""Device-mesh sharding for the crypto data plane.
+
+The reference's scale dimension is validator-set size N: every commit
+verification is O(N) sequential CPU there (SURVEY §5.7).  Here the batch
+axis of the signature-verification tensors is sharded over a
+`jax.sharding.Mesh` — data parallelism over ICI — so a 10k-validator commit
+splits across chips with zero collectives (the program is elementwise over
+the batch; only the final per-signature bits travel back).
+
+This module is deliberately mesh-shape agnostic: a 1-D ("batch",) mesh is
+the natural layout; multi-host DCN meshes work identically because no
+cross-batch communication exists.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tendermint_tpu.ops import ed25519_jax as _dev
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the batch axis."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("batch",))
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def sharded_verify_fn(mesh: Mesh):
+    """jit of the batched ZIP-215 verify core with all inputs/outputs
+    sharded along the batch axis of `mesh`.  Cached per mesh; XLA caches
+    per input shape under it."""
+    batch = NamedSharding(mesh, P("batch"))
+    batch2 = NamedSharding(mesh, P("batch", None))
+    in_sh = (batch2, batch, batch2, batch, batch2, batch2, batch)
+    return jax.jit(_dev._verify_core, in_shardings=in_sh, out_shardings=batch)
+
+
+def verify_batch_sharded(pubs, msgs, sigs, mesh: Mesh | None = None) -> np.ndarray:
+    """Like ops.ed25519_jax.verify_batch but sharded across all devices."""
+    n = len(pubs)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = mesh.devices.size
+    inputs = _dev.prepare_batch(pubs, msgs, sigs)
+    b = max(_dev._bucket(n), pad_to_multiple(n, n_dev))
+    b = pad_to_multiple(b, n_dev)
+    if b != n:
+        pad = b - n
+        inputs = tuple(
+            np.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) for x in inputs
+        )
+    ok = sharded_verify_fn(mesh)(*inputs)
+    return np.asarray(ok)[:n]
