@@ -1,0 +1,21 @@
+from .basic import (
+    BlockIDFlag,
+    SignedMsgType,
+    BlockID,
+    PartSetHeader,
+    ZERO_BLOCK_ID,
+    GO_ZERO_TIME_NS,
+    encode_timestamp,
+    now_ns,
+)
+from .canonical import vote_sign_bytes_raw, proposal_sign_bytes_raw
+from .validator import Validator, ValidatorSet, simple_validator_bytes
+from .vote import Vote
+from .proposal import Proposal
+from .commit import Commit, CommitSig
+from .block import Header, Data, Block, BlockMeta
+from .part_set import Part, PartSet, BLOCK_PART_SIZE_BYTES
+from .vote_set import VoteSet, ConflictingVoteError, commit_to_vote_set
+from .evidence import DuplicateVoteEvidence, LightClientAttackEvidence, decode_evidence
+from .params import ConsensusParams, ConsensusParamsUpdate
+from .genesis import GenesisDoc, GenesisValidator

@@ -1,0 +1,126 @@
+"""Foundational wire-model types: enums, BlockID, PartSetHeader, timestamps.
+
+Field numbers follow the reference protocol definitions
+(proto/tendermint/types/types.proto, canonical.proto); timestamps are integer
+nanoseconds since the Unix epoch (Go time.Time semantics: zero value is
+0001-01-01T00:00:00Z, UTC, nanosecond precision — types/time/time.go:16).
+"""
+
+from __future__ import annotations
+
+import enum
+import time as _time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+# Go's zero time (0001-01-01T00:00:00Z) in ns since the Unix epoch.
+GO_ZERO_TIME_SECONDS = -62135596800
+GO_ZERO_TIME_NS = GO_ZERO_TIME_SECONDS * 1_000_000_000
+NS = 1_000_000_000
+
+
+def now_ns() -> int:
+    return _time.time_ns()
+
+
+def encode_timestamp(ns: int) -> bytes:
+    """google.protobuf.Timestamp{seconds=1, nanos=2}; floor division keeps
+    nanos in [0, 1e9) for negative (pre-epoch) times."""
+    seconds, nanos = divmod(ns, NS)
+    return ProtoWriter().varint(1, seconds).varint(2, nanos).bytes_out()
+
+
+def decode_timestamp(data: bytes) -> int:
+    f = fields_to_dict(data)
+    seconds = f.get(1, [0])[0]
+    nanos = f.get(2, [0])[0]
+    if seconds >= 1 << 63:
+        seconds -= 1 << 64
+    return seconds * NS + nanos
+
+
+class BlockIDFlag(enum.IntEnum):
+    """types.proto BlockIDFlag"""
+
+    ABSENT = 1
+    COMMIT = 2
+    NIL = 3
+
+
+class SignedMsgType(enum.IntEnum):
+    """types.proto SignedMsgType"""
+
+    UNKNOWN = 0
+    PREVOTE = 1
+    PRECOMMIT = 2
+    PROPOSAL = 32
+
+
+@dataclass(frozen=True)
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and len(self.hash) == 0
+
+    def encode(self) -> bytes:
+        return ProtoWriter().varint(1, self.total).bytes_(2, self.hash).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PartSetHeader":
+        f = fields_to_dict(data)
+        return cls(total=f.get(1, [0])[0], hash=f.get(2, [b""])[0])
+
+    def validate_basic(self) -> None:
+        if self.total < 0:
+            raise ValueError("negative part-set total")
+        if self.hash and len(self.hash) != 32:
+            raise ValueError("part-set hash must be 32 bytes")
+
+
+@dataclass(frozen=True)
+class BlockID:
+    hash: bytes = b""
+    part_set_header: PartSetHeader = field(default_factory=PartSetHeader)
+
+    def is_zero(self) -> bool:
+        return len(self.hash) == 0 and self.part_set_header.is_zero()
+
+    def is_complete(self) -> bool:
+        return len(self.hash) == 32 and self.part_set_header.total > 0
+
+    def encode(self) -> bytes:
+        """types.proto BlockID{hash=1, part_set_header=2 non-nullable}."""
+        return (
+            ProtoWriter()
+            .bytes_(1, self.hash)
+            .message(2, self.part_set_header.encode(), always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockID":
+        f = fields_to_dict(data)
+        psh = f.get(2, [None])[0]
+        return cls(
+            hash=f.get(1, [b""])[0],
+            part_set_header=PartSetHeader.decode(psh) if psh is not None else PartSetHeader(),
+        )
+
+    def key(self) -> tuple:
+        return (self.hash, self.part_set_header.total, self.part_set_header.hash)
+
+    def validate_basic(self) -> None:
+        if self.hash and len(self.hash) != 32:
+            raise ValueError("block hash must be 32 bytes")
+        self.part_set_header.validate_basic()
+        # either both zero or both set
+        if self.is_zero():
+            return
+        if not self.hash and not self.part_set_header.is_zero():
+            raise ValueError("blockID hash empty but part-set header set")
+
+
+ZERO_BLOCK_ID = BlockID()

@@ -1,0 +1,286 @@
+"""Block, Header, Data, BlockMeta — the chained data model.
+
+Parity: reference types/block.go (Header :334-580, Hash :448 — merkle root
+of the 14 proto-encoded fields with gogotypes wrapper encoding
+(types/encoding_helper.go cdcEncode), Block :43-330, MakePartSet :130),
+wire form types.proto Header{1..14}, Block, Data, BlockMeta.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .basic import (
+    BlockID,
+    GO_ZERO_TIME_NS,
+    decode_timestamp,
+    encode_timestamp,
+)
+from .commit import Commit
+from .part_set import BLOCK_PART_SIZE_BYTES, PartSet
+
+# Protocol versions (reference version/version.go:11-24)
+BLOCK_PROTOCOL = 11
+
+
+def consensus_version_bytes(block: int, app: int) -> bytes:
+    """tendermint.version.Consensus{block=1, app=2}."""
+    return ProtoWriter().varint(1, block).varint(2, app).bytes_out()
+
+
+def _wrap_bytes(v: bytes) -> bytes:
+    """gogotypes.BytesValue{value=1}; empty → nil bytes (cdcEncode)."""
+    if not v:
+        return b""
+    return ProtoWriter().bytes_(1, v).bytes_out()
+
+
+def _wrap_string(v: str) -> bytes:
+    if not v:
+        return b""
+    return ProtoWriter().string(1, v).bytes_out()
+
+
+def _wrap_int64(v: int) -> bytes:
+    if not v:
+        return b""
+    return ProtoWriter().varint(1, v).bytes_out()
+
+
+@dataclass
+class Header:
+    chain_id: str = ""
+    height: int = 0
+    time_ns: int = GO_ZERO_TIME_NS
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+    version_block: int = BLOCK_PROTOCOL
+    version_app: int = 0
+
+    def hash(self) -> bytes | None:
+        """Merkle root of the 14 proto-encoded fields (reference :448-483).
+        None if ValidatorsHash is missing (header not fully populated)."""
+        if not self.validators_hash:
+            return None
+        return merkle.hash_from_byte_slices(
+            [
+                consensus_version_bytes(self.version_block, self.version_app),
+                _wrap_string(self.chain_id),
+                _wrap_int64(self.height),
+                encode_timestamp(self.time_ns),
+                self.last_block_id.encode(),
+                _wrap_bytes(self.last_commit_hash),
+                _wrap_bytes(self.data_hash),
+                _wrap_bytes(self.validators_hash),
+                _wrap_bytes(self.next_validators_hash),
+                _wrap_bytes(self.consensus_hash),
+                _wrap_bytes(self.app_hash),
+                _wrap_bytes(self.last_results_hash),
+                _wrap_bytes(self.evidence_hash),
+                _wrap_bytes(self.proposer_address),
+            ]
+        )
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .message(1, consensus_version_bytes(self.version_block, self.version_app), always=True)
+            .string(2, self.chain_id)
+            .varint(3, self.height)
+            .message(4, encode_timestamp(self.time_ns), always=True)
+            .message(5, self.last_block_id.encode(), always=True)
+            .bytes_(6, self.last_commit_hash)
+            .bytes_(7, self.data_hash)
+            .bytes_(8, self.validators_hash)
+            .bytes_(9, self.next_validators_hash)
+            .bytes_(10, self.consensus_hash)
+            .bytes_(11, self.app_hash)
+            .bytes_(12, self.last_results_hash)
+            .bytes_(13, self.evidence_hash)
+            .bytes_(14, self.proposer_address)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Header":
+        from tendermint_tpu.wire.proto import to_int64
+
+        f = fields_to_dict(data)
+
+        def get(n, default):
+            return f.get(n, [default])[0]
+
+        ver = fields_to_dict(get(1, b""))
+        bid = get(5, None)
+        ts = get(4, None)
+        return cls(
+            version_block=ver.get(1, [0])[0],
+            version_app=ver.get(2, [0])[0],
+            chain_id=get(2, b"").decode("utf-8") if isinstance(get(2, b""), bytes) else "",
+            height=to_int64(get(3, 0)),
+            time_ns=decode_timestamp(ts) if ts is not None else GO_ZERO_TIME_NS,
+            last_block_id=BlockID.decode(bid) if bid is not None else BlockID(),
+            last_commit_hash=get(6, b""),
+            data_hash=get(7, b""),
+            validators_hash=get(8, b""),
+            next_validators_hash=get(9, b""),
+            consensus_hash=get(10, b""),
+            app_hash=get(11, b""),
+            last_results_hash=get(12, b""),
+            evidence_hash=get(13, b""),
+            proposer_address=get(14, b""),
+        )
+
+    def validate_basic(self) -> None:
+        if len(self.chain_id) > 50:
+            raise ValueError("chain_id too long")
+        if self.height < 0:
+            raise ValueError("negative height")
+        self.last_block_id.validate_basic()
+        for name, h in (
+            ("last_commit_hash", self.last_commit_hash),
+            ("data_hash", self.data_hash),
+            ("evidence_hash", self.evidence_hash),
+            ("last_results_hash", self.last_results_hash),
+            ("validators_hash", self.validators_hash),
+            ("next_validators_hash", self.next_validators_hash),
+            ("consensus_hash", self.consensus_hash),
+        ):
+            if h and len(h) != 32:
+                raise ValueError(f"{name} must be 32 bytes")
+        if self.proposer_address and len(self.proposer_address) != 20:
+            raise ValueError("proposer address must be 20 bytes")
+
+
+@dataclass
+class Data:
+    txs: list[bytes] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(list(self.txs))
+
+    def encode(self) -> bytes:
+        return ProtoWriter().repeated_bytes(1, self.txs).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Data":
+        f = fields_to_dict(data)
+        return cls(txs=list(f.get(1, [])))
+
+
+@dataclass
+class Block:
+    header: Header
+    data: Data = field(default_factory=Data)
+    evidence: list = field(default_factory=list)
+    last_commit: Commit | None = None
+
+    def hash(self) -> bytes | None:
+        return self.header.hash()
+
+    def block_id(self, part_set: PartSet | None = None) -> BlockID:
+        ps = part_set or self.make_part_set()
+        h = self.hash()
+        assert h is not None
+        return BlockID(hash=h, part_set_header=ps.header())
+
+    def make_part_set(self, part_size: int = BLOCK_PART_SIZE_BYTES) -> PartSet:
+        return PartSet.from_data(self.encode(), part_size)
+
+    def fill_header(self) -> None:
+        """Populate derived hashes (reference block.go fillHeader)."""
+        if not self.header.last_commit_hash and self.last_commit is not None:
+            self.header.last_commit_hash = self.last_commit.hash()
+        if not self.header.data_hash:
+            self.header.data_hash = self.data.hash()
+        if not self.header.evidence_hash:
+            self.header.evidence_hash = _evidence_hash(self.evidence)
+
+    def encode(self) -> bytes:
+        """Block{header=1, data=2, evidence=3, last_commit=4}."""
+        ev = ProtoWriter()
+        for e in self.evidence:
+            ev.message(1, e.encode(), always=True)
+        w = (
+            ProtoWriter()
+            .message(1, self.header.encode(), always=True)
+            .message(2, self.data.encode(), always=True)
+            .message(3, ev.bytes_out(), always=True)
+        )
+        if self.last_commit is not None:
+            w.message(4, self.last_commit.encode())
+        return w.bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        from .evidence import decode_evidence  # local: avoid import cycle
+
+        f = fields_to_dict(data)
+        header = Header.decode(f.get(1, [b""])[0])
+        blk_data = Data.decode(f.get(2, [b""])[0]) if f.get(2) else Data()
+        ev_list = []
+        if f.get(3):
+            evf = fields_to_dict(f[3][0])
+            ev_list = [decode_evidence(b) for b in evf.get(1, [])]
+        lc = f.get(4, [None])[0]
+        return cls(
+            header=header,
+            data=blk_data,
+            evidence=ev_list,
+            last_commit=Commit.decode(lc) if lc is not None else None,
+        )
+
+    def validate_basic(self) -> None:
+        self.header.validate_basic()
+        if self.header.height > 1:
+            if self.last_commit is None:
+                raise ValueError("nil LastCommit")
+            self.last_commit.validate_basic()
+        if self.last_commit is not None:
+            if self.header.last_commit_hash != self.last_commit.hash():
+                raise ValueError("wrong LastCommitHash")
+        if self.header.data_hash != self.data.hash():
+            raise ValueError("wrong DataHash")
+
+
+def _evidence_hash(evidence: list) -> bytes:
+    return merkle.hash_from_byte_slices([e.hash() for e in evidence])
+
+
+@dataclass
+class BlockMeta:
+    block_id: BlockID
+    block_size: int
+    header: Header
+    num_txs: int
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .message(1, self.block_id.encode(), always=True)
+            .varint(2, self.block_size)
+            .message(3, self.header.encode(), always=True)
+            .varint(4, self.num_txs)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockMeta":
+        f = fields_to_dict(data)
+        return cls(
+            block_id=BlockID.decode(f.get(1, [b""])[0]),
+            block_size=f.get(2, [0])[0],
+            header=Header.decode(f.get(3, [b""])[0]),
+            num_txs=f.get(4, [0])[0],
+        )

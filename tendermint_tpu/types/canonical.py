@@ -1,0 +1,75 @@
+"""Canonical sign-bytes — byte-compatible with the reference.
+
+CanonicalVote/CanonicalProposal wire layout per
+proto/tendermint/types/canonical.proto (field numbers, sfixed64
+height/round) and types/canonical.go (zero BlockID → field omitted;
+timestamp always emitted).  The final sign-bytes are varint-length-delimited
+(types/vote.go:93-101 MarshalDelimited).  Conformance-tested against the
+reference's TestVoteSignBytesTestVectors byte vectors.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.wire.proto import ProtoWriter, encode_delimited
+
+from .basic import BlockID, SignedMsgType, encode_timestamp
+
+
+def _canonical_block_id(block_id: BlockID) -> bytes | None:
+    """CanonicalBlockID{hash=1, part_set_header=2 non-nullable}; nil when
+    the blockID is zero (nil votes)."""
+    if block_id.is_zero():
+        return None
+    psh = (
+        ProtoWriter()
+        .varint(1, block_id.part_set_header.total)
+        .bytes_(2, block_id.part_set_header.hash)
+        .bytes_out()
+    )
+    return ProtoWriter().bytes_(1, block_id.hash).message(2, psh, always=True).bytes_out()
+
+
+def vote_sign_bytes_raw(
+    chain_id: str,
+    msg_type: SignedMsgType,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+) -> bytes:
+    """Delimited CanonicalVote{type=1, height=2 sfixed64, round=3 sfixed64,
+    block_id=4, timestamp=5 (always), chain_id=6}."""
+    w = (
+        ProtoWriter()
+        .varint(1, int(msg_type))
+        .sfixed64(2, height)
+        .sfixed64(3, round_)
+        .message(4, _canonical_block_id(block_id))
+        .message(5, encode_timestamp(timestamp_ns), always=True)
+        .string(6, chain_id)
+    )
+    return encode_delimited(w.bytes_out())
+
+
+def proposal_sign_bytes_raw(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+) -> bytes:
+    """Delimited CanonicalProposal{type=1(=32), height=2 sfixed64, round=3
+    sfixed64, pol_round=4 int64, block_id=5, timestamp=6 (always),
+    chain_id=7}."""
+    w = (
+        ProtoWriter()
+        .varint(1, int(SignedMsgType.PROPOSAL))
+        .sfixed64(2, height)
+        .sfixed64(3, round_)
+        .varint(4, pol_round)
+        .message(5, _canonical_block_id(block_id))
+        .message(6, encode_timestamp(timestamp_ns), always=True)
+        .string(7, chain_id)
+    )
+    return encode_delimited(w.bytes_out())

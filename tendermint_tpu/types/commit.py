@@ -1,0 +1,155 @@
+"""Commit and CommitSig: the aggregated precommit evidence for a block.
+
+Parity: reference types/block.go:583-870 (CommitSig :603, VoteSignBytes
+:815, CommitToVoteSet in vote_set.py), wire form types.proto Commit{1..4},
+CommitSig{1..4}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .basic import (
+    BlockID,
+    BlockIDFlag,
+    GO_ZERO_TIME_NS,
+    SignedMsgType,
+    decode_timestamp,
+    encode_timestamp,
+)
+from .canonical import vote_sign_bytes_raw
+
+
+@dataclass
+class CommitSig:
+    block_id_flag: BlockIDFlag
+    validator_address: bytes = b""
+    timestamp_ns: int = GO_ZERO_TIME_NS
+    signature: bytes = b""
+
+    @classmethod
+    def absent_sig(cls) -> "CommitSig":
+        return cls(block_id_flag=BlockIDFlag.ABSENT)
+
+    def absent(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.ABSENT
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.COMMIT
+
+    def vote_block_id(self, commit_block_id: BlockID) -> BlockID:
+        """The BlockID this signature signed over (reference block.go
+        CommitSig.BlockID): COMMIT → the commit's, NIL/ABSENT → zero."""
+        if self.block_id_flag == BlockIDFlag.COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    def validate_basic(self) -> None:
+        if self.block_id_flag not in (
+            BlockIDFlag.ABSENT,
+            BlockIDFlag.COMMIT,
+            BlockIDFlag.NIL,
+        ):
+            raise ValueError(f"unknown BlockIDFlag {self.block_id_flag}")
+        if self.absent():
+            if self.validator_address or self.signature:
+                raise ValueError("absent CommitSig must be empty")
+        else:
+            if len(self.validator_address) != 20:
+                raise ValueError("validator address must be 20 bytes")
+            if not self.signature or len(self.signature) > 64:
+                raise ValueError("signature missing or too big")
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, int(self.block_id_flag))
+            .bytes_(2, self.validator_address)
+            .message(3, encode_timestamp(self.timestamp_ns), always=True)
+            .bytes_(4, self.signature)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "CommitSig":
+        f = fields_to_dict(data)
+        ts = f.get(3, [None])[0]
+        return cls(
+            block_id_flag=BlockIDFlag(f.get(1, [1])[0]),
+            validator_address=f.get(2, [b""])[0],
+            timestamp_ns=decode_timestamp(ts) if ts is not None else GO_ZERO_TIME_NS,
+            signature=f.get(4, [b""])[0],
+        )
+
+
+@dataclass
+class Commit:
+    height: int
+    round: int
+    block_id: BlockID
+    signatures: list[CommitSig] = field(default_factory=list)
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        """Reconstruct validator idx's canonical precommit bytes
+        (reference block.go:815)."""
+        cs = self.signatures[idx]
+        return vote_sign_bytes_raw(
+            chain_id,
+            SignedMsgType.PRECOMMIT,
+            self.height,
+            self.round,
+            cs.vote_block_id(self.block_id),
+            cs.timestamp_ns,
+        )
+
+    def hash(self) -> bytes:
+        """Merkle root over proto-encoded CommitSigs (reference block.go
+        Commit.Hash)."""
+        return merkle.hash_from_byte_slices([cs.encode() for cs in self.signatures])
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def validate_basic(self) -> None:
+        from .vote_set import MAX_VOTES_COUNT
+
+        if self.height < 0:
+            raise ValueError("negative height")
+        if self.round < 0:
+            raise ValueError("negative round")
+        if len(self.signatures) > MAX_VOTES_COUNT:
+            raise ValueError(f"too many signatures: max {MAX_VOTES_COUNT}")
+        if self.height >= 1:
+            if self.block_id.is_zero():
+                raise ValueError("commit cannot be for nil block")
+            if not self.signatures:
+                raise ValueError("no signatures in commit")
+            for cs in self.signatures:
+                cs.validate_basic()
+
+    def encode(self) -> bytes:
+        w = (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .message(3, self.block_id.encode(), always=True)
+        )
+        for cs in self.signatures:
+            w.message(4, cs.encode(), always=True)
+        return w.bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Commit":
+        from tendermint_tpu.wire.proto import to_int64
+
+        f = fields_to_dict(data)
+        bid = f.get(3, [None])[0]
+        return cls(
+            height=to_int64(f.get(1, [0])[0]),
+            round=to_int64(f.get(2, [0])[0]),
+            block_id=BlockID.decode(bid) if bid is not None else BlockID(),
+            signatures=[CommitSig.decode(b) for b in f.get(4, [])],
+        )

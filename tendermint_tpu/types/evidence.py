@@ -1,0 +1,166 @@
+"""Evidence of byzantine behaviour.
+
+Parity: reference types/evidence.go (DuplicateVoteEvidence,
+LightClientAttackEvidence), wire form
+proto/tendermint/types/evidence.proto (oneof sum{1,2}).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .basic import GO_ZERO_TIME_NS, decode_timestamp, encode_timestamp
+from .vote import Vote
+
+
+@dataclass
+class DuplicateVoteEvidence:
+    vote_a: Vote
+    vote_b: Vote
+    total_voting_power: int = 0
+    validator_power: int = 0
+    timestamp_ns: int = GO_ZERO_TIME_NS
+
+    @classmethod
+    def from_votes(cls, vote1: Vote, vote2: Vote, block_time_ns: int, val_set) -> "DuplicateVoteEvidence":
+        """Orders votes lexically by BlockID key (reference
+        NewDuplicateVoteEvidence)."""
+        _, val = val_set.get_by_address(vote1.validator_address)
+        if val is None:
+            raise ValueError("validator not in set")
+        if vote1.block_id.key() <= vote2.block_id.key():
+            a, b = vote1, vote2
+        else:
+            a, b = vote2, vote1
+        return cls(
+            vote_a=a,
+            vote_b=b,
+            total_voting_power=val_set.total_voting_power(),
+            validator_power=val.voting_power,
+            timestamp_ns=block_time_ns,
+        )
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def hash(self) -> bytes:
+        return tmhash.sum_sha256(self.encode_inner())
+
+    def encode_inner(self) -> bytes:
+        return (
+            ProtoWriter()
+            .message(1, self.vote_a.encode())
+            .message(2, self.vote_b.encode())
+            .varint(3, self.total_voting_power)
+            .varint(4, self.validator_power)
+            .message(5, encode_timestamp(self.timestamp_ns), always=True)
+            .bytes_out()
+        )
+
+    def encode(self) -> bytes:
+        """Evidence{oneof sum: duplicate_vote_evidence=1}."""
+        return ProtoWriter().message(1, self.encode_inner(), always=True).bytes_out()
+
+    def validate_basic(self) -> None:
+        if self.vote_a is None or self.vote_b is None:
+            raise ValueError("missing votes")
+        self.vote_a.validate_basic()
+        self.vote_b.validate_basic()
+        if self.vote_a.block_id.key() > self.vote_b.block_id.key():
+            raise ValueError("duplicate votes in invalid order")
+
+
+@dataclass
+class LightClientAttackEvidence:
+    conflicting_block_bytes: bytes  # encoded LightBlock (opaque here)
+    common_height: int
+    byzantine_validators: list = field(default_factory=list)
+    total_voting_power: int = 0
+    timestamp_ns: int = GO_ZERO_TIME_NS
+    conflicting_header_hash: bytes = b""
+
+    def height(self) -> int:
+        return self.common_height
+
+    def hash(self) -> bytes:
+        """SHA-256 over zero-padded conflicting header hash (31 bytes kept,
+        replicating the reference's off-by-one) + zigzag-varint common
+        height (reference evidence.go:299-306)."""
+        from tendermint_tpu.wire.proto import encode_uvarint
+
+        zigzag = (self.common_height << 1) ^ (self.common_height >> 63)
+        buf = encode_uvarint(zigzag)
+        bz = bytearray(tmhash.SIZE + len(buf))
+        h31 = self.conflicting_header_hash[: tmhash.SIZE - 1]
+        bz[: len(h31)] = h31  # fixed-size zone stays zero-padded
+        bz[tmhash.SIZE :] = buf
+        return tmhash.sum_sha256(bytes(bz))
+
+    def encode_inner(self) -> bytes:
+        w = (
+            ProtoWriter()
+            .message(1, self.conflicting_block_bytes)
+            .varint(2, self.common_height)
+        )
+        for v in self.byzantine_validators:
+            w.message(3, v.encode(), always=True)
+        w.varint(4, self.total_voting_power)
+        w.message(5, encode_timestamp(self.timestamp_ns), always=True)
+        return w.bytes_out()
+
+    def encode(self) -> bytes:
+        return ProtoWriter().message(2, self.encode_inner(), always=True).bytes_out()
+
+    def validate_basic(self) -> None:
+        if self.common_height < 1:
+            raise ValueError("common height must be >= 1")
+
+
+def decode_evidence(data: bytes):
+    f = fields_to_dict(data)
+    if 1 in f:
+        inner = fields_to_dict(f[1][0])
+        ts = inner.get(5, [None])[0]
+        return DuplicateVoteEvidence(
+            vote_a=Vote.decode(inner.get(1, [b""])[0]),
+            vote_b=Vote.decode(inner.get(2, [b""])[0]),
+            total_voting_power=inner.get(3, [0])[0],
+            validator_power=inner.get(4, [0])[0],
+            timestamp_ns=decode_timestamp(ts) if ts is not None else GO_ZERO_TIME_NS,
+        )
+    if 2 in f:
+        from .validator import Validator
+
+        inner = fields_to_dict(f[2][0])
+        ts = inner.get(5, [None])[0]
+        lb_bytes = inner.get(1, [b""])[0]
+        return LightClientAttackEvidence(
+            conflicting_block_bytes=lb_bytes,
+            common_height=inner.get(2, [0])[0],
+            byzantine_validators=[Validator.decode(b) for b in inner.get(3, [])],
+            total_voting_power=inner.get(4, [0])[0],
+            timestamp_ns=decode_timestamp(ts) if ts is not None else GO_ZERO_TIME_NS,
+            conflicting_header_hash=_header_hash_from_light_block(lb_bytes),
+        )
+    raise ValueError("unknown evidence type")
+
+
+def _header_hash_from_light_block(lb_bytes: bytes) -> bytes:
+    """Derive the conflicting header's hash from the encoded LightBlock
+    (LightBlock{signed_header=1{header=1}}) so evidence hashes survive the
+    wire round trip."""
+    from .block import Header
+
+    try:
+        sh = fields_to_dict(lb_bytes).get(1, [None])[0]
+        if sh is None:
+            return b""
+        hdr = fields_to_dict(sh).get(1, [None])[0]
+        if hdr is None:
+            return b""
+        return Header.decode(hdr).hash() or b""
+    except (ValueError, KeyError):
+        return b""

@@ -1,0 +1,163 @@
+"""GenesisDoc — chain bootstrap document.
+
+Parity: reference types/genesis.go:38-46 (chain_id, initial_height,
+consensus params, validators, app_hash, app_state), JSON-persisted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto.keys import PubKey
+
+from .basic import now_ns
+from .params import ConsensusParams
+from .validator import Validator, ValidatorSet
+
+MAX_CHAIN_ID_LEN = 50
+
+
+@dataclass
+class GenesisValidator:
+    pub_key: PubKey
+    power: int
+    name: str = ""
+
+    @property
+    def address(self) -> bytes:
+        return self.pub_key.address()
+
+
+@dataclass
+class GenesisDoc:
+    chain_id: str
+    genesis_time_ns: int = field(default_factory=now_ns)
+    initial_height: int = 1
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    validators: list[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: bytes = b"{}"
+
+    def validate_and_complete(self) -> None:
+        if not self.chain_id:
+            raise ValueError("genesis doc must include non-empty chain_id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError(f"chain_id in genesis doc longer than {MAX_CHAIN_ID_LEN}")
+        if self.initial_height < 0:
+            raise ValueError("initial_height cannot be negative")
+        if self.initial_height == 0:
+            self.initial_height = 1
+        self.consensus_params.validate()
+        for v in self.validators:
+            if v.power < 0:
+                raise ValueError("genesis validator cannot have negative power")
+
+    def validator_set(self) -> ValidatorSet:
+        return ValidatorSet(
+            [Validator(pub_key=v.pub_key, voting_power=v.power) for v in self.validators]
+        )
+
+    # -- JSON persistence ---------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "genesis_time_ns": self.genesis_time_ns,
+                "chain_id": self.chain_id,
+                "initial_height": str(self.initial_height),
+                "consensus_params": {
+                    "block": {
+                        "max_bytes": str(self.consensus_params.block.max_bytes),
+                        "max_gas": str(self.consensus_params.block.max_gas),
+                    },
+                    "evidence": {
+                        "max_age_num_blocks": str(
+                            self.consensus_params.evidence.max_age_num_blocks
+                        ),
+                        "max_age_duration_ns": str(
+                            self.consensus_params.evidence.max_age_duration_ns
+                        ),
+                        "max_bytes": str(self.consensus_params.evidence.max_bytes),
+                    },
+                    "validator": {
+                        "pub_key_types": self.consensus_params.validator.pub_key_types
+                    },
+                    "version": {
+                        "app_version": str(self.consensus_params.version.app_version)
+                    },
+                },
+                "validators": [
+                    {
+                        "address": v.address.hex().upper(),
+                        "pub_key": {
+                            "type": "tendermint/PubKeyEd25519",
+                            "value": v.pub_key.bytes_().hex(),
+                        },
+                        "power": str(v.power),
+                        "name": v.name,
+                    }
+                    for v in self.validators
+                ],
+                "app_hash": self.app_hash.hex().upper(),
+                "app_state": json.loads(self.app_state.decode("utf-8") or "{}"),
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "GenesisDoc":
+        from .params import BlockParams, EvidenceParams, ValidatorParams, VersionParams
+
+        d = json.loads(raw)
+        cp = d.get("consensus_params", {})
+        params = ConsensusParams(
+            block=BlockParams(
+                max_bytes=int(cp.get("block", {}).get("max_bytes", 22020096)),
+                max_gas=int(cp.get("block", {}).get("max_gas", -1)),
+            ),
+            evidence=EvidenceParams(
+                max_age_num_blocks=int(
+                    cp.get("evidence", {}).get("max_age_num_blocks", 100000)
+                ),
+                max_age_duration_ns=int(
+                    cp.get("evidence", {}).get(
+                        "max_age_duration_ns", 48 * 3600 * 10**9
+                    )
+                ),
+                max_bytes=int(cp.get("evidence", {}).get("max_bytes", 1048576)),
+            ),
+            validator=ValidatorParams(
+                pub_key_types=list(
+                    cp.get("validator", {}).get("pub_key_types", ["ed25519"])
+                )
+            ),
+            version=VersionParams(
+                app_version=int(cp.get("version", {}).get("app_version", 0))
+            ),
+        )
+        doc = cls(
+            chain_id=d["chain_id"],
+            genesis_time_ns=int(d.get("genesis_time_ns", 0)),
+            initial_height=int(d.get("initial_height", 1)),
+            consensus_params=params,
+            validators=[
+                GenesisValidator(
+                    pub_key=PubKey(bytes.fromhex(v["pub_key"]["value"])),
+                    power=int(v["power"]),
+                    name=v.get("name", ""),
+                )
+                for v in d.get("validators", [])
+            ],
+            app_hash=bytes.fromhex(d.get("app_hash", "")),
+            app_state=json.dumps(d.get("app_state", {})).encode("utf-8"),
+        )
+        doc.validate_and_complete()
+        return doc
+
+    def doc_hash(self) -> bytes:
+        """SHA-256 of the serialized doc — pinned in the state DB so restarts
+        reject a changed genesis (reference node.go
+        LoadStateFromDBOrGenesisDocProvider)."""
+        return hashlib.sha256(self.to_json().encode("utf-8")).digest()

@@ -1,0 +1,174 @@
+"""ConsensusParams — protocol-level limits, hashed into the header.
+
+Parity: reference types/params.go (defaults :34-60, Hash :137-155 — SHA-256
+over HashedParams{block_max_bytes=1, block_max_gas=2}), wire form
+proto/tendermint/types/params.proto.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+MAX_BLOCK_SIZE_BYTES = 104857600  # 100MB hard cap
+ABCI_PUBKEY_TYPE_ED25519 = "ed25519"
+
+
+@dataclass
+class BlockParams:
+    max_bytes: int = 22020096  # 21MB default
+    max_gas: int = -1
+    time_iota_ms: int = 1  # unused, kept for wire parity
+
+    def validate(self) -> None:
+        if self.max_bytes <= 0 or self.max_bytes > MAX_BLOCK_SIZE_BYTES:
+            raise ValueError("block.max_bytes out of range")
+        if self.max_gas < -1:
+            raise ValueError("block.max_gas must be >= -1")
+
+
+@dataclass
+class EvidenceParams:
+    max_age_num_blocks: int = 100000
+    max_age_duration_ns: int = 48 * 3600 * 1_000_000_000
+    max_bytes: int = 1048576
+
+    def validate(self) -> None:
+        if self.max_age_num_blocks <= 0:
+            raise ValueError("evidence.max_age_num_blocks must be positive")
+        if self.max_age_duration_ns <= 0:
+            raise ValueError("evidence.max_age_duration must be positive")
+
+
+@dataclass
+class ValidatorParams:
+    pub_key_types: list[str] = field(default_factory=lambda: [ABCI_PUBKEY_TYPE_ED25519])
+
+    def validate(self) -> None:
+        if not self.pub_key_types:
+            raise ValueError("validator.pub_key_types must not be empty")
+
+
+@dataclass
+class VersionParams:
+    app_version: int = 0
+
+
+@dataclass
+class ConsensusParams:
+    block: BlockParams = field(default_factory=BlockParams)
+    evidence: EvidenceParams = field(default_factory=EvidenceParams)
+    validator: ValidatorParams = field(default_factory=ValidatorParams)
+    version: VersionParams = field(default_factory=VersionParams)
+
+    def hash(self) -> bytes:
+        hp = ProtoWriter().varint(1, self.block.max_bytes).varint(2, self.block.max_gas)
+        return tmhash.sum_sha256(hp.bytes_out())
+
+    def validate(self) -> None:
+        self.block.validate()
+        self.evidence.validate()
+        self.validator.validate()
+
+    def update(self, updates: "ConsensusParamsUpdate | None") -> "ConsensusParams":
+        """Apply non-None ABCI EndBlock updates, returning a new params
+        value (reference params.go Update)."""
+        if updates is None:
+            return self
+        res = ConsensusParams(
+            block=replace(self.block),
+            evidence=replace(self.evidence),
+            validator=ValidatorParams(list(self.validator.pub_key_types)),
+            version=replace(self.version),
+        )
+        if updates.block is not None:
+            res.block = replace(updates.block)
+        if updates.evidence is not None:
+            res.evidence = replace(updates.evidence)
+        if updates.validator is not None:
+            res.validator = ValidatorParams(list(updates.validator.pub_key_types))
+        if updates.version is not None:
+            res.version = replace(updates.version)
+        return res
+
+    # -- wire ---------------------------------------------------------
+    def encode(self) -> bytes:
+        b = (
+            ProtoWriter()
+            .varint(1, self.block.max_bytes)
+            .varint(2, self.block.max_gas)
+            .varint(3, self.block.time_iota_ms)
+            .bytes_out()
+        )
+        e = (
+            ProtoWriter()
+            .varint(1, self.evidence.max_age_num_blocks)
+            .message(2, _encode_duration(self.evidence.max_age_duration_ns), always=True)
+            .varint(3, self.evidence.max_bytes)
+            .bytes_out()
+        )
+        v = ProtoWriter()
+        for t in self.validator.pub_key_types:
+            v.string(1, t)
+        ver = ProtoWriter().varint(1, self.version.app_version).bytes_out()
+        return (
+            ProtoWriter()
+            .message(1, b, always=True)
+            .message(2, e, always=True)
+            .message(3, v.bytes_out(), always=True)
+            .message(4, ver, always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ConsensusParams":
+        f = fields_to_dict(data)
+        bp = fields_to_dict(f.get(1, [b""])[0])
+        ep = fields_to_dict(f.get(2, [b""])[0])
+        vp = fields_to_dict(f.get(3, [b""])[0])
+        verp = fields_to_dict(f.get(4, [b""])[0])
+        mg = bp.get(2, [0])[0]
+        if mg >= 1 << 63:
+            mg -= 1 << 64
+        return cls(
+            block=BlockParams(
+                max_bytes=bp.get(1, [0])[0],
+                max_gas=mg,
+                time_iota_ms=bp.get(3, [0])[0],
+            ),
+            evidence=EvidenceParams(
+                max_age_num_blocks=ep.get(1, [0])[0],
+                max_age_duration_ns=_decode_duration(ep.get(2, [b""])[0]),
+                max_bytes=ep.get(3, [0])[0],
+            ),
+            validator=ValidatorParams(
+                pub_key_types=[t.decode("utf-8") for t in vp.get(1, [])]
+            ),
+            version=VersionParams(app_version=verp.get(1, [0])[0]),
+        )
+
+
+@dataclass
+class ConsensusParamsUpdate:
+    block: BlockParams | None = None
+    evidence: EvidenceParams | None = None
+    validator: ValidatorParams | None = None
+    version: VersionParams | None = None
+
+
+def _encode_duration(ns: int) -> bytes:
+    seconds, nanos = divmod(ns, 1_000_000_000)
+    return ProtoWriter().varint(1, seconds).varint(2, nanos).bytes_out()
+
+
+def _decode_duration(data: bytes) -> int:
+    f = fields_to_dict(data)
+    s = f.get(1, [0])[0]
+    if s >= 1 << 63:
+        s -= 1 << 64
+    return s * 1_000_000_000 + f.get(2, [0])[0]
+
+
+DEFAULT_CONSENSUS_PARAMS = ConsensusParams()

@@ -1,0 +1,131 @@
+"""PartSet: a block chopped into merkle-proven 64KB parts for gossip.
+
+Parity: reference types/part_set.go:23-375 (Part{index,bytes,proof},
+BlockPartSizeBytes = 65536 in types/params.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .basic import PartSetHeader
+
+BLOCK_PART_SIZE_BYTES = 65536
+
+
+@dataclass
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.Proof
+
+    def validate_basic(self) -> None:
+        if self.index < 0:
+            raise ValueError("negative part index")
+        if len(self.bytes_) > BLOCK_PART_SIZE_BYTES:
+            raise ValueError("part too big")
+
+    def encode(self) -> bytes:
+        proof = (
+            ProtoWriter()
+            .varint(1, self.proof.total)
+            .varint(2, self.proof.index)
+            .bytes_(3, self.proof.leaf_hash)
+            .repeated_bytes(4, self.proof.aunts)
+            .bytes_out()
+        )
+        return (
+            ProtoWriter()
+            .varint(1, self.index)
+            .bytes_(2, self.bytes_)
+            .message(3, proof, always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Part":
+        f = fields_to_dict(data)
+        pf = fields_to_dict(f.get(3, [b""])[0])
+        proof = merkle.Proof(
+            total=pf.get(1, [0])[0],
+            index=pf.get(2, [0])[0],
+            leaf_hash=pf.get(3, [b""])[0],
+            aunts=list(pf.get(4, [])),
+        )
+        return cls(index=f.get(1, [0])[0], bytes_=f.get(2, [b""])[0], proof=proof)
+
+
+class PartSet:
+    """Either built complete from bytes (proposer side) or accumulated part
+    by part against a PartSetHeader (gossip receiver side)."""
+
+    def __init__(self, header: PartSetHeader):
+        self._header = header
+        self._parts: list[Part | None] = [None] * header.total
+        self._count = 0
+        self._byte_size = 0
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE_BYTES) -> "PartSet":
+        chunks = [data[i : i + part_size] for i in range(0, len(data), part_size)] or [b""]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = cls(PartSetHeader(total=len(chunks), hash=root))
+        for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
+            ps._parts[i] = Part(index=i, bytes_=chunk, proof=proof)
+        ps._count = len(chunks)
+        ps._byte_size = len(data)
+        return ps
+
+    def header(self) -> PartSetHeader:
+        return self._header
+
+    def has_header(self, header: PartSetHeader) -> bool:
+        return self._header == header
+
+    @property
+    def total(self) -> int:
+        return self._header.total
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    def is_complete(self) -> bool:
+        return self._count == self._header.total
+
+    def bit_array(self) -> list[bool]:
+        return [p is not None for p in self._parts]
+
+    def get_part(self, index: int) -> Part | None:
+        if 0 <= index < len(self._parts):
+            return self._parts[index]
+        return None
+
+    def add_part(self, part: Part) -> bool:
+        """Verify the part's merkle proof against the header hash and store.
+        Returns False if duplicate; raises on invalid proof/index."""
+        part.validate_basic()
+        if part.index >= self._header.total:
+            raise ValueError("part index out of bounds")
+        if self._parts[part.index] is not None:
+            return False
+        if part.proof.total != self._header.total or part.proof.index != part.index:
+            raise ValueError("part proof shape mismatch")
+        if not part.proof.verify(self._header.hash, part.bytes_):
+            raise ValueError("invalid part proof")
+        self._parts[part.index] = part
+        self._count += 1
+        self._byte_size += len(part.bytes_)
+        return True
+
+    def assemble(self) -> bytes:
+        if not self.is_complete():
+            raise ValueError("part set incomplete")
+        return b"".join(p.bytes_ for p in self._parts)  # type: ignore[union-attr]

@@ -1,0 +1,88 @@
+"""Proposal: the proposer's signed block proposal for a round.
+
+Parity: reference types/proposal.go (sign-bytes via CanonicalProposal),
+wire form types.proto Proposal{1..7}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto.keys import PubKey
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .basic import (
+    BlockID,
+    GO_ZERO_TIME_NS,
+    SignedMsgType,
+    decode_timestamp,
+    encode_timestamp,
+)
+from .canonical import proposal_sign_bytes_raw
+
+
+@dataclass
+class Proposal:
+    height: int
+    round: int
+    pol_round: int  # -1 if no proof-of-lock round
+    block_id: BlockID
+    timestamp_ns: int = GO_ZERO_TIME_NS
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return proposal_sign_bytes_raw(
+            chain_id, self.height, self.round, self.pol_round, self.block_id, self.timestamp_ns
+        )
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> bool:
+        return pub_key.verify_signature(self.sign_bytes(chain_id), self.signature)
+
+    def validate_basic(self) -> None:
+        if self.height < 0:
+            raise ValueError("negative height")
+        if self.round < 0:
+            raise ValueError("negative round")
+        if self.pol_round < -1 or self.pol_round >= self.round:
+            raise ValueError("POLRound must be -1 or in [0, round)")
+        self.block_id.validate_basic()
+        if not self.block_id.is_complete():
+            raise ValueError("proposal blockID must be complete")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > 64:
+            raise ValueError("signature too big")
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, int(SignedMsgType.PROPOSAL))
+            .varint(2, self.height)
+            .varint(3, self.round)
+            .varint(4, self.pol_round)
+            .message(5, self.block_id.encode(), always=True)
+            .message(6, encode_timestamp(self.timestamp_ns), always=True)
+            .bytes_(7, self.signature)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Proposal":
+        f = fields_to_dict(data)
+
+        def get(n, default):
+            return f.get(n, [default])[0]
+
+        bid = get(5, None)
+        ts = get(6, None)
+        pol = get(4, 0)
+        if pol >= 1 << 63:
+            pol -= 1 << 64
+        return cls(
+            height=get(2, 0),
+            round=get(3, 0),
+            pol_round=pol,
+            block_id=BlockID.decode(bid) if bid is not None else BlockID(),
+            timestamp_ns=decode_timestamp(ts) if ts is not None else GO_ZERO_TIME_NS,
+            signature=get(7, b""),
+        )

@@ -1,0 +1,122 @@
+"""Vote: the signed consensus message (prevote/precommit).
+
+Parity: reference types/vote.go (sign-bytes :93-101, Verify :147-156),
+wire form proto/tendermint/types/types.proto Vote{1..8}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto.keys import PubKey
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .basic import (
+    BlockID,
+    BlockIDFlag,
+    GO_ZERO_TIME_NS,
+    SignedMsgType,
+    decode_timestamp,
+    encode_timestamp,
+)
+from .canonical import vote_sign_bytes_raw
+
+MAX_VOTE_BYTES = 223  # reference types/vote.go MaxVoteBytes
+
+
+@dataclass
+class Vote:
+    type: SignedMsgType
+    height: int
+    round: int
+    block_id: BlockID
+    timestamp_ns: int = GO_ZERO_TIME_NS
+    validator_address: bytes = b""
+    validator_index: int = -1
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return vote_sign_bytes_raw(
+            chain_id, self.type, self.height, self.round, self.block_id, self.timestamp_ns
+        )
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        """Address check + signature check (reference vote.go:147-156)."""
+        if pub_key.address() != self.validator_address:
+            raise ValueError("invalid validator address")
+        if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
+            raise ValueError("invalid signature")
+
+    def is_nil(self) -> bool:
+        return self.block_id.is_zero()
+
+    def commit_sig(self):
+        """Convert to CommitSig (reference block.go CommitSig/NewCommitSigForBlock)."""
+        from .commit import CommitSig
+
+        if self.block_id.is_zero():
+            flag = BlockIDFlag.NIL
+        else:
+            flag = BlockIDFlag.COMMIT
+        return CommitSig(
+            block_id_flag=flag,
+            validator_address=self.validator_address,
+            timestamp_ns=self.timestamp_ns,
+            signature=self.signature,
+        )
+
+    def validate_basic(self) -> None:
+        if self.type not in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):
+            raise ValueError("invalid vote type")
+        if self.height < 0:
+            raise ValueError("negative height")
+        if self.round < 0:
+            raise ValueError("negative round")
+        self.block_id.validate_basic()
+        if not self.block_id.is_zero() and not self.block_id.is_complete():
+            raise ValueError("blockID must be either empty or complete")
+        if len(self.validator_address) != 20:
+            raise ValueError("validator address must be 20 bytes")
+        if self.validator_index < 0:
+            raise ValueError("negative validator index")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > 64:
+            raise ValueError("signature too big")
+
+    # -- wire (gossip) encoding ---------------------------------------
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, int(self.type))
+            .varint(2, self.height)
+            .varint(3, self.round)
+            .message(4, self.block_id.encode(), always=True)
+            .message(5, encode_timestamp(self.timestamp_ns), always=True)
+            .bytes_(6, self.validator_address)
+            .varint(7, self.validator_index)
+            .bytes_(8, self.signature)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Vote":
+        from tendermint_tpu.wire.proto import to_int64
+
+        f = fields_to_dict(data)
+
+        def get(n, default):
+            return f.get(n, [default])[0]
+
+        bid = get(4, None)
+        ts = get(5, None)
+        return cls(
+            type=SignedMsgType(get(1, 0)),
+            height=to_int64(get(2, 0)),
+            round=to_int64(get(3, 0)),
+            block_id=BlockID.decode(bid) if bid is not None else BlockID(),
+            timestamp_ns=decode_timestamp(ts) if ts is not None else GO_ZERO_TIME_NS,
+            validator_address=get(6, b""),
+            validator_index=to_int64(get(7, 0)),
+            signature=get(8, b""),
+        )

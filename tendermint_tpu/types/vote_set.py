@@ -1,0 +1,299 @@
+"""VoteSet: per-(height, round, type) signature collector with 2/3 tracking.
+
+Parity: reference types/vote_set.go:78-655 — one canonical vote per
+validator, conflict tracking by block, peer-claimed-majority admission
+(SetPeerMaj23 :309), quorum promotion (:391), MakeCommit (:578).
+
+North-star redesign: the reference verifies one signature inline per
+addVote (:203).  Here `add_votes` pre-verifies a whole slice of votes —
+everything a gossip scheduler tick delivered — as ONE BatchVerifier device
+call, then applies the identical admission state machine with signatures
+already checked.  `add_vote` is the single-vote convenience wrapper.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto import new_batch_verifier
+
+from .basic import BlockID, SignedMsgType
+from .commit import Commit, CommitSig
+from .validator import ValidatorSet
+from .vote import Vote
+
+MAX_VOTES_COUNT = 10000  # DoS bound (reference vote_set.go:18)
+
+
+class ConflictingVoteError(Exception):
+    def __init__(self, vote_a: Vote, vote_b: Vote):
+        super().__init__(f"conflicting votes from validator {vote_a.validator_address.hex()}")
+        self.vote_a = vote_a
+        self.vote_b = vote_b
+
+
+class _BlockVotes:
+    __slots__ = ("peer_maj23", "votes", "sum")
+
+    def __init__(self, peer_maj23: bool, num_validators: int):
+        self.peer_maj23 = peer_maj23
+        self.votes: list[Vote | None] = [None] * num_validators
+        self.sum = 0
+
+    def add(self, vote: Vote, power: int) -> None:
+        if self.votes[vote.validator_index] is None:
+            self.votes[vote.validator_index] = vote
+            self.sum += power
+
+    def get(self, idx: int) -> Vote | None:
+        return self.votes[idx]
+
+
+class VoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round_: int,
+        signed_msg_type: SignedMsgType,
+        val_set: ValidatorSet,
+    ):
+        if height == 0:
+            raise ValueError("cannot make VoteSet for height 0")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.signed_msg_type = signed_msg_type
+        self.val_set = val_set
+        self.votes: list[Vote | None] = [None] * val_set.size()
+        self.sum = 0
+        self.maj23: BlockID | None = None
+        self.votes_by_block: dict[tuple, _BlockVotes] = {}
+        self.peer_maj23s: dict[str, BlockID] = {}
+
+    # -- admission ----------------------------------------------------
+    def add_vote(self, vote: Vote) -> bool:
+        """Validate + verify one vote and admit it.  Returns True if the
+        vote was newly added; False for duplicates.  Raises
+        ConflictingVoteError (carrying both votes) for equivocation and
+        ValueError for everything else."""
+        self._validate(vote)
+        if self._known_duplicate(vote):
+            return False
+        val = self.val_set.get_by_index(vote.validator_index)
+        vote.verify(self.chain_id, val.pub_key)
+        return self._add_verified(vote, val.voting_power)
+
+    def add_votes(self, votes: list[Vote]) -> list[bool | Exception]:
+        """Admit a slice of votes with ONE batched signature verification.
+
+        Per-vote outcome: True (added), False (duplicate), or the exception
+        that vote raised (invalid sig, conflict, ...).  State mutation is
+        in input order, matching a sequential add_vote loop."""
+        outcomes: list[bool | Exception] = [None] * len(votes)  # type: ignore[list-item]
+        to_verify: list[int] = []
+        bv = new_batch_verifier()
+        for i, vote in enumerate(votes):
+            try:
+                self._validate(vote)
+            except ValueError as e:
+                outcomes[i] = e
+                continue
+            val = self.val_set.get_by_index(vote.validator_index)
+            bv.add(val.pub_key, vote.sign_bytes(self.chain_id), vote.signature)
+            to_verify.append(i)
+        _, oks = bv.verify()
+        for ok, i in zip(oks, to_verify):
+            vote = votes[i]
+            if not ok:
+                outcomes[i] = ValueError(f"invalid signature from index {vote.validator_index}")
+                continue
+            # duplicates re-checked *after* earlier votes in this slice mutate
+            if self._known_duplicate_or_raise(vote, outcomes, i):
+                continue
+            val = self.val_set.get_by_index(vote.validator_index)
+            try:
+                outcomes[i] = self._add_verified(vote, val.voting_power)
+            except ConflictingVoteError as e:
+                outcomes[i] = e
+        return outcomes
+
+    def _known_duplicate_or_raise(self, vote, outcomes, i) -> bool:
+        try:
+            if self._known_duplicate(vote):
+                outcomes[i] = False
+                return True
+        except ValueError as e:
+            outcomes[i] = e
+            return True
+        return False
+
+    def _validate(self, vote: Vote) -> None:
+        if vote is None:
+            raise ValueError("nil vote")
+        if vote.validator_index < 0:
+            raise ValueError("validator index < 0")
+        if not vote.validator_address:
+            raise ValueError("empty validator address")
+        if (
+            vote.height != self.height
+            or vote.round != self.round
+            or vote.type != self.signed_msg_type
+        ):
+            raise ValueError(
+                f"expected {self.height}/{self.round}/{self.signed_msg_type}, got "
+                f"{vote.height}/{vote.round}/{vote.type}"
+            )
+        val = self.val_set.get_by_index(vote.validator_index)
+        if val is None:
+            raise ValueError(f"validator index {vote.validator_index} out of range")
+        if val.address != vote.validator_address:
+            raise ValueError("validator address does not match index")
+
+    def _known_duplicate(self, vote: Vote) -> bool:
+        """True if we already have this exact vote; raises on a same-block
+        vote with a different signature (non-deterministic signing)."""
+        existing = self._get_vote(vote.validator_index, vote.block_id.key())
+        if existing is not None:
+            if existing.signature == vote.signature:
+                return True
+            raise ValueError("same block vote with non-deterministic signature")
+        return False
+
+    def _get_vote(self, val_index: int, block_key: tuple) -> Vote | None:
+        existing = self.votes[val_index]
+        if existing is not None and existing.block_id.key() == block_key:
+            return existing
+        bv = self.votes_by_block.get(block_key)
+        if bv is not None:
+            return bv.get(val_index)
+        return None
+
+    def _add_verified(self, vote: Vote, power: int) -> bool:
+        """The reference's addVerifiedVote admission machine (:232-300)."""
+        val_index = vote.validator_index
+        block_key = vote.block_id.key()
+        conflicting: Vote | None = None
+
+        existing = self.votes[val_index]
+        if existing is not None:
+            conflicting = existing
+            if self.maj23 is not None and self.maj23.key() == block_key:
+                self.votes[val_index] = vote
+        else:
+            self.votes[val_index] = vote
+            self.sum += power
+
+        bvotes = self.votes_by_block.get(block_key)
+        if bvotes is not None:
+            if conflicting is not None and not bvotes.peer_maj23:
+                raise ConflictingVoteError(conflicting, vote)
+        else:
+            if conflicting is not None:
+                raise ConflictingVoteError(conflicting, vote)
+            bvotes = _BlockVotes(False, self.val_set.size())
+            self.votes_by_block[block_key] = bvotes
+
+        orig_sum = bvotes.sum
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        bvotes.add(vote, power)
+        if orig_sum < quorum <= bvotes.sum and self.maj23 is None:
+            self.maj23 = vote.block_id
+            for i, v in enumerate(bvotes.votes):
+                if v is not None:
+                    self.votes[i] = v
+        if conflicting is not None:
+            raise ConflictingVoteError(conflicting, vote)
+        return True
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """Track a peer's claimed 2/3 majority; enables admitting
+        conflicting votes for that block (reference :309)."""
+        block_key = block_id.key()
+        existing = self.peer_maj23s.get(peer_id)
+        if existing is not None:
+            if existing == block_id:
+                return
+            raise ValueError(f"conflicting maj23 claim from peer {peer_id}")
+        self.peer_maj23s[peer_id] = block_id
+        bvotes = self.votes_by_block.get(block_key)
+        if bvotes is not None:
+            bvotes.peer_maj23 = True
+        else:
+            self.votes_by_block[block_key] = _BlockVotes(True, self.val_set.size())
+
+    # -- queries ------------------------------------------------------
+    def get_by_index(self, idx: int) -> Vote | None:
+        return self.votes[idx]
+
+    def bit_array(self) -> list[bool]:
+        return [v is not None for v in self.votes]
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> list[bool] | None:
+        bv = self.votes_by_block.get(block_id.key())
+        if bv is None:
+            return None
+        return [v is not None for v in bv.votes]
+
+    def two_thirds_majority(self) -> BlockID | None:
+        return self.maj23
+
+    def has_two_thirds_majority(self) -> bool:
+        return self.maj23 is not None
+
+    def has_two_thirds_any(self) -> bool:
+        return self.sum > self.val_set.total_voting_power() * 2 // 3
+
+    def has_all(self) -> bool:
+        return self.sum == self.val_set.total_voting_power()
+
+    # -- commit construction ------------------------------------------
+    def make_commit(self) -> Commit:
+        """Reference MakeCommit (:578): requires precommit maj23; votes for
+        other blocks become absent sigs."""
+        if self.signed_msg_type != SignedMsgType.PRECOMMIT:
+            raise ValueError("cannot MakeCommit() unless VoteSet is for precommits")
+        if self.maj23 is None:
+            raise ValueError("cannot MakeCommit() unless +2/3 has voted")
+        sigs = []
+        for v in self.votes:
+            if v is None:
+                sigs.append(CommitSig.absent_sig())
+                continue
+            cs = v.commit_sig()
+            if cs.for_block() and v.block_id != self.maj23:
+                cs = CommitSig.absent_sig()
+            sigs.append(cs)
+        return Commit(
+            height=self.height, round=self.round, block_id=self.maj23, signatures=sigs
+        )
+
+
+def commit_to_vote_set(chain_id: str, commit: Commit, val_set: ValidatorSet) -> VoteSet:
+    """Rebuild a precommit VoteSet from a Commit — restart path (reference
+    types/block.go:775, consensus/state.go:548).  All signatures are
+    verified in one batch device call via add_votes."""
+    vs = VoteSet(chain_id, commit.height, commit.round, SignedMsgType.PRECOMMIT, val_set)
+    votes = []
+    for idx, cs in enumerate(commit.signatures):
+        if cs.absent():
+            continue
+        votes.append(
+            Vote(
+                type=SignedMsgType.PRECOMMIT,
+                height=commit.height,
+                round=commit.round,
+                block_id=cs.vote_block_id(commit.block_id),
+                timestamp_ns=cs.timestamp_ns,
+                validator_address=cs.validator_address,
+                validator_index=idx,
+                signature=cs.signature,
+            )
+        )
+    outcomes = vs.add_votes(votes)
+    for out in outcomes:
+        if isinstance(out, Exception):
+            raise ValueError(f"failed to reconstruct vote set: {out}") from out
+        if out is not True:
+            raise ValueError("duplicate vote while reconstructing vote set")
+    return vs

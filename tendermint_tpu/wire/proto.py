@@ -198,6 +198,12 @@ def parse_message(data: bytes) -> list[tuple[int, int, object]]:
     return fields
 
 
+def to_int64(v: int) -> int:
+    """Sign-extend a decoded varint to int64 (protobuf int32/int64 fields
+    encode negatives as 64-bit two's complement)."""
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
 def fields_to_dict(data: bytes) -> dict[int, list[object]]:
     out: dict[int, list[object]] = {}
     for field, _wt, v in parse_message(data):

@@ -1,0 +1,459 @@
+"""Types-layer tests: sign-bytes conformance, proposer rotation properties,
+commit verification (batched), vote set admission, block/part-set round trips."""
+
+import pytest
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.keys import gen_priv_key, priv_key_from_seed
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    BlockIDFlag,
+    Commit,
+    CommitSig,
+    ConflictingVoteError,
+    Data,
+    GO_ZERO_TIME_NS,
+    GenesisDoc,
+    GenesisValidator,
+    Header,
+    PartSetHeader,
+    PartSet,
+    SignedMsgType,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+    commit_to_vote_set,
+    vote_sign_bytes_raw,
+)
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    # types-layer tests use the sequential CPU verifier (fast at these sizes;
+    # the JAX backend is covered by test_ed25519_jax.py)
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def make_val_set(n, power=10):
+    keys = [priv_key_from_seed(bytes([7 * i + 1]) * 32) for i in range(n)]
+    vals = [Validator(pub_key=k.pub_key(), voting_power=power) for k in keys]
+    vs = ValidatorSet(vals)
+    key_by_addr = {k.pub_key().address(): k for k in keys}
+    ordered = [key_by_addr[v.address] for v in vs.validators]
+    return vs, ordered
+
+
+def make_block_id(seed=b"blk"):
+    return BlockID(
+        hash=tmhash.sum_sha256(seed),
+        part_set_header=PartSetHeader(total=1, hash=tmhash.sum_sha256(seed + b"ps")),
+    )
+
+
+def make_commit(chain_id, height, round_, block_id, vs, keys, absent=(), nil=()):
+    sigs = []
+    for i, k in enumerate(keys):
+        if i in absent:
+            sigs.append(CommitSig.absent_sig())
+            continue
+        bid = BlockID() if i in nil else block_id
+        ts = GO_ZERO_TIME_NS + 1_000_000_000 * (height * 100 + i)
+        sb = vote_sign_bytes_raw(chain_id, SignedMsgType.PRECOMMIT, height, round_, bid, ts)
+        sigs.append(
+            CommitSig(
+                block_id_flag=BlockIDFlag.NIL if i in nil else BlockIDFlag.COMMIT,
+                validator_address=k.pub_key().address(),
+                timestamp_ns=ts,
+                signature=k.sign(sb),
+            )
+        )
+    return Commit(height=height, round=round_, block_id=block_id, signatures=sigs)
+
+
+# ---------------------------------------------------------------------------
+# sign-bytes conformance (reference types/vote_test.go TestVoteSignBytesTestVectors)
+# ---------------------------------------------------------------------------
+
+def test_vote_sign_bytes_reference_vectors():
+    cases = [
+        (
+            ("", SignedMsgType.UNKNOWN, 0, 0, BlockID(), GO_ZERO_TIME_NS),
+            bytes([0xD, 0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]),
+        ),
+        (
+            ("", SignedMsgType.PRECOMMIT, 1, 1, BlockID(), GO_ZERO_TIME_NS),
+            bytes(
+                [0x21, 0x8, 0x2, 0x11, 1, 0, 0, 0, 0, 0, 0, 0, 0x19, 1, 0, 0, 0, 0, 0, 0, 0]
+                + [0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+            ),
+        ),
+    ]
+    for args, want in cases:
+        assert vote_sign_bytes_raw(*args) == want
+
+
+def test_vote_sign_verify():
+    pk = gen_priv_key()
+    vote = Vote(
+        type=SignedMsgType.PREVOTE,
+        height=5,
+        round=0,
+        block_id=make_block_id(),
+        timestamp_ns=1_700_000_000 * 10**9,
+        validator_address=pk.pub_key().address(),
+        validator_index=0,
+    )
+    vote.signature = pk.sign(vote.sign_bytes("test-chain"))
+    vote.verify("test-chain", pk.pub_key())  # no raise
+    with pytest.raises(ValueError):
+        vote.verify("other-chain", pk.pub_key())
+    with pytest.raises(ValueError):
+        vote.verify("test-chain", gen_priv_key().pub_key())
+
+
+# ---------------------------------------------------------------------------
+# ValidatorSet
+# ---------------------------------------------------------------------------
+
+def test_proposer_rotation_equal_power_round_robin():
+    vs, _ = make_val_set(4)
+    seen = []
+    cur = vs.copy()
+    for _ in range(8):
+        seen.append(cur.get_proposer().address)
+        cur.increment_proposer_priority(1)
+    # equal power: every validator proposes exactly twice in 8 rounds
+    assert len(set(seen[:4])) == 4
+    assert seen[:4] == seen[4:]
+
+
+def test_proposer_rotation_weighted():
+    k1, k2 = priv_key_from_seed(b"\x01" * 32), priv_key_from_seed(b"\x02" * 32)
+    vs = ValidatorSet(
+        [
+            Validator(pub_key=k1.pub_key(), voting_power=3),
+            Validator(pub_key=k2.pub_key(), voting_power=1),
+        ]
+    )
+    counts = {k1.pub_key().address(): 0, k2.pub_key().address(): 0}
+    cur = vs.copy()
+    for _ in range(40):
+        counts[cur.get_proposer().address] += 1
+        cur.increment_proposer_priority(1)
+    assert counts[k1.pub_key().address()] == 30
+    assert counts[k2.pub_key().address()] == 10
+
+
+def test_validator_set_hash_changes_with_membership():
+    vs1, _ = make_val_set(3)
+    vs2, _ = make_val_set(4)
+    assert vs1.hash() != vs2.hash()
+    assert vs1.hash() == vs1.copy().hash()
+
+
+def test_update_with_change_set():
+    vs, keys = make_val_set(3)
+    newk = gen_priv_key()
+    vs2 = vs.copy()
+    vs2.update_with_change_set(
+        [
+            Validator(pub_key=newk.pub_key(), voting_power=5),
+            Validator(pub_key=keys[0].pub_key(), voting_power=0),  # removal
+        ]
+    )
+    assert vs2.size() == 3
+    assert vs2.has_address(newk.pub_key().address())
+    assert not vs2.has_address(keys[0].pub_key().address())
+    assert vs2.total_voting_power() == 25
+
+
+# ---------------------------------------------------------------------------
+# Commit verification (batched surface)
+# ---------------------------------------------------------------------------
+
+def test_verify_commit_all_good():
+    vs, keys = make_val_set(7)
+    bid = make_block_id()
+    commit = make_commit("c1", 10, 0, bid, vs, keys)
+    vs.verify_commit("c1", bid, 10, commit)
+    vs.verify_commit_light("c1", bid, 10, commit)
+
+
+def test_verify_commit_insufficient_power():
+    vs, keys = make_val_set(7)
+    bid = make_block_id()
+    # 4 of 7 absent: 3*10=30 <= (70*2//3)=46
+    commit = make_commit("c1", 10, 0, bid, vs, keys, absent={0, 1, 2, 3})
+    with pytest.raises(ValueError, match="insufficient"):
+        vs.verify_commit("c1", bid, 10, commit)
+
+
+def test_verify_commit_bad_sig_rejected():
+    vs, keys = make_val_set(4)
+    bid = make_block_id()
+    commit = make_commit("c1", 10, 0, bid, vs, keys)
+    commit.signatures[2].signature = bytes(64)
+    with pytest.raises(ValueError, match="wrong signature"):
+        vs.verify_commit("c1", bid, 10, commit)
+
+
+def test_verify_commit_light_ignores_invalid_after_cutoff():
+    """Reference semantics: VerifyCommitLight never looks past the +2/3
+    cutoff, so a bad signature positioned after it must not reject."""
+    vs, keys = make_val_set(4, power=10)
+    bid = make_block_id()
+    commit = make_commit("c1", 10, 0, bid, vs, keys)
+    commit.signatures[3].signature = bytes(64)  # needed: >26 → first 3 suffice
+    vs.verify_commit_light("c1", bid, 10, commit)
+    with pytest.raises(ValueError):
+        vs.verify_commit("c1", bid, 10, commit)  # full verify still rejects
+
+
+def test_verify_commit_light_trusting():
+    from fractions import Fraction
+
+    vs, keys = make_val_set(6)
+    bid = make_block_id()
+    commit = make_commit("trusted", 4, 0, bid, vs, keys)
+    vs.verify_commit_light_trusting("trusted", commit, Fraction(1, 3))
+    # a disjoint validator set can't reach the trust level
+    other, _ = make_val_set(6, power=7)
+    assert other.hash() != vs.hash()
+
+
+def test_verify_commit_nil_votes_counted_as_present_but_not_tallied():
+    vs, keys = make_val_set(4)
+    bid = make_block_id()
+    commit = make_commit("c1", 10, 0, bid, vs, keys, nil={3})
+    vs.verify_commit("c1", bid, 10, commit)  # 30 > 26 still holds
+
+
+# ---------------------------------------------------------------------------
+# VoteSet
+# ---------------------------------------------------------------------------
+
+def make_vote(chain_id, key, idx, height, round_, bid, type_=SignedMsgType.PREVOTE):
+    v = Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=bid,
+        timestamp_ns=GO_ZERO_TIME_NS + idx + 1,
+        validator_address=key.pub_key().address(),
+        validator_index=idx,
+    )
+    v.signature = key.sign(v.sign_bytes(chain_id))
+    return v
+
+
+def test_vote_set_majority_and_commit():
+    vs, keys = make_val_set(4)
+    bid = make_block_id()
+    vset = VoteSet("vs-chain", 3, 0, SignedMsgType.PRECOMMIT, vs)
+    votes = [
+        make_vote("vs-chain", k, i, 3, 0, bid, SignedMsgType.PRECOMMIT)
+        for i, k in enumerate(keys[:3])
+    ]
+    outcomes = vset.add_votes(votes)
+    assert outcomes == [True, True, True]
+    assert vset.two_thirds_majority() == bid
+    commit = vset.make_commit()
+    assert commit.block_id == bid
+    assert sum(1 for s in commit.signatures if not s.absent()) == 3
+    vs.verify_commit_light("vs-chain", bid, 3, commit)
+
+
+def test_vote_set_batched_add_with_bad_sig():
+    vs, keys = make_val_set(4)
+    bid = make_block_id()
+    vset = VoteSet("vs-chain", 3, 0, SignedMsgType.PREVOTE, vs)
+    votes = [make_vote("vs-chain", k, i, 3, 0, bid) for i, k in enumerate(keys)]
+    votes[1].signature = bytes(64)
+    outcomes = vset.add_votes(votes)
+    assert outcomes[0] is True and outcomes[2] is True and outcomes[3] is True
+    assert isinstance(outcomes[1], ValueError)
+    assert vset.bit_array() == [i != 1 for i in range(4)]
+
+
+def test_vote_set_conflict_detection():
+    vs, keys = make_val_set(4)
+    vset = VoteSet("vs-chain", 3, 0, SignedMsgType.PREVOTE, vs)
+    v1 = make_vote("vs-chain", keys[0], 0, 3, 0, make_block_id(b"a"))
+    v2 = make_vote("vs-chain", keys[0], 0, 3, 0, make_block_id(b"b"))
+    assert vset.add_vote(v1) is True
+    assert vset.add_vote(v1) is False  # duplicate
+    with pytest.raises(ConflictingVoteError) as ei:
+        vset.add_vote(v2)
+    assert ei.value.vote_a.block_id == v1.block_id
+
+
+def test_vote_set_peer_maj23_admits_conflicts():
+    vs, keys = make_val_set(4)
+    bid_a, bid_b = make_block_id(b"a"), make_block_id(b"b")
+    vset = VoteSet("vs-chain", 3, 0, SignedMsgType.PREVOTE, vs)
+    vset.add_vote(make_vote("vs-chain", keys[0], 0, 3, 0, bid_a))
+    vset.set_peer_maj23("peer1", bid_b)
+    with pytest.raises(ConflictingVoteError):
+        # still reported as conflict, but tracked under bid_b now
+        vset.add_vote(make_vote("vs-chain", keys[0], 0, 3, 0, bid_b))
+    assert vset.bit_array_by_block_id(bid_b)[0] is True
+
+
+def test_commit_to_vote_set_roundtrip():
+    vs, keys = make_val_set(4)
+    bid = make_block_id()
+    commit = make_commit("rt-chain", 9, 2, bid, vs, keys, absent={3})
+    vset = commit_to_vote_set("rt-chain", commit, vs)
+    assert vset.two_thirds_majority() == bid
+    rebuilt = vset.make_commit()
+    assert rebuilt.hash() == commit.hash()
+
+
+# ---------------------------------------------------------------------------
+# Blocks, headers, part sets
+# ---------------------------------------------------------------------------
+
+def test_header_hash_populated_and_stable():
+    h = Header(
+        chain_id="hdr-chain",
+        height=3,
+        time_ns=1_700_000_000 * 10**9,
+        validators_hash=tmhash.sum_sha256(b"vals"),
+        next_validators_hash=tmhash.sum_sha256(b"nvals"),
+        consensus_hash=tmhash.sum_sha256(b"params"),
+        proposer_address=b"\x01" * 20,
+    )
+    hh = h.hash()
+    assert hh is not None and len(hh) == 32
+    assert h.hash() == hh
+    h2 = Header(**{**h.__dict__, "height": 4})
+    assert h2.hash() != hh
+    assert Header(chain_id="x").hash() is None  # no validators hash
+
+
+def test_block_encode_decode_roundtrip():
+    vs, keys = make_val_set(4)
+    bid = make_block_id()
+    commit = make_commit("blk-chain", 1, 0, bid, vs, keys)
+    blk = Block(
+        header=Header(
+            chain_id="blk-chain",
+            height=2,
+            time_ns=1_700_000_001 * 10**9,
+            validators_hash=vs.hash(),
+            next_validators_hash=vs.hash(),
+            consensus_hash=tmhash.sum_sha256(b"params"),
+            proposer_address=vs.get_proposer().address,
+            last_block_id=bid,
+        ),
+        data=Data(txs=[b"tx1", b"tx22"]),
+        last_commit=commit,
+    )
+    blk.fill_header()
+    enc = blk.encode()
+    dec = Block.decode(enc)
+    assert dec.header.hash() == blk.header.hash()
+    assert dec.data.txs == [b"tx1", b"tx22"]
+    assert dec.last_commit.hash() == commit.hash()
+    blk.validate_basic()
+
+
+def test_part_set_roundtrip_and_proofs():
+    data = bytes(range(256)) * 1000  # 256000 bytes → 4 parts
+    ps = PartSet.from_data(data)
+    assert ps.total == 4 and ps.is_complete()
+    header = ps.header()
+    # receiver side: accumulate parts with proof verification
+    rx = PartSet(header)
+    for i in range(ps.total):
+        part = ps.get_part(i)
+        assert rx.add_part(part) is True
+        assert rx.add_part(part) is False  # duplicate
+    assert rx.is_complete()
+    assert rx.assemble() == data
+    # tampered part rejected
+    rx2 = PartSet(header)
+    bad = ps.get_part(0)
+    import dataclasses
+
+    bad2 = dataclasses.replace(bad, bytes_=b"evil" + bad.bytes_[4:])
+    with pytest.raises(ValueError):
+        rx2.add_part(bad2)
+
+
+def test_genesis_roundtrip():
+    keys = [gen_priv_key() for _ in range(2)]
+    doc = GenesisDoc(
+        chain_id="genesis-chain",
+        validators=[GenesisValidator(pub_key=k.pub_key(), power=5) for k in keys],
+    )
+    doc.validate_and_complete()
+    raw = doc.to_json()
+    doc2 = GenesisDoc.from_json(raw)
+    assert doc2.chain_id == "genesis-chain"
+    assert doc2.doc_hash() == doc.doc_hash()
+    assert doc2.validator_set().hash() == doc.validator_set().hash()
+
+
+# -- review-fix regressions --------------------------------------------------
+
+def test_vote_decode_sign_extension():
+    v = Vote(
+        type=SignedMsgType.PREVOTE,
+        height=3,
+        round=0,
+        block_id=make_block_id(),
+        validator_address=b"\x01" * 20,
+        validator_index=-1,
+        signature=b"s",
+    )
+    d = Vote.decode(v.encode())
+    assert d.validator_index == -1 and d.height == 3
+
+
+def test_light_client_evidence_roundtrip():
+    from tendermint_tpu.types import LightClientAttackEvidence, decode_evidence
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.wire.proto import ProtoWriter
+
+    hdr = Header(
+        chain_id="ev-chain",
+        height=5,
+        validators_hash=tmhash.sum_sha256(b"v"),
+        time_ns=1_700_000_000 * 10**9,
+    )
+    sh = ProtoWriter().message(1, hdr.encode(), always=True).bytes_out()
+    lb = ProtoWriter().message(1, sh, always=True).bytes_out()
+    vs, _ = make_val_set(2)
+    ev = LightClientAttackEvidence(
+        conflicting_block_bytes=lb,
+        common_height=4,
+        byzantine_validators=[vs.validators[0]],
+        total_voting_power=20,
+        timestamp_ns=1_700_000_100 * 10**9,
+        conflicting_header_hash=hdr.hash(),
+    )
+    dec = decode_evidence(ev.encode())
+    assert dec.common_height == 4
+    assert len(dec.byzantine_validators) == 1
+    assert dec.byzantine_validators[0].address == vs.validators[0].address
+    assert dec.conflicting_header_hash == hdr.hash()
+    assert dec.hash() == ev.hash()
+
+
+def test_commit_rejects_too_many_sigs():
+    from tendermint_tpu.types.vote_set import MAX_VOTES_COUNT
+
+    c = Commit(
+        height=1,
+        round=0,
+        block_id=make_block_id(),
+        signatures=[CommitSig.absent_sig()] * (MAX_VOTES_COUNT + 1),
+    )
+    with pytest.raises(ValueError, match="too many"):
+        c.validate_basic()
