@@ -1,0 +1,77 @@
+"""ABCI clients.
+
+LocalClient: in-process client sharing one lock with the application —
+the default for built-in apps (reference abci/client/local_client.go,
+proxy/client.go NewLocalClientCreator).  Socket/gRPC transports for
+external applications are provided by abci.server / later rounds.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import types as abci
+
+
+class LocalClient:
+    """Serializes all calls into the app with one mutex, mirroring the
+    reference's local client semantics."""
+
+    def __init__(self, app: abci.Application, lock: threading.Lock | None = None):
+        self._app = app
+        self._lock = lock or threading.Lock()
+
+    # query connection
+    def info_sync(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        with self._lock:
+            return self._app.info(req)
+
+    def query_sync(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        with self._lock:
+            return self._app.query(req)
+
+    # mempool connection
+    def check_tx_sync(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        with self._lock:
+            return self._app.check_tx(req)
+
+    # consensus connection
+    def init_chain_sync(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        with self._lock:
+            return self._app.init_chain(req)
+
+    def begin_block_sync(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        with self._lock:
+            return self._app.begin_block(req)
+
+    def deliver_tx_sync(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        with self._lock:
+            return self._app.deliver_tx(req)
+
+    def end_block_sync(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        with self._lock:
+            return self._app.end_block(req)
+
+    def commit_sync(self) -> abci.ResponseCommit:
+        with self._lock:
+            return self._app.commit()
+
+    # snapshot connection
+    def list_snapshots_sync(self) -> list[abci.Snapshot]:
+        with self._lock:
+            return self._app.list_snapshots()
+
+    def offer_snapshot_sync(self, snapshot, app_hash: bytes):
+        with self._lock:
+            return self._app.offer_snapshot(snapshot, app_hash)
+
+    def load_snapshot_chunk_sync(self, height: int, format: int, chunk: int) -> bytes:
+        with self._lock:
+            return self._app.load_snapshot_chunk(height, format, chunk)
+
+    def apply_snapshot_chunk_sync(self, index: int, chunk: bytes, sender: str):
+        with self._lock:
+            return self._app.apply_snapshot_chunk(index, chunk, sender)
+
+    def flush_sync(self) -> None:
+        return None

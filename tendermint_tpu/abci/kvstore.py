@@ -1,0 +1,158 @@
+"""Example ABCI applications: kvstore (with validator-update txs) and counter.
+
+Parity: reference abci/example/kvstore/kvstore.go:66 (key=value txs,
+Query), persistent_kvstore.go:27 (`val:<pubkey>!<power>` validator-change
+txs), counter/counter.go:11 (serial nonce checking).
+
+Deliberate TPU-rebuild deviation: the app hash binds the full sorted
+key-value state (SHA-256) instead of the reference's size-varint — a
+stronger commitment with identical determinism properties.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from tendermint_tpu.crypto.keys import PubKey
+
+from . import types as abci
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(abci.BaseApplication):
+    def __init__(self):
+        self.state: dict[bytes, bytes] = {}
+        self.height = 0
+        self.app_hash = b""
+        self.size = 0
+        self.val_updates: list[abci.ValidatorUpdate] = []
+        self.validators: dict[bytes, int] = {}  # pubkey bytes -> power
+        self.retain_blocks = 0  # set >0 to exercise pruning
+
+    # -- query connection ---------------------------------------------
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps({"size": self.size}),
+            version="0.1.0",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        value = self.state.get(req.data, b"")
+        return abci.ResponseQuery(
+            code=abci.CodeTypeOK,
+            key=req.data,
+            value=value,
+            log="exists" if value else "does not exist",
+            height=self.height,
+        )
+
+    # -- mempool connection -------------------------------------------
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX) and not self._parse_val_tx(req.tx):
+            return abci.ResponseCheckTx(code=1, log="invalid validator tx")
+        return abci.ResponseCheckTx(code=abci.CodeTypeOK, gas_wanted=1)
+
+    # -- consensus connection -----------------------------------------
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for vu in req.validators:
+            self.validators[vu.pub_key.bytes_()] = vu.power
+        return abci.ResponseInitChain()
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        self.val_updates = []
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX):
+            parsed = self._parse_val_tx(req.tx)
+            if not parsed:
+                return abci.ResponseDeliverTx(code=1, log="invalid validator tx")
+            pub, power = parsed
+            self.val_updates.append(abci.ValidatorUpdate(pub_key=pub, power=power))
+            self.validators[pub.bytes_()] = power
+            return abci.ResponseDeliverTx(code=abci.CodeTypeOK)
+        if b"=" in req.tx:
+            key, value = req.tx.split(b"=", 1)
+        else:
+            key = value = req.tx
+        self.state[key] = value
+        self.size = len(self.state)
+        events = [
+            abci.Event(
+                type="app",
+                attributes=[
+                    abci.EventAttribute(key=b"key", value=key, index=True),
+                    abci.EventAttribute(key=b"index_key", value=b"index is working", index=True),
+                ],
+            )
+        ]
+        return abci.ResponseDeliverTx(code=abci.CodeTypeOK, data=key, events=events)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return abci.ResponseEndBlock(validator_updates=list(self.val_updates))
+
+    def commit(self) -> abci.ResponseCommit:
+        self.height += 1
+        h = hashlib.sha256()
+        for k in sorted(self.state):
+            h.update(len(k).to_bytes(4, "big") + k)
+            h.update(len(self.state[k]).to_bytes(4, "big") + self.state[k])
+        self.app_hash = h.digest()
+        retain = 0
+        if self.retain_blocks > 0 and self.height > self.retain_blocks:
+            retain = self.height - self.retain_blocks
+        return abci.ResponseCommit(data=self.app_hash, retain_height=retain)
+
+    # -- helpers -------------------------------------------------------
+    @staticmethod
+    def _parse_val_tx(tx: bytes):
+        """val:<hex pubkey>!<power>"""
+        try:
+            body = tx[len(VALIDATOR_TX_PREFIX) :].decode("ascii")
+            pub_hex, power_s = body.split("!", 1)
+            return PubKey(bytes.fromhex(pub_hex)), int(power_s)
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+
+class CounterApplication(abci.BaseApplication):
+    """Serial counter: txs must be the big-endian encoding of the next
+    expected value (reference abci/example/counter)."""
+
+    def __init__(self, serial: bool = True):
+        self.serial = serial
+        self.tx_count = 0
+        self.height = 0
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps({"txs": self.tx_count}), last_block_height=self.height
+        )
+
+    def _check(self, tx: bytes, expected: int) -> int:
+        if not self.serial:
+            return abci.CodeTypeOK
+        if len(tx) > 8:
+            return 1
+        value = int.from_bytes(tx, "big")
+        return abci.CodeTypeOK if value == expected else 2
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        code = self._check(req.tx, self.tx_count)
+        return abci.ResponseCheckTx(code=code)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        code = self._check(req.tx, self.tx_count)
+        if code == abci.CodeTypeOK:
+            self.tx_count += 1
+        return abci.ResponseDeliverTx(code=code)
+
+    def commit(self) -> abci.ResponseCommit:
+        self.height += 1
+        if self.tx_count == 0:
+            return abci.ResponseCommit(data=b"")
+        return abci.ResponseCommit(data=self.tx_count.to_bytes(8, "big"))

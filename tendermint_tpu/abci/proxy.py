@@ -1,0 +1,33 @@
+"""proxy.AppConns: the four logical ABCI connections from one client
+creator (reference proxy/multi_app_conn.go:22-33, proxy/app_conn.go).
+
+With the in-process LocalClient all four share one app mutex, exactly like
+the reference's local creator."""
+
+from __future__ import annotations
+
+import threading
+
+from .client import LocalClient
+from .types import Application
+
+
+class AppConns:
+    def __init__(self, app: Application):
+        lock = threading.Lock()
+        self._consensus = LocalClient(app, lock)
+        self._mempool = LocalClient(app, lock)
+        self._query = LocalClient(app, lock)
+        self._snapshot = LocalClient(app, lock)
+
+    def consensus(self) -> LocalClient:
+        return self._consensus
+
+    def mempool(self) -> LocalClient:
+        return self._mempool
+
+    def query(self) -> LocalClient:
+        return self._query
+
+    def snapshot(self) -> LocalClient:
+        return self._snapshot

@@ -1,0 +1,328 @@
+"""ABCI: the application bridge — 13 methods over 4 logical connections.
+
+Parity: reference abci/types/application.go:11-31 (Application iface),
+proto/tendermint/abci/types.proto (request/response shapes; field numbers
+used where bytes must be deterministic, e.g. ResponseDeliverTx for
+LastResultsHash — types/results.go).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.keys import PubKey
+from tendermint_tpu.wire.proto import ProtoWriter
+
+CodeTypeOK = 0
+
+
+class CheckTxType(enum.IntEnum):
+    NEW = 0
+    RECHECK = 1
+
+
+@dataclass
+class EventAttribute:
+    key: bytes
+    value: bytes
+    index: bool = False
+
+
+@dataclass
+class Event:
+    type: str
+    attributes: list[EventAttribute] = field(default_factory=list)
+
+
+@dataclass
+class RequestInfo:
+    version: str = ""
+    block_version: int = 0
+    p2p_version: int = 0
+
+
+@dataclass
+class ResponseInfo:
+    data: str = ""
+    version: str = ""
+    app_version: int = 0
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+
+@dataclass
+class ValidatorUpdate:
+    pub_key: PubKey
+    power: int
+
+
+@dataclass
+class RequestInitChain:
+    time_ns: int = 0
+    chain_id: str = ""
+    consensus_params: object | None = None
+    validators: list[ValidatorUpdate] = field(default_factory=list)
+    app_state_bytes: bytes = b""
+    initial_height: int = 1
+
+
+@dataclass
+class ResponseInitChain:
+    consensus_params: object | None = None
+    validators: list[ValidatorUpdate] = field(default_factory=list)
+    app_hash: bytes = b""
+
+
+@dataclass
+class RequestQuery:
+    data: bytes = b""
+    path: str = ""
+    height: int = 0
+    prove: bool = False
+
+
+@dataclass
+class ResponseQuery:
+    code: int = 0
+    log: str = ""
+    info: str = ""
+    index: int = 0
+    key: bytes = b""
+    value: bytes = b""
+    proof_ops: list = field(default_factory=list)
+    height: int = 0
+    codespace: str = ""
+
+
+@dataclass
+class RequestCheckTx:
+    tx: bytes
+    type: CheckTxType = CheckTxType.NEW
+
+
+@dataclass
+class ResponseCheckTx:
+    code: int = 0
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: list[Event] = field(default_factory=list)
+    codespace: str = ""
+
+    def is_ok(self) -> bool:
+        return self.code == CodeTypeOK
+
+
+@dataclass
+class Validator:
+    address: bytes
+    power: int
+
+
+@dataclass
+class VoteInfo:
+    validator: Validator
+    signed_last_block: bool
+
+
+@dataclass
+class LastCommitInfo:
+    round: int = 0
+    votes: list[VoteInfo] = field(default_factory=list)
+
+
+@dataclass
+class Misbehavior:
+    """abci.Evidence (type 1 = duplicate vote, 2 = light client attack)."""
+
+    type: int
+    validator: Validator
+    height: int
+    time_ns: int
+    total_voting_power: int
+
+
+@dataclass
+class RequestBeginBlock:
+    hash: bytes = b""
+    header: object | None = None
+    last_commit_info: LastCommitInfo = field(default_factory=LastCommitInfo)
+    byzantine_validators: list[Misbehavior] = field(default_factory=list)
+
+
+@dataclass
+class ResponseBeginBlock:
+    events: list[Event] = field(default_factory=list)
+
+
+@dataclass
+class RequestDeliverTx:
+    tx: bytes
+
+
+@dataclass
+class ResponseDeliverTx:
+    code: int = 0
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: list[Event] = field(default_factory=list)
+    codespace: str = ""
+
+    def is_ok(self) -> bool:
+        return self.code == CodeTypeOK
+
+
+@dataclass
+class RequestEndBlock:
+    height: int
+
+
+@dataclass
+class ResponseEndBlock:
+    validator_updates: list[ValidatorUpdate] = field(default_factory=list)
+    consensus_param_updates: object | None = None
+    events: list[Event] = field(default_factory=list)
+
+
+@dataclass
+class ResponseCommit:
+    data: bytes = b""  # the app hash
+    retain_height: int = 0
+
+
+# -- snapshots (state sync) -------------------------------------------------
+
+@dataclass
+class Snapshot:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+
+@dataclass
+class ResponseOfferSnapshot:
+    class Result(enum.IntEnum):
+        UNKNOWN = 0
+        ACCEPT = 1
+        ABORT = 2
+        REJECT = 3
+        REJECT_FORMAT = 4
+        REJECT_SENDER = 5
+
+    result: "ResponseOfferSnapshot.Result" = Result.UNKNOWN
+
+
+@dataclass
+class ResponseApplySnapshotChunk:
+    class Result(enum.IntEnum):
+        UNKNOWN = 0
+        ACCEPT = 1
+        ABORT = 2
+        RETRY = 3
+        RETRY_SNAPSHOT = 4
+        REJECT_SNAPSHOT = 5
+
+    result: "ResponseApplySnapshotChunk.Result" = Result.UNKNOWN
+    refetch_chunks: list[int] = field(default_factory=list)
+    reject_senders: list[str] = field(default_factory=list)
+
+
+class Application:
+    """The 13-method ABCI application interface
+    (reference abci/types/application.go:11-31)."""
+
+    # connection: query
+    def info(self, req: RequestInfo) -> ResponseInfo: ...
+
+    def query(self, req: RequestQuery) -> ResponseQuery: ...
+
+    # connection: mempool
+    def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx: ...
+
+    # connection: consensus
+    def init_chain(self, req: RequestInitChain) -> ResponseInitChain: ...
+
+    def begin_block(self, req: RequestBeginBlock) -> ResponseBeginBlock: ...
+
+    def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx: ...
+
+    def end_block(self, req: RequestEndBlock) -> ResponseEndBlock: ...
+
+    def commit(self) -> ResponseCommit: ...
+
+    # connection: snapshot
+    def list_snapshots(self) -> list[Snapshot]: ...
+
+    def offer_snapshot(self, snapshot: Snapshot, app_hash: bytes) -> ResponseOfferSnapshot: ...
+
+    def load_snapshot_chunk(self, height: int, format: int, chunk: int) -> bytes: ...
+
+    def apply_snapshot_chunk(self, index: int, chunk: bytes, sender: str) -> ResponseApplySnapshotChunk: ...
+
+
+class BaseApplication(Application):
+    """No-op base (reference abci/types/application.go:38)."""
+
+    def info(self, req: RequestInfo) -> ResponseInfo:
+        return ResponseInfo()
+
+    def query(self, req: RequestQuery) -> ResponseQuery:
+        return ResponseQuery()
+
+    def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx:
+        return ResponseCheckTx()
+
+    def init_chain(self, req: RequestInitChain) -> ResponseInitChain:
+        return ResponseInitChain()
+
+    def begin_block(self, req: RequestBeginBlock) -> ResponseBeginBlock:
+        return ResponseBeginBlock()
+
+    def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx:
+        return ResponseDeliverTx()
+
+    def end_block(self, req: RequestEndBlock) -> ResponseEndBlock:
+        return ResponseEndBlock()
+
+    def commit(self) -> ResponseCommit:
+        return ResponseCommit()
+
+    def list_snapshots(self) -> list[Snapshot]:
+        return []
+
+    def offer_snapshot(self, snapshot: Snapshot, app_hash: bytes) -> ResponseOfferSnapshot:
+        return ResponseOfferSnapshot()
+
+    def load_snapshot_chunk(self, height: int, format: int, chunk: int) -> bytes:
+        return b""
+
+    def apply_snapshot_chunk(self, index: int, chunk: bytes, sender: str) -> ResponseApplySnapshotChunk:
+        return ResponseApplySnapshotChunk()
+
+
+def deterministic_deliver_tx_bytes(r: ResponseDeliverTx) -> bytes:
+    """Deterministic subset {code=1, data=2, gas_wanted=5, gas_used=6} of
+    ResponseDeliverTx — the LastResultsHash leaves (types/results.go)."""
+    return (
+        ProtoWriter()
+        .varint(1, r.code)
+        .bytes_(2, r.data)
+        .varint(5, r.gas_wanted)
+        .varint(6, r.gas_used)
+        .bytes_out()
+    )
+
+
+def results_hash(responses: list[ResponseDeliverTx]) -> bytes:
+    return merkle.hash_from_byte_slices(
+        [deterministic_deliver_tx_bytes(r) for r in responses]
+    )

@@ -1,0 +1,4 @@
+from .state import State, make_genesis_state
+from .store import StateStore, ABCIResponses
+from .execution import BlockExecutor
+from .validation import validate_block

@@ -1,0 +1,276 @@
+"""BlockExecutor: proposal creation, validation, ABCI execution, commit.
+
+Parity: reference state/execution.go —
+CreateProposalBlock :95, ValidateBlock :118, ApplyBlock :132 (BeginBlock →
+DeliverTx pipeline → EndBlock → updateState with validator updates :406 →
+Commit :210 under mempool lock → fireEvents :474), retain-height pruning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu import abci
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    BlockIDFlag,
+    Commit,
+    Validator,
+    ValidatorSet,
+)
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, LightClientAttackEvidence
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .state import State
+from .store import ABCIResponses, StateStore
+from .validation import validate_block, weighted_median_time
+
+
+class _NullMempool:
+    def lock(self):
+        pass
+
+    def unlock(self):
+        pass
+
+    def reap_max_bytes_max_gas(self, max_bytes, max_gas):
+        return []
+
+    def update(self, height, txs, deliver_tx_responses, pre_check=None):
+        pass
+
+    def flush_app_conn(self):
+        pass
+
+
+class _NullEvidencePool:
+    def pending_evidence(self, max_bytes):
+        return []
+
+    def update(self, state, evidence):
+        pass
+
+    def check_evidence(self, state, evidence):
+        if evidence:
+            raise ValueError("unexpected evidence (null pool)")
+
+
+class BlockExecutor:
+    def __init__(
+        self,
+        state_store: StateStore,
+        app_conn: "abci.LocalClient",
+        mempool=None,
+        evidence_pool=None,
+        event_bus=None,
+        logger: Logger | None = None,
+    ):
+        self.store = state_store
+        self.app = app_conn
+        self.mempool = mempool if mempool is not None else _NullMempool()
+        self.evpool = evidence_pool if evidence_pool is not None else _NullEvidencePool()
+        self.event_bus = event_bus
+        self.logger = logger or nop_logger()
+
+    # -- proposal -------------------------------------------------------
+    def create_proposal_block(
+        self, height: int, state: State, last_commit: Commit, proposer_addr: bytes
+    ) -> Block:
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence = self.evpool.pending_evidence(
+            state.consensus_params.evidence.max_bytes
+        )
+        # leave generous room for header/commit/evidence (reference
+        # types.MaxDataBytes is exact and panics when negative; a negative
+        # cap must never reach the mempool, where it means "unlimited")
+        data_cap = max_bytes - 2048 - 300 * len(last_commit.signatures)
+        if data_cap < 0:
+            raise ValueError(
+                f"block.max_bytes {max_bytes} too small for "
+                f"{len(last_commit.signatures)} commit signatures"
+            )
+        txs = self.mempool.reap_max_bytes_max_gas(data_cap, max_gas)
+        if height == state.initial_height:
+            time_ns = state.last_block_time_ns
+        else:
+            time_ns = weighted_median_time(last_commit, state.last_validators)
+        return state.make_block(height, txs, last_commit, evidence, proposer_addr, time_ns)
+
+    # -- validation -----------------------------------------------------
+    def validate_block(self, state: State, block: Block) -> None:
+        validate_block(state, block, self.evpool)
+
+    # -- execution ------------------------------------------------------
+    def apply_block(self, state: State, block_id: BlockID, block: Block) -> tuple[State, int]:
+        """Execute the block against the app, persist responses, advance
+        state, commit the app, update mempool/evidence.  Returns
+        (new_state, retain_height)."""
+        self.validate_block(state, block)
+
+        abci_responses = self._exec_block_on_app(state, block)
+        self.store.save_abci_responses(block.header.height, abci_responses)
+
+        # validate validator updates per consensus params
+        val_updates = (
+            abci_responses.end_block.validator_updates if abci_responses.end_block else []
+        )
+        self._validate_validator_updates(val_updates, state)
+
+        new_state = self._update_state(state, block_id, block, abci_responses, val_updates)
+
+        # commit the app + update mempool atomically w.r.t. CheckTx
+        app_hash, retain_height = self._commit(new_state, block, abci_responses)
+        new_state.app_hash = app_hash
+        self.store.save(new_state)
+
+        self.evpool.update(new_state, block.evidence)
+
+        if self.event_bus is not None:
+            self._fire_events(block, block_id, abci_responses, val_updates)
+        return new_state, retain_height
+
+    def _exec_block_on_app(self, state: State, block: Block) -> ABCIResponses:
+        """BeginBlock → DeliverTx×N (pipelined in the reference; the local
+        client serializes anyway) → EndBlock (reference :261-340)."""
+        commit_info = self._begin_block_commit_info(state, block)
+        byz = self._byzantine_validators(state, block)
+        rbb = self.app.begin_block_sync(
+            abci.RequestBeginBlock(
+                hash=block.hash() or b"",
+                header=block.header,
+                last_commit_info=commit_info,
+                byzantine_validators=byz,
+            )
+        )
+        deliver_txs = [
+            self.app.deliver_tx_sync(abci.RequestDeliverTx(tx=tx)) for tx in block.data.txs
+        ]
+        reb = self.app.end_block_sync(abci.RequestEndBlock(height=block.header.height))
+        return ABCIResponses(
+            deliver_txs=deliver_txs, end_block=reb, begin_block_events=rbb.events
+        )
+
+    def _begin_block_commit_info(self, state: State, block: Block) -> abci.LastCommitInfo:
+        if block.header.height == state.initial_height or block.last_commit is None:
+            return abci.LastCommitInfo()
+        votes = []
+        for i, cs in enumerate(block.last_commit.signatures):
+            val = state.last_validators.get_by_index(i)
+            votes.append(
+                abci.VoteInfo(
+                    validator=abci.types.Validator(address=val.address, power=val.voting_power),
+                    signed_last_block=not cs.absent(),
+                )
+            )
+        return abci.LastCommitInfo(round=block.last_commit.round, votes=votes)
+
+    def _byzantine_validators(self, state: State, block: Block) -> list:
+        out = []
+        for ev in block.evidence:
+            if isinstance(ev, DuplicateVoteEvidence):
+                out.append(
+                    abci.types.Misbehavior(
+                        type=1,
+                        validator=abci.types.Validator(
+                            address=ev.vote_a.validator_address, power=ev.validator_power
+                        ),
+                        height=ev.height(),
+                        time_ns=ev.timestamp_ns,
+                        total_voting_power=ev.total_voting_power,
+                    )
+                )
+            elif isinstance(ev, LightClientAttackEvidence):
+                for v in ev.byzantine_validators:
+                    out.append(
+                        abci.types.Misbehavior(
+                            type=2,
+                            validator=abci.types.Validator(
+                                address=v.address, power=v.voting_power
+                            ),
+                            height=ev.height(),
+                            time_ns=ev.timestamp_ns,
+                            total_voting_power=ev.total_voting_power,
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _validate_validator_updates(updates: list, state: State) -> None:
+        allowed = set(state.consensus_params.validator.pub_key_types)
+        for vu in updates:
+            if vu.power < 0:
+                raise ValueError("validator update with negative power")
+            if vu.pub_key.type() not in allowed:
+                raise ValueError(f"validator pubkey type {vu.pub_key.type()} not allowed")
+
+    def _update_state(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        abci_responses: ABCIResponses,
+        val_updates: list,
+    ) -> State:
+        """reference updateState (:390-470)."""
+        height = block.header.height
+        n_val_set = state.next_validators.copy()
+        last_height_vals_changed = state.last_height_validators_changed
+        if val_updates:
+            n_val_set.update_with_change_set(
+                [
+                    Validator(pub_key=vu.pub_key, voting_power=vu.power)
+                    for vu in val_updates
+                ]
+            )
+            last_height_vals_changed = height + 1 + 1  # effective H+2
+
+        n_val_set.increment_proposer_priority(1)
+
+        params = state.consensus_params
+        last_height_params_changed = state.last_height_consensus_params_changed
+        cpu = abci_responses.end_block.consensus_param_updates if abci_responses.end_block else None
+        if cpu is not None:
+            params = params.update(cpu)
+            params.validate()
+            last_height_params_changed = height + 1
+
+        return State(
+            chain_id=state.chain_id,
+            initial_height=state.initial_height,
+            last_block_height=height,
+            last_block_id=block_id,
+            last_block_time_ns=block.header.time_ns,
+            validators=state.next_validators.copy(),
+            next_validators=n_val_set,
+            last_validators=state.validators.copy(),
+            last_height_validators_changed=last_height_vals_changed,
+            consensus_params=params,
+            last_height_consensus_params_changed=last_height_params_changed,
+            last_results_hash=abci_responses.results_hash(),
+            app_hash=b"",  # set after app Commit
+            version_app=params.version.app_version,
+        )
+
+    def _commit(self, state: State, block: Block, abci_responses: ABCIResponses) -> tuple[bytes, int]:
+        """App commit under mempool lock (reference :210-260)."""
+        self.mempool.lock()
+        try:
+            self.mempool.flush_app_conn()
+            res = self.app.commit_sync()
+            self.mempool.update(
+                block.header.height, block.data.txs, abci_responses.deliver_txs
+            )
+            return res.data, res.retain_height
+        finally:
+            self.mempool.unlock()
+
+    def _fire_events(self, block, block_id, abci_responses, val_updates) -> None:
+        self.event_bus.publish_new_block(block, block_id, abci_responses)
+        for i, tx in enumerate(block.data.txs):
+            self.event_bus.publish_tx(
+                block.header.height, i, tx, abci_responses.deliver_txs[i]
+            )
+        if val_updates:
+            self.event_bus.publish_validator_set_updates(val_updates)

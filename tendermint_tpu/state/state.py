@@ -1,0 +1,115 @@
+"""sm.State — the value-type snapshot threaded through block execution.
+
+Parity: reference state/state.go:356 — chainID, initial height, last block
+info, current/next/last validator sets, LastHeightValidatorsChanged,
+consensus params, AppHash, LastResultsHash; MakeGenesisState; MakeBlock.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    Commit,
+    ConsensusParams,
+    Data,
+    GenesisDoc,
+    Header,
+    ValidatorSet,
+)
+from tendermint_tpu.types.block import BLOCK_PROTOCOL
+
+
+@dataclass
+class State:
+    chain_id: str
+    initial_height: int
+    last_block_height: int
+    last_block_id: BlockID
+    last_block_time_ns: int
+    validators: ValidatorSet
+    next_validators: ValidatorSet
+    last_validators: ValidatorSet | None
+    last_height_validators_changed: int
+    consensus_params: ConsensusParams
+    last_height_consensus_params_changed: int
+    last_results_hash: bytes
+    app_hash: bytes
+    version_app: int = 0
+
+    def copy(self) -> "State":
+        return State(
+            chain_id=self.chain_id,
+            initial_height=self.initial_height,
+            last_block_height=self.last_block_height,
+            last_block_id=self.last_block_id,
+            last_block_time_ns=self.last_block_time_ns,
+            validators=self.validators.copy(),
+            next_validators=self.next_validators.copy(),
+            last_validators=self.last_validators.copy() if self.last_validators else None,
+            last_height_validators_changed=self.last_height_validators_changed,
+            consensus_params=self.consensus_params,
+            last_height_consensus_params_changed=self.last_height_consensus_params_changed,
+            last_results_hash=self.last_results_hash,
+            app_hash=self.app_hash,
+            version_app=self.version_app,
+        )
+
+    def is_empty(self) -> bool:
+        return self.validators.is_nil_or_empty()
+
+    def make_block(
+        self,
+        height: int,
+        txs: list[bytes],
+        last_commit: Commit,
+        evidence: list,
+        proposer_address: bytes,
+        time_ns: int,
+    ) -> Block:
+        """Build the next proposal block from this state (reference
+        state/state.go MakeBlock)."""
+        header = Header(
+            chain_id=self.chain_id,
+            height=height,
+            time_ns=time_ns,
+            last_block_id=self.last_block_id,
+            validators_hash=self.validators.hash(),
+            next_validators_hash=self.next_validators.hash(),
+            consensus_hash=self.consensus_params.hash(),
+            app_hash=self.app_hash,
+            last_results_hash=self.last_results_hash,
+            proposer_address=proposer_address,
+            version_block=BLOCK_PROTOCOL,
+            version_app=self.version_app,
+        )
+        block = Block(
+            header=header, data=Data(txs=txs), evidence=evidence, last_commit=last_commit
+        )
+        block.fill_header()
+        return block
+
+
+def make_genesis_state(genesis: GenesisDoc) -> State:
+    """reference state/state.go MakeGenesisState."""
+    genesis.validate_and_complete()
+    val_set = genesis.validator_set()
+    next_vals = val_set.copy_increment_proposer_priority(1)
+    return State(
+        chain_id=genesis.chain_id,
+        initial_height=genesis.initial_height,
+        last_block_height=0,
+        last_block_id=BlockID(),
+        last_block_time_ns=genesis.genesis_time_ns,
+        validators=val_set,
+        next_validators=next_vals,
+        last_validators=None,
+        last_height_validators_changed=genesis.initial_height,
+        consensus_params=genesis.consensus_params,
+        last_height_consensus_params_changed=genesis.initial_height,
+        last_results_hash=b"",
+        app_hash=genesis.app_hash,
+        version_app=genesis.consensus_params.version.app_version,
+    )

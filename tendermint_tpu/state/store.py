@@ -1,0 +1,387 @@
+"""StateStore: persists sm.State snapshots, historical validator sets,
+consensus params, and per-height ABCI responses.
+
+Parity: reference state/store.go:65-560 — ValidatorsInfo de-duped via
+lastHeightChanged (:503), ConsensusParamsInfo, ABCIResponses (:435),
+Bootstrap for statesync (:205), PruneStates (:237),
+ABCIResponsesResultsHash (:397) → Header.LastResultsHash.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+
+from tendermint_tpu.abci import ResponseDeliverTx, ResponseEndBlock, results_hash
+from tendermint_tpu.store.db import KVStore
+from tendermint_tpu.types import BlockID, ConsensusParams, PartSetHeader, ValidatorSet
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .state import State
+
+_STATE_KEY = b"stateKey"
+_VALS = b"validatorsKey:"
+_PARAMS = b"consensusParamsKey:"
+_ABCI = b"abciResponsesKey:"
+_GENESIS_HASH = b"genesisDocHash"
+
+
+def _hk(prefix: bytes, height: int) -> bytes:
+    return prefix + struct.pack(">q", height)
+
+
+@dataclass
+class ABCIResponses:
+    deliver_txs: list[ResponseDeliverTx] = field(default_factory=list)
+    end_block: ResponseEndBlock | None = None
+    begin_block_events: list = field(default_factory=list)
+
+    def results_hash(self) -> bytes:
+        return results_hash(self.deliver_txs)
+
+
+class StateStore:
+    def __init__(self, db: KVStore):
+        self._db = db
+
+    # -- genesis pinning ------------------------------------------------
+    def genesis_doc_hash(self) -> bytes | None:
+        return self._db.get(_GENESIS_HASH)
+
+    def save_genesis_doc_hash(self, h: bytes) -> None:
+        self._db.set(_GENESIS_HASH, h)
+
+    # -- state snapshot --------------------------------------------------
+    def save(self, state: State) -> None:
+        next_height = state.last_block_height + 1
+        if state.last_block_height == 0:  # genesis bootstrap
+            next_height = state.initial_height
+            self._save_validators_info(next_height, next_height, state.validators)
+        self._save_validators_info(
+            next_height + 1, state.last_height_validators_changed, state.next_validators
+        )
+        self._save_params_info(
+            next_height, state.last_height_consensus_params_changed, state.consensus_params
+        )
+        self._db.set(_STATE_KEY, _encode_state(state))
+
+    def load(self) -> State | None:
+        raw = self._db.get(_STATE_KEY)
+        if raw is None:
+            return None
+        return _decode_state(raw)
+
+    def bootstrap(self, state: State) -> None:
+        """Statesync entry: persist a light-client-verified state snapshot
+        (reference :205)."""
+        height = state.last_block_height + 1
+        if height == state.initial_height and state.last_validators is not None:
+            self._save_validators_info(height - 1, height - 1, state.last_validators)
+        if state.last_validators is not None and height > state.initial_height:
+            self._save_validators_info(height - 1, height - 1, state.last_validators)
+        self._save_validators_info(height, height, state.validators)
+        self._save_validators_info(height + 1, height + 1, state.next_validators)
+        self._save_params_info(
+            height, state.last_height_consensus_params_changed, state.consensus_params
+        )
+        self._db.set(_STATE_KEY, _encode_state(state))
+
+    # -- historical validators / params ----------------------------------
+    def _save_validators_info(
+        self, height: int, last_changed: int, vals: ValidatorSet
+    ) -> None:
+        """De-dup: full set stored only at change heights; other heights
+        store a pointer (reference :503)."""
+        if last_changed > height:
+            raise ValueError("lastHeightChanged cannot be greater than height")
+        w = ProtoWriter().varint(1, last_changed)
+        if height == last_changed:
+            w.message(2, vals.encode(), always=True)
+        self._db.set(_hk(_VALS, height), w.bytes_out())
+
+    def load_validators(self, height: int) -> ValidatorSet | None:
+        raw = self._db.get(_hk(_VALS, height))
+        if raw is None:
+            return None
+        f = fields_to_dict(raw)
+        last_changed = f.get(1, [0])[0]
+        enc = f.get(2, [None])[0]
+        if enc is None:
+            raw2 = self._db.get(_hk(_VALS, last_changed))
+            if raw2 is None:
+                return None
+            f2 = fields_to_dict(raw2)
+            enc = f2.get(2, [None])[0]
+            if enc is None:
+                return None
+            vals = ValidatorSet.decode(enc)
+            # advance priorities to the requested height (reference
+            # LoadValidators: CopyIncrementProposerPriority(height - lastChanged))
+            vals.increment_proposer_priority(height - last_changed)
+            return vals
+        return ValidatorSet.decode(enc)
+
+    def _save_params_info(self, height: int, last_changed: int, params: ConsensusParams) -> None:
+        w = ProtoWriter().varint(1, last_changed)
+        if height == last_changed:
+            w.message(2, params.encode(), always=True)
+        self._db.set(_hk(_PARAMS, height), w.bytes_out())
+
+    def load_consensus_params(self, height: int) -> ConsensusParams | None:
+        raw = self._db.get(_hk(_PARAMS, height))
+        if raw is None:
+            return None
+        f = fields_to_dict(raw)
+        enc = f.get(2, [None])[0]
+        if enc is None:
+            last_changed = f.get(1, [0])[0]
+            raw2 = self._db.get(_hk(_PARAMS, last_changed))
+            if raw2 is None:
+                return None
+            enc = fields_to_dict(raw2).get(2, [None])[0]
+            if enc is None:
+                return None
+        return ConsensusParams.decode(enc)
+
+    # -- ABCI responses ---------------------------------------------------
+    def save_abci_responses(self, height: int, responses: ABCIResponses) -> None:
+        self._db.set(_hk(_ABCI, height), _encode_abci_responses(responses))
+
+    def load_abci_responses(self, height: int) -> ABCIResponses | None:
+        raw = self._db.get(_hk(_ABCI, height))
+        if raw is None:
+            return None
+        return _decode_abci_responses(raw)
+
+    # -- pruning ----------------------------------------------------------
+    def prune_states(self, base: int, retain_height: int) -> None:
+        if retain_height <= base:
+            return
+        deletes = []
+        for h in range(base, retain_height):
+            deletes.append(_hk(_VALS, h))
+            deletes.append(_hk(_PARAMS, h))
+            deletes.append(_hk(_ABCI, h))
+        self._db.write_batch([], deletes)
+
+
+# -- serialization -----------------------------------------------------------
+
+def _encode_state(s: State) -> bytes:
+    meta = {
+        "chain_id": s.chain_id,
+        "initial_height": s.initial_height,
+        "last_block_height": s.last_block_height,
+        "last_block_time_ns": s.last_block_time_ns,
+        "last_height_validators_changed": s.last_height_validators_changed,
+        "last_height_consensus_params_changed": s.last_height_consensus_params_changed,
+        "version_app": s.version_app,
+    }
+    w = (
+        ProtoWriter()
+        .bytes_(1, json.dumps(meta, sort_keys=True).encode())
+        .message(2, s.last_block_id.encode(), always=True)
+        .message(3, s.validators.encode(), always=True)
+        .message(4, s.next_validators.encode(), always=True)
+        .message(5, s.last_validators.encode() if s.last_validators else None)
+        .message(6, s.consensus_params.encode(), always=True)
+        .bytes_(7, s.last_results_hash)
+        .bytes_(8, s.app_hash)
+    )
+    return w.bytes_out()
+
+
+def _decode_state(raw: bytes) -> State:
+    f = fields_to_dict(raw)
+    meta = json.loads(f[1][0].decode())
+    lv = f.get(5, [None])[0]
+    return State(
+        chain_id=meta["chain_id"],
+        initial_height=meta["initial_height"],
+        last_block_height=meta["last_block_height"],
+        last_block_id=BlockID.decode(f[2][0]),
+        last_block_time_ns=meta["last_block_time_ns"],
+        validators=ValidatorSet.decode(f[3][0]),
+        next_validators=ValidatorSet.decode(f[4][0]),
+        last_validators=ValidatorSet.decode(lv) if lv else None,
+        last_height_validators_changed=meta["last_height_validators_changed"],
+        consensus_params=ConsensusParams.decode(f[6][0]),
+        last_height_consensus_params_changed=meta["last_height_consensus_params_changed"],
+        last_results_hash=f.get(7, [b""])[0],
+        app_hash=f.get(8, [b""])[0],
+        version_app=meta.get("version_app", 0),
+    )
+
+
+def _encode_event(ev) -> bytes:
+    w = ProtoWriter().string(1, ev.type)
+    for a in ev.attributes:
+        w.message(
+            2,
+            ProtoWriter().bytes_(1, a.key).bytes_(2, a.value).bool_(3, a.index).bytes_out(),
+            always=True,
+        )
+    return w.bytes_out()
+
+
+def _decode_event(raw: bytes):
+    from tendermint_tpu.abci.types import Event, EventAttribute
+
+    f = fields_to_dict(raw)
+    attrs = []
+    for b in f.get(2, []):
+        af = fields_to_dict(b)
+        attrs.append(
+            EventAttribute(
+                key=af.get(1, [b""])[0],
+                value=af.get(2, [b""])[0],
+                index=bool(af.get(3, [0])[0]),
+            )
+        )
+    t = f.get(1, [b""])[0]
+    return Event(type=t.decode() if isinstance(t, bytes) else "", attributes=attrs)
+
+
+def _encode_abci_responses(r: ABCIResponses) -> bytes:
+    from tendermint_tpu.types.validator import pub_key_proto_bytes
+
+    w = ProtoWriter()
+    for dtx in r.deliver_txs:
+        dw = (
+            ProtoWriter()
+            .varint(1, dtx.code)
+            .bytes_(2, dtx.data)
+            .string(3, dtx.log)
+            .varint(5, dtx.gas_wanted)
+            .varint(6, dtx.gas_used)
+        )
+        for ev in dtx.events:
+            dw.message(7, _encode_event(ev), always=True)
+        w.message(1, dw.bytes_out(), always=True)
+    if r.end_block is not None:
+        ew = ProtoWriter()
+        for vu in r.end_block.validator_updates:
+            ew.message(
+                1,
+                ProtoWriter()
+                .message(1, pub_key_proto_bytes(vu.pub_key), always=True)
+                .varint(2, vu.power)
+                .bytes_out(),
+                always=True,
+            )
+        cpu = r.end_block.consensus_param_updates
+        if cpu is not None:
+            ew.message(2, _encode_param_updates(cpu), always=True)
+        for ev in r.end_block.events:
+            ew.message(3, _encode_event(ev), always=True)
+        w.message(2, ew.bytes_out(), always=True)
+    for ev in r.begin_block_events:
+        w.message(3, _encode_event(ev), always=True)
+    return w.bytes_out()
+
+
+def _encode_param_updates(cpu) -> bytes:
+    w = ProtoWriter()
+    if cpu.block is not None:
+        w.message(
+            1,
+            ProtoWriter()
+            .varint(1, cpu.block.max_bytes)
+            .varint(2, cpu.block.max_gas)
+            .varint(3, cpu.block.time_iota_ms)
+            .bytes_out(),
+            always=True,
+        )
+    if cpu.evidence is not None:
+        w.message(
+            2,
+            ProtoWriter()
+            .varint(1, cpu.evidence.max_age_num_blocks)
+            .varint(2, cpu.evidence.max_age_duration_ns)
+            .varint(3, cpu.evidence.max_bytes)
+            .bytes_out(),
+            always=True,
+        )
+    if cpu.validator is not None:
+        vw = ProtoWriter()
+        for t in cpu.validator.pub_key_types:
+            vw.string(1, t)
+        w.message(3, vw.bytes_out(), always=True)
+    if cpu.version is not None:
+        w.message(4, ProtoWriter().varint(1, cpu.version.app_version).bytes_out(), always=True)
+    return w.bytes_out()
+
+
+def _decode_param_updates(raw: bytes):
+    from tendermint_tpu.types.params import (
+        BlockParams,
+        ConsensusParamsUpdate,
+        EvidenceParams,
+        ValidatorParams,
+        VersionParams,
+    )
+    from tendermint_tpu.wire.proto import to_int64
+
+    f = fields_to_dict(raw)
+    out = ConsensusParamsUpdate()
+    if f.get(1):
+        bf = fields_to_dict(f[1][0])
+        out.block = BlockParams(
+            max_bytes=bf.get(1, [0])[0],
+            max_gas=to_int64(bf.get(2, [0])[0]),
+            time_iota_ms=bf.get(3, [0])[0],
+        )
+    if f.get(2):
+        ef = fields_to_dict(f[2][0])
+        out.evidence = EvidenceParams(
+            max_age_num_blocks=ef.get(1, [0])[0],
+            max_age_duration_ns=ef.get(2, [0])[0],
+            max_bytes=ef.get(3, [0])[0],
+        )
+    if f.get(3):
+        vf = fields_to_dict(f[3][0])
+        out.validator = ValidatorParams(
+            pub_key_types=[t.decode("utf-8") for t in vf.get(1, [])]
+        )
+    if f.get(4):
+        out.version = VersionParams(app_version=fields_to_dict(f[4][0]).get(1, [0])[0])
+    return out
+
+
+def _decode_abci_responses(raw: bytes) -> ABCIResponses:
+    from tendermint_tpu.abci.types import ValidatorUpdate
+    from tendermint_tpu.crypto.keys import PubKey
+
+    f = fields_to_dict(raw)
+    dtxs = []
+    for b in f.get(1, []):
+        df = fields_to_dict(b)
+        dtxs.append(
+            ResponseDeliverTx(
+                code=df.get(1, [0])[0],
+                data=df.get(2, [b""])[0],
+                log=df.get(3, [b""])[0].decode() if df.get(3) else "",
+                gas_wanted=df.get(5, [0])[0],
+                gas_used=df.get(6, [0])[0],
+                events=[_decode_event(e) for e in df.get(7, [])],
+            )
+        )
+    eb = None
+    if f.get(2):
+        eb = ResponseEndBlock()
+        ef = fields_to_dict(f[2][0])
+        for b in ef.get(1, []):
+            vf = fields_to_dict(b)
+            pk = fields_to_dict(vf.get(1, [b""])[0])
+            eb.validator_updates.append(
+                ValidatorUpdate(pub_key=PubKey(pk.get(1, [b""])[0]), power=vf.get(2, [0])[0])
+            )
+        if ef.get(2):
+            eb.consensus_param_updates = _decode_param_updates(ef[2][0])
+        eb.events = [_decode_event(e) for e in ef.get(3, [])]
+    return ABCIResponses(
+        deliver_txs=dtxs,
+        end_block=eb,
+        begin_block_events=[_decode_event(e) for e in f.get(3, [])],
+    )

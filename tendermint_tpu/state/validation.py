@@ -1,0 +1,104 @@
+"""Block validation against state.
+
+Parity: reference state/validation.go:14-150 — header field checks against
+the state snapshot, last-commit verification through the validator set
+(the north-star batched call, validation.go:92), proposer membership,
+median-time rule.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.types import Block
+from tendermint_tpu.types.block import BLOCK_PROTOCOL
+
+from .state import State
+
+
+def weighted_median_time(commit, val_set) -> int:
+    """Median of commit vote times weighted by voting power (reference
+    types/time/time.go:35 WeightedMedian, types/block.go MedianTime)."""
+    weighted = []
+    for i, cs in enumerate(commit.signatures):
+        if cs.absent():
+            continue
+        val = val_set.get_by_index(i)
+        if val is not None:
+            weighted.append((cs.timestamp_ns, val.voting_power))
+    total = sum(w for _, w in weighted)
+    if total == 0:
+        return 0
+    weighted.sort(key=lambda t: t[0])
+    median = total // 2
+    for ts, w in weighted:
+        if median < w:
+            return ts
+        median -= w
+    return weighted[-1][0]
+
+
+def validate_block(state: State, block: Block, evidence_pool=None) -> None:
+    """Raises ValueError when the block is invalid for this state."""
+    block.validate_basic()
+    h = block.header
+
+    if h.version_block != BLOCK_PROTOCOL:
+        raise ValueError(f"wrong block protocol: got {h.version_block}")
+    if h.chain_id != state.chain_id:
+        raise ValueError(f"wrong chain ID: got {h.chain_id}, want {state.chain_id}")
+    expected_height = (
+        state.initial_height
+        if state.last_block_height == 0
+        else state.last_block_height + 1
+    )
+    if h.height != expected_height:
+        raise ValueError(f"wrong height: got {h.height}, want {expected_height}")
+    if h.last_block_id != state.last_block_id:
+        raise ValueError("wrong LastBlockID")
+
+    # validate derived hashes against state
+    if h.validators_hash != state.validators.hash():
+        raise ValueError("wrong ValidatorsHash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise ValueError("wrong NextValidatorsHash")
+    if h.consensus_hash != state.consensus_params.hash():
+        raise ValueError("wrong ConsensusHash")
+    if h.app_hash != state.app_hash:
+        raise ValueError("wrong AppHash")
+    if h.last_results_hash != state.last_results_hash:
+        raise ValueError("wrong LastResultsHash")
+
+    # last commit
+    if h.height == state.initial_height:
+        if block.last_commit is not None and len(block.last_commit.signatures) != 0:
+            raise ValueError("initial block cannot have LastCommit signatures")
+    else:
+        if block.last_commit is None:
+            raise ValueError("nil LastCommit")
+        if len(block.last_commit.signatures) != state.last_validators.size():
+            raise ValueError(
+                f"invalid LastCommit size: got {len(block.last_commit.signatures)}, "
+                f"want {state.last_validators.size()}"
+            )
+        # ONE batched device call for the whole commit (validation.go:92)
+        state.last_validators.verify_commit(
+            state.chain_id, state.last_block_id, h.height - 1, block.last_commit
+        )
+
+    # time rules
+    if h.height > state.initial_height:
+        median = weighted_median_time(block.last_commit, state.last_validators)
+        if h.time_ns != median:
+            raise ValueError("invalid block time (must equal weighted median)")
+        if h.time_ns <= state.last_block_time_ns:
+            raise ValueError("block time must be monotonically increasing")
+    elif h.height == state.initial_height:
+        if h.time_ns != state.last_block_time_ns:
+            raise ValueError("initial block must have genesis time")
+
+    # proposer must be in the current validator set
+    if not state.validators.has_address(h.proposer_address):
+        raise ValueError("proposer not in validator set")
+
+    # evidence
+    if evidence_pool is not None:
+        evidence_pool.check_evidence(state, block.evidence)

@@ -1,0 +1,2 @@
+from .db import KVStore, MemDB, SQLiteDB, open_db
+from .blockstore import BlockStore

@@ -1,0 +1,154 @@
+"""BlockStore: height-keyed persistence of blocks, parts, and commits.
+
+Parity: reference store/store.go:32-560 — block meta/parts/commits keyed by
+height, hash→height index, SaveBlock :419, PruneBlocks :285 with batched
+deletes, base/height tracking for pruned chains.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from tendermint_tpu.types import Block, BlockID, BlockMeta, Commit
+from tendermint_tpu.types.part_set import Part, PartSet
+
+from .db import KVStore
+
+
+def _h(prefix: bytes, height: int) -> bytes:
+    return prefix + struct.pack(">q", height)
+
+
+_META = b"BM:"
+_PART = b"BP:"
+_COMMIT = b"BC:"
+_SEEN = b"SC:"
+_HASH = b"BH:"
+_STATE = b"BSJ"  # base/height bookkeeping
+
+
+class BlockStore:
+    def __init__(self, db: KVStore):
+        self._db = db
+        self._lock = threading.RLock()
+        raw = db.get(_STATE)
+        if raw is not None:
+            self._base, self._height = struct.unpack(">qq", raw)
+        else:
+            self._base, self._height = 0, 0
+
+    def base(self) -> int:
+        with self._lock:
+            return self._base
+
+    def height(self) -> int:
+        with self._lock:
+            return self._height
+
+    def size(self) -> int:
+        with self._lock:
+            return 0 if self._height == 0 else self._height - self._base + 1
+
+    def _save_state(self, sets: list) -> None:
+        sets.append((_STATE, struct.pack(">qq", self._base, self._height)))
+
+    def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit) -> None:
+        """Persist block meta + all parts + last_commit + seen_commit
+        atomically (reference :419-470)."""
+        height = block.header.height
+        with self._lock:
+            if self._height > 0 and height != self._height + 1:
+                raise ValueError(
+                    f"cannot save block at height {height}, expected {self._height + 1}"
+                )
+            if not part_set.is_complete():
+                raise ValueError("cannot save block with incomplete part set")
+            block_id = BlockID(hash=block.hash(), part_set_header=part_set.header())
+            meta = BlockMeta(
+                block_id=block_id,
+                block_size=part_set.byte_size,
+                header=block.header,
+                num_txs=len(block.data.txs),
+            )
+            sets: list[tuple[bytes, bytes]] = [
+                (_h(_META, height), meta.encode()),
+                (_HASH + block.hash(), struct.pack(">q", height)),
+            ]
+            for i in range(part_set.total):
+                part = part_set.get_part(i)
+                sets.append((_h(_PART, height) + struct.pack(">i", i), part.encode()))
+            if block.last_commit is not None:
+                sets.append((_h(_COMMIT, height - 1), block.last_commit.encode()))
+            sets.append((_h(_SEEN, height), seen_commit.encode()))
+            if self._base == 0:
+                self._base = height
+            self._height = height
+            self._save_state(sets)
+            self._db.write_batch(sets, [])
+
+    def load_block_meta(self, height: int) -> BlockMeta | None:
+        raw = self._db.get(_h(_META, height))
+        return BlockMeta.decode(raw) if raw is not None else None
+
+    def load_block(self, height: int) -> Block | None:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        parts = []
+        for i in range(meta.block_id.part_set_header.total):
+            raw = self._db.get(_h(_PART, height) + struct.pack(">i", i))
+            if raw is None:
+                return None
+            parts.append(Part.decode(raw).bytes_)
+        return Block.decode(b"".join(parts))
+
+    def load_block_by_hash(self, block_hash: bytes) -> Block | None:
+        raw = self._db.get(_HASH + block_hash)
+        if raw is None:
+            return None
+        return self.load_block(struct.unpack(">q", raw)[0])
+
+    def load_block_part(self, height: int, index: int) -> Part | None:
+        raw = self._db.get(_h(_PART, height) + struct.pack(">i", index))
+        return Part.decode(raw) if raw is not None else None
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """The canonical commit for block `height` (stored with height+1)."""
+        raw = self._db.get(_h(_COMMIT, height))
+        return Commit.decode(raw) if raw is not None else None
+
+    def load_seen_commit(self, height: int) -> Commit | None:
+        raw = self._db.get(_h(_SEEN, height))
+        return Commit.decode(raw) if raw is not None else None
+
+    def save_seen_commit(self, height: int, commit: Commit) -> None:
+        self._db.set(_h(_SEEN, height), commit.encode())
+
+    def prune_blocks(self, retain_height: int) -> int:
+        """Delete everything below retain_height (reference :285-330)."""
+        with self._lock:
+            if retain_height <= 0:
+                raise ValueError("retain height must be positive")
+            if retain_height > self._height:
+                raise ValueError("cannot prune beyond store height")
+            if retain_height <= self._base:
+                return 0
+            pruned = 0
+            deletes: list[bytes] = []
+            for h in range(self._base, retain_height):
+                meta = self.load_block_meta(h)
+                if meta is None:
+                    continue
+                deletes.append(_h(_META, h))
+                deletes.append(_HASH + meta.block_id.hash)
+                deletes.append(_h(_SEEN, h))
+                deletes.append(_h(_COMMIT, h))  # commit FOR block h
+                for i in range(meta.block_id.part_set_header.total):
+                    deletes.append(_h(_PART, h) + struct.pack(">i", i))
+                pruned += 1
+            self._base = retain_height
+            sets: list[tuple[bytes, bytes]] = []
+            self._save_state(sets)
+            self._db.write_batch(sets, deletes)
+            return pruned

@@ -1,0 +1,142 @@
+"""Ordered key-value store abstraction.
+
+The reference rides tm-db (goleveldb default, cgo rocksdb/cleveldb behind
+build tags — Makefile:33-48).  Here the interface is the same shape with
+two backends: MemDB (tests, in-proc nets) and SQLiteDB (durable, stdlib,
+transactional).  A native C++ engine can slot in behind the same interface
+in a later round without touching callers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import sqlite3
+import threading
+from typing import Iterator, Protocol
+
+
+class KVStore(Protocol):
+    def get(self, key: bytes) -> bytes | None: ...
+
+    def set(self, key: bytes, value: bytes) -> None: ...
+
+    def delete(self, key: bytes) -> None: ...
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None) -> Iterator[tuple[bytes, bytes]]: ...
+
+    def write_batch(self, sets: list[tuple[bytes, bytes]], deletes: list[bytes]) -> None: ...
+
+    def close(self) -> None: ...
+
+
+class MemDB:
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            if key not in self._data:
+                bisect.insort(self._keys, key)
+            self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            if key in self._data:
+                del self._data[key]
+                idx = bisect.bisect_left(self._keys, key)
+                del self._keys[idx]
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        with self._lock:
+            i = bisect.bisect_left(self._keys, start)
+            keys = []
+            while i < len(self._keys):
+                k = self._keys[i]
+                if end is not None and k >= end:
+                    break
+                keys.append(k)
+                i += 1
+            snapshot = [(k, self._data[k]) for k in keys]
+        yield from snapshot
+
+    def write_batch(self, sets, deletes) -> None:
+        with self._lock:
+            for k, v in sets:
+                self.set(k, v)
+            for k in deletes:
+                self.delete(k)
+
+    def close(self) -> None:
+        pass
+
+
+class SQLiteDB:
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+            )
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.commit()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv(k, v) VALUES(?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                (key, value),
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._conn.commit()
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        with self._lock:
+            if end is None:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (start,)
+                ).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k", (start, end)
+                ).fetchall()
+        yield from ((bytes(k), bytes(v)) for k, v in rows)
+
+    def write_batch(self, sets, deletes) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO kv(k, v) VALUES(?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                sets,
+            )
+            self._conn.executemany("DELETE FROM kv WHERE k = ?", [(k,) for k in deletes])
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def open_db(backend: str, path: str | None = None) -> KVStore:
+    if backend == "memdb":
+        return MemDB()
+    if backend == "sqlite":
+        if not path:
+            raise ValueError("sqlite backend requires a path")
+        return SQLiteDB(path)
+    raise ValueError(f"unknown db backend {backend!r}")

@@ -1,0 +1,2 @@
+from .service import Service
+from .log import new_logger, Logger

@@ -1,0 +1,66 @@
+"""Structured key-value logging (reference libs/log: leveled, per-module
+`With("module", ...)` fields)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+class Logger:
+    def __init__(self, base: logging.Logger, fields: dict | None = None):
+        self._base = base
+        self._fields = fields or {}
+
+    def with_(self, **fields) -> "Logger":
+        merged = dict(self._fields)
+        merged.update(fields)
+        return Logger(self._base, merged)
+
+    def _fmt(self, msg: str, kv: dict) -> str:
+        merged = dict(self._fields)
+        merged.update(kv)
+        if not merged:
+            return msg
+        tail = " ".join(f"{k}={v}" for k, v in merged.items())
+        return f"{msg} {tail}"
+
+    def debug(self, msg: str, **kv) -> None:
+        self._base.debug(self._fmt(msg, kv))
+
+    def info(self, msg: str, **kv) -> None:
+        self._base.info(self._fmt(msg, kv))
+
+    def warn(self, msg: str, **kv) -> None:
+        self._base.warning(self._fmt(msg, kv))
+
+    def error(self, msg: str, **kv) -> None:
+        self._base.error(self._fmt(msg, kv))
+
+
+_configured = False
+
+
+def new_logger(name: str = "tendermint_tpu", level: str = "info") -> Logger:
+    global _configured
+    base = logging.getLogger(name)
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname).1s %(name)s | %(message)s")
+        )
+        root = logging.getLogger("tendermint_tpu")
+        if not root.handlers:
+            root.addHandler(handler)
+        root.setLevel(getattr(logging, level.upper(), logging.INFO))
+        root.propagate = False
+        _configured = True
+    return Logger(base)
+
+
+def nop_logger() -> Logger:
+    base = logging.getLogger("tendermint_tpu.nop")
+    base.addHandler(logging.NullHandler())
+    base.propagate = False
+    base.setLevel(logging.CRITICAL + 1)
+    return Logger(base)

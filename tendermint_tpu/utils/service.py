@@ -1,0 +1,61 @@
+"""Service lifecycle: the rebuild's equivalent of the reference's
+BaseService (libs/service/service.go:24,97) — every long-lived object
+(node, reactors, mempool, WAL, transports) shares start/stop semantics.
+
+The reference guards with atomics + a Quit channel; here the runtime is
+asyncio, so a Service owns a set of tasks and an Event."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class Service:
+    def __init__(self, name: str | None = None):
+        self._name = name or type(self).__name__
+        self._started = False
+        self._stopped = False
+        self._quit = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    async def start(self) -> None:
+        if self._started:
+            raise RuntimeError(f"{self._name} already started")
+        self._started = True
+        await self.on_start()
+
+    async def stop(self) -> None:
+        if self._stopped or not self._started:
+            return
+        self._stopped = True
+        self._quit.set()
+        await self.on_stop()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+
+    def spawn(self, coro) -> asyncio.Task:
+        """Track a routine; cancelled on stop (goroutine-leak hygiene)."""
+        task = asyncio.get_event_loop().create_task(coro)
+        self._tasks.append(task)
+        return task
+
+    async def wait(self) -> None:
+        await self._quit.wait()
+
+    # hooks
+    async def on_start(self) -> None: ...
+
+    async def on_stop(self) -> None: ...

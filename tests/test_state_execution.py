@@ -1,0 +1,189 @@
+"""Persistence + execution: drive a real multi-block chain through
+BlockExecutor with a kvstore app, verifying state transitions, stores,
+validator updates, and commit verification along the way."""
+
+import pytest
+
+from tendermint_tpu.abci import AppConns
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from tendermint_tpu.store import BlockStore, MemDB
+from tendermint_tpu.types import (
+    BlockID,
+    BlockIDFlag,
+    Commit,
+    CommitSig,
+    GenesisDoc,
+    GenesisValidator,
+    SignedMsgType,
+    vote_sign_bytes_raw,
+)
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def make_chain_fixture(n_vals=4, power=10):
+    keys = [priv_key_from_seed(bytes([11 * i + 3]) * 32) for i in range(n_vals)]
+    genesis = GenesisDoc(
+        chain_id="exec-chain",
+        genesis_time_ns=1_700_000_000 * 10**9,
+        validators=[GenesisValidator(pub_key=k.pub_key(), power=power) for k in keys],
+    )
+    state = make_genesis_state(genesis)
+    key_by_addr = {k.pub_key().address(): k for k in keys}
+    return genesis, state, key_by_addr
+
+
+def sign_commit(chain_id, height, round_, block_id, val_set, key_by_addr, time_ns):
+    sigs = []
+    for v in val_set.validators:
+        k = key_by_addr[v.address]
+        sb = vote_sign_bytes_raw(
+            chain_id, SignedMsgType.PRECOMMIT, height, round_, block_id, time_ns
+        )
+        sigs.append(
+            CommitSig(
+                block_id_flag=BlockIDFlag.COMMIT,
+                validator_address=v.address,
+                timestamp_ns=time_ns,
+                signature=k.sign(sb),
+            )
+        )
+    return Commit(height=height, round=round_, block_id=block_id, signatures=sigs)
+
+
+class ChainDriver:
+    """Produce+apply blocks exactly as consensus would."""
+
+    def __init__(self, app=None):
+        self.genesis, self.state, self.key_by_addr = make_chain_fixture()
+        self.app = app or KVStoreApplication()
+        self.conns = AppConns(self.app)
+        self.db = MemDB()
+        self.state_store = StateStore(MemDB())
+        self.block_store = BlockStore(self.db)
+        # bootstrap: persist genesis state + pin doc hash (node assembly path)
+        self.state_store.save(self.state)
+        self.state_store.save_genesis_doc_hash(self.genesis.doc_hash())
+        self.executor = BlockExecutor(self.state_store, self.conns.consensus())
+        self.last_commit = Commit(
+            height=0, round=0, block_id=BlockID(), signatures=[]
+        )
+
+    def step(self, txs):
+        state = self.state
+        height = (
+            state.initial_height
+            if state.last_block_height == 0
+            else state.last_block_height + 1
+        )
+        proposer = state.validators.get_proposer()
+        block = self.executor.create_proposal_block(
+            height, state, self.last_commit, proposer.address
+        )
+        block.data.txs = list(txs)
+        block.header.data_hash = block.data.hash()
+        part_set = block.make_part_set()
+        block_id = BlockID(hash=block.hash(), part_set_header=part_set.header())
+        new_state, retain = self.executor.apply_block(state, block_id, block)
+        # everyone precommits for the block (vote time = block time + 1s)
+        seen_commit = sign_commit(
+            state.chain_id,
+            height,
+            0,
+            block_id,
+            new_state.validators if False else state.validators,
+            self.key_by_addr,
+            block.header.time_ns + 10**9,
+        )
+        self.block_store.save_block(block, part_set, seen_commit)
+        self.last_commit = seen_commit
+        self.state = new_state
+        return block, block_id, retain
+
+
+def test_apply_five_blocks_kvstore():
+    driver = ChainDriver()
+    app = driver.app
+    hashes = []
+    for h in range(1, 6):
+        block, block_id, _ = driver.step([f"k{h}=v{h}".encode()])
+        hashes.append(block.hash())
+        assert driver.state.last_block_height == h
+        assert app.height == h
+    # app state reflects all txs
+    assert app.state == {f"k{h}".encode(): f"v{h}".encode() for h in range(1, 6)}
+    # header chaining: block h's app_hash is the app hash after h-1
+    b5 = driver.block_store.load_block(5)
+    assert b5 is not None and b5.header.last_block_id.hash == hashes[3]
+    # stores
+    assert driver.block_store.height() == 5 and driver.block_store.base() == 1
+    st = driver.state_store.load()
+    assert st.last_block_height == 5
+    assert st.validators.hash() == driver.state.validators.hash()
+    # last commit of block 5 verifies against validators at height 4
+    vals4 = driver.state_store.load_validators(4)
+    assert vals4 is not None
+    vals4.verify_commit(
+        "exec-chain", b5.header.last_block_id, 4, b5.last_commit
+    )
+
+
+def test_invalid_block_rejected():
+    driver = ChainDriver()
+    driver.step([b"a=1"])
+    state = driver.state
+    block = driver.executor.create_proposal_block(
+        2, state, driver.last_commit, state.validators.get_proposer().address
+    )
+    block.header.app_hash = b"\x00" * 32  # wrong app hash
+    ps = block.make_part_set()
+    bid = BlockID(hash=block.hash(), part_set_header=ps.header())
+    with pytest.raises(ValueError, match="AppHash"):
+        driver.executor.apply_block(state, bid, block)
+
+
+def test_validator_update_via_tx():
+    driver = ChainDriver()
+    newkey = priv_key_from_seed(b"\x55" * 32)
+    driver.key_by_addr[newkey.pub_key().address()] = newkey  # it will co-sign
+    tx = b"val:" + newkey.pub_key().bytes_().hex().encode() + b"!7"
+    driver.step([tx])
+    # validator set changes take effect at H+2
+    assert not driver.state.validators.has_address(newkey.pub_key().address())
+    assert driver.state.next_validators.has_address(newkey.pub_key().address())
+    driver.step([b"x=y"])
+    assert driver.state.validators.has_address(newkey.pub_key().address())
+    # removal
+    tx2 = b"val:" + newkey.pub_key().bytes_().hex().encode() + b"!0"
+    driver.step([tx2])
+    driver.step([b"z=1"])
+    assert not driver.state.validators.has_address(newkey.pub_key().address())
+
+
+def test_abci_responses_persisted_and_results_hash_chained():
+    driver = ChainDriver()
+    driver.step([b"k=v", b"k2=v2"])
+    responses = driver.state_store.load_abci_responses(1)
+    assert responses is not None and len(responses.deliver_txs) == 2
+    assert driver.state.last_results_hash == responses.results_hash()
+    block2, _, _ = driver.step([b"k3=v3"])
+    assert block2.header.last_results_hash == responses.results_hash()
+
+
+def test_block_store_prune():
+    driver = ChainDriver()
+    for h in range(1, 6):
+        driver.step([f"p{h}=1".encode()])
+    pruned = driver.block_store.prune_blocks(3)
+    assert pruned == 2
+    assert driver.block_store.base() == 3
+    assert driver.block_store.load_block(2) is None
+    assert driver.block_store.load_block(3) is not None
