@@ -133,23 +133,33 @@ class CounterApplication(abci.BaseApplication):
             data=json.dumps({"txs": self.tx_count}), last_block_height=self.height
         )
 
-    def _check(self, tx: bytes, expected: int) -> int:
-        if not self.serial:
-            return abci.CodeTypeOK
+    def _parse(self, tx: bytes) -> int | None:
         if len(tx) > 8:
-            return 1
-        value = int.from_bytes(tx, "big")
-        return abci.CodeTypeOK if value == expected else 2
+            return None
+        return int.from_bytes(tx, "big")
 
     def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
-        code = self._check(req.tx, self.tx_count)
-        return abci.ResponseCheckTx(code=code)
+        """CheckTx admits any not-yet-delivered value (reference counter:
+        value < txCount is the only rejection); DeliverTx is the strict
+        serial gate."""
+        if not self.serial:
+            return abci.ResponseCheckTx(code=abci.CodeTypeOK)
+        value = self._parse(req.tx)
+        if value is None:
+            return abci.ResponseCheckTx(code=1, log="tx too long")
+        if value < self.tx_count:
+            return abci.ResponseCheckTx(code=2, log="stale counter value")
+        return abci.ResponseCheckTx(code=abci.CodeTypeOK)
 
     def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
-        code = self._check(req.tx, self.tx_count)
-        if code == abci.CodeTypeOK:
-            self.tx_count += 1
-        return abci.ResponseDeliverTx(code=code)
+        if self.serial:
+            value = self._parse(req.tx)
+            if value is None:
+                return abci.ResponseDeliverTx(code=1, log="tx too long")
+            if value != self.tx_count:
+                return abci.ResponseDeliverTx(code=2, log="out-of-order counter value")
+        self.tx_count += 1
+        return abci.ResponseDeliverTx(code=abci.CodeTypeOK)
 
     def commit(self) -> abci.ResponseCommit:
         self.height += 1

@@ -1,0 +1,34 @@
+from .messages import (
+    BlockPartMessage,
+    EndHeightMessage,
+    HasVoteMessage,
+    MsgInfo,
+    NewRoundStepMessage,
+    NewValidBlockMessage,
+    ProposalMessage,
+    ProposalPOLMessage,
+    TimeoutInfo,
+    VoteMessage,
+    VoteSetBitsMessage,
+    VoteSetMaj23Message,
+)
+from .ticker import TimeoutTicker
+from .wal import WAL, NopWAL
+
+__all__ = [
+    "BlockPartMessage",
+    "EndHeightMessage",
+    "HasVoteMessage",
+    "MsgInfo",
+    "NewRoundStepMessage",
+    "NewValidBlockMessage",
+    "ProposalMessage",
+    "ProposalPOLMessage",
+    "TimeoutInfo",
+    "VoteMessage",
+    "VoteSetBitsMessage",
+    "VoteSetMaj23Message",
+    "WAL",
+    "NopWAL",
+    "TimeoutTicker",
+]

@@ -1,0 +1,49 @@
+"""Consensus timing/behaviour knobs.
+
+Parity: reference config/config.go:844-940 (ConsensusConfig) — propose /
+prevote / precommit timeouts with per-round escalation deltas, commit
+timeout, skip-timeout-commit, empty-block creation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ConsensusConfig:
+    timeout_propose_ms: int = 3000
+    timeout_propose_delta_ms: int = 500
+    timeout_prevote_ms: int = 1000
+    timeout_prevote_delta_ms: int = 500
+    timeout_precommit_ms: int = 1000
+    timeout_precommit_delta_ms: int = 500
+    timeout_commit_ms: int = 1000
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ms: int = 0
+    double_sign_check_height: int = 0
+
+    def propose_timeout(self, round_: int) -> int:
+        return self.timeout_propose_ms + self.timeout_propose_delta_ms * round_
+
+    def prevote_timeout(self, round_: int) -> int:
+        return self.timeout_prevote_ms + self.timeout_prevote_delta_ms * round_
+
+    def precommit_timeout(self, round_: int) -> int:
+        return self.timeout_precommit_ms + self.timeout_precommit_delta_ms * round_
+
+    @classmethod
+    def test_config(cls) -> "ConsensusConfig":
+        """Fast timeouts for in-proc tests (reference TestConsensusConfig:
+        40ms-class timeouts, skip_timeout_commit=True)."""
+        return cls(
+            timeout_propose_ms=400,
+            timeout_propose_delta_ms=100,
+            timeout_prevote_ms=200,
+            timeout_prevote_delta_ms=100,
+            timeout_precommit_ms=200,
+            timeout_precommit_delta_ms=100,
+            timeout_commit_ms=50,
+            skip_timeout_commit=True,
+        )

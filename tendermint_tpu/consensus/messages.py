@@ -1,0 +1,404 @@
+"""Consensus messages: reactor gossip payloads + WAL records.
+
+Parity: reference consensus/msgs.go and
+proto/tendermint/consensus/types.proto (gossip messages), consensus/wal.go
+WALMessage union + proto/tendermint/consensus/wal.proto (WAL records).
+Each message carries its proto field layout in the docstring; encoding is
+via the deterministic ProtoWriter, decoding via fields_to_dict.
+
+The WAL record union wraps each variant under a distinct field number
+(MsgInfo=1, TimeoutInfo=2, EndHeight=3, RoundStateEvent=4) mirroring the
+reference's WALMessage oneof.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+from tendermint_tpu.types import BlockID, Proposal, Vote
+from tendermint_tpu.types.basic import PartSetHeader, SignedMsgType
+from tendermint_tpu.types.part_set import Part
+from tendermint_tpu.utils.bits import BitArray
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict, to_int64
+
+
+# ---------------------------------------------------------------------------
+# gossip messages (consensus channels 0x20-0x23)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NewRoundStepMessage:
+    """NewRoundStep{height=1, round=2, step=3, seconds_since_start_time=4,
+    last_commit_round=5}."""
+
+    height: int
+    round: int
+    step: int
+    seconds_since_start_time: int = 0
+    last_commit_round: int = 0
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .varint(3, self.step)
+            .varint(4, self.seconds_since_start_time)
+            .varint(5, self.last_commit_round)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NewRoundStepMessage":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        return cls(g(1), g(2), g(3), g(4), g(5))
+
+
+@dataclass
+class NewValidBlockMessage:
+    """NewValidBlock{height=1, round=2, block_part_set_header=3,
+    block_parts=4 (BitArray), is_commit=5}."""
+
+    height: int
+    round: int
+    block_part_set_header: PartSetHeader
+    block_parts: BitArray
+    is_commit: bool = False
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .message(3, self.block_part_set_header.encode(), always=True)
+            .message(4, self.block_parts.encode(), always=True)
+            .bool_(5, self.is_commit)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NewValidBlockMessage":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        return cls(
+            height=g(1),
+            round=g(2),
+            block_part_set_header=PartSetHeader.decode(f.get(3, [b""])[0]),
+            block_parts=BitArray.decode(f.get(4, [b""])[0]),
+            is_commit=bool(g(5)),
+        )
+
+
+@dataclass
+class ProposalMessage:
+    """Proposal{proposal=1}."""
+
+    proposal: Proposal
+
+    def encode(self) -> bytes:
+        return ProtoWriter().message(1, self.proposal.encode(), always=True).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ProposalMessage":
+        f = fields_to_dict(data)
+        return cls(Proposal.decode(f[1][0]))
+
+
+@dataclass
+class ProposalPOLMessage:
+    """ProposalPOL{height=1, proposal_pol_round=2, proposal_pol=3}."""
+
+    height: int
+    proposal_pol_round: int
+    proposal_pol: BitArray
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.proposal_pol_round)
+            .message(3, self.proposal_pol.encode(), always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ProposalPOLMessage":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        return cls(g(1), g(2), BitArray.decode(f.get(3, [b""])[0]))
+
+
+@dataclass
+class BlockPartMessage:
+    """BlockPart{height=1, round=2, part=3}."""
+
+    height: int
+    round: int
+    part: Part
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .message(3, self.part.encode(), always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockPartMessage":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        return cls(g(1), g(2), Part.decode(f[3][0]))
+
+
+@dataclass
+class VoteMessage:
+    """Vote{vote=1}."""
+
+    vote: Vote
+
+    def encode(self) -> bytes:
+        return ProtoWriter().message(1, self.vote.encode(), always=True).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VoteMessage":
+        f = fields_to_dict(data)
+        return cls(Vote.decode(f[1][0]))
+
+
+@dataclass
+class HasVoteMessage:
+    """HasVote{height=1, round=2, type=3, index=4}."""
+
+    height: int
+    round: int
+    type: SignedMsgType
+    index: int
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .varint(3, int(self.type))
+            .varint(4, self.index)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "HasVoteMessage":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        return cls(g(1), g(2), SignedMsgType(g(3)), g(4))
+
+
+@dataclass
+class VoteSetMaj23Message:
+    """VoteSetMaj23{height=1, round=2, type=3, block_id=4}."""
+
+    height: int
+    round: int
+    type: SignedMsgType
+    block_id: BlockID
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .varint(3, int(self.type))
+            .message(4, self.block_id.encode(), always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VoteSetMaj23Message":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        return cls(g(1), g(2), SignedMsgType(g(3)), BlockID.decode(f.get(4, [b""])[0]))
+
+
+@dataclass
+class VoteSetBitsMessage:
+    """VoteSetBits{height=1, round=2, type=3, block_id=4, votes=5}."""
+
+    height: int
+    round: int
+    type: SignedMsgType
+    block_id: BlockID
+    votes: BitArray
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .varint(3, int(self.type))
+            .message(4, self.block_id.encode(), always=True)
+            .message(5, self.votes.encode(), always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VoteSetBitsMessage":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        return cls(
+            g(1),
+            g(2),
+            SignedMsgType(g(3)),
+            BlockID.decode(f.get(4, [b""])[0]),
+            BitArray.decode(f.get(5, [b""])[0]),
+        )
+
+
+_GOSSIP_TYPES: list[type] = [
+    NewRoundStepMessage,
+    NewValidBlockMessage,
+    ProposalMessage,
+    ProposalPOLMessage,
+    BlockPartMessage,
+    VoteMessage,
+    HasVoteMessage,
+    VoteSetMaj23Message,
+    VoteSetBitsMessage,
+]
+# stable union field numbers (1-based) for channel framing + WAL msg_info
+_GOSSIP_FIELD = {t: i + 1 for i, t in enumerate(_GOSSIP_TYPES)}
+
+
+def encode_consensus_message(msg) -> bytes:
+    """Wrap a gossip message in the Message oneof envelope
+    (proto/tendermint/consensus/types.proto Message{new_round_step=1,
+    new_valid_block=2, proposal=3, proposal_pol=4, block_part=5, vote=6,
+    has_vote=7, vote_set_maj23=8, vote_set_bits=9})."""
+    fld = _GOSSIP_FIELD[type(msg)]
+    return ProtoWriter().message(fld, msg.encode(), always=True).bytes_out()
+
+
+def decode_consensus_message(data: bytes):
+    f = fields_to_dict(data)
+    for t, fld in _GOSSIP_FIELD.items():
+        if fld in f:
+            return t.decode(f[fld][0])
+    raise ValueError("unknown consensus message")
+
+
+# ---------------------------------------------------------------------------
+# WAL records
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MsgInfo:
+    """A consensus message with its origin (empty peer_id = internal).
+    Reference consensus/state.go msgInfo."""
+
+    msg: object  # ProposalMessage | BlockPartMessage | VoteMessage | ...
+    peer_id: str = ""
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .message(1, encode_consensus_message(self.msg), always=True)
+            .string(2, self.peer_id)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "MsgInfo":
+        f = fields_to_dict(data)
+        peer = f.get(2, [b""])[0]
+        if isinstance(peer, bytes):
+            peer = peer.decode()
+        return cls(decode_consensus_message(f[1][0]), peer)
+
+
+@dataclass
+class TimeoutInfo:
+    """A scheduled timeout firing (reference timeoutInfo / wal.proto
+    TimeoutInfo{duration=1, height=2, round=3, step=4})."""
+
+    duration_ms: int
+    height: int
+    round: int
+    step: int
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.duration_ms)
+            .varint(2, self.height)
+            .varint(3, self.round)
+            .varint(4, self.step)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TimeoutInfo":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        return cls(g(1), g(2), g(3), g(4))
+
+
+@dataclass
+class EndHeightMessage:
+    """Commit barrier: height H fully committed (reference
+    EndHeightMessage, wal.go:38)."""
+
+    height: int
+
+    def encode(self) -> bytes:
+        return ProtoWriter().varint(1, self.height).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "EndHeightMessage":
+        f = fields_to_dict(data)
+        return cls(to_int64(f.get(1, [0])[0]))
+
+
+@dataclass
+class RoundStateEvent:
+    """EventDataRoundState record (reference logs these on step change)."""
+
+    height: int
+    round: int
+    step: str
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.round)
+            .string(3, self.step)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RoundStateEvent":
+        f = fields_to_dict(data)
+        g = lambda n: to_int64(f.get(n, [0])[0])
+        step = f.get(3, [b""])[0]
+        if isinstance(step, bytes):
+            step = step.decode()
+        return cls(g(1), g(2), step)
+
+
+_WAL_FIELD = {MsgInfo: 1, TimeoutInfo: 2, EndHeightMessage: 3, RoundStateEvent: 4}
+_WAL_TYPES = {v: k for k, v in _WAL_FIELD.items()}
+
+
+def encode_wal_message(msg) -> bytes:
+    fld = _WAL_FIELD[type(msg)]
+    return ProtoWriter().message(fld, msg.encode(), always=True).bytes_out()
+
+
+def decode_wal_message(data: bytes):
+    f = fields_to_dict(data)
+    for fld, t in _WAL_TYPES.items():
+        if fld in f:
+            return t.decode(f[fld][0])
+    raise ValueError("unknown WAL message")

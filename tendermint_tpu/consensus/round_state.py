@@ -1,0 +1,128 @@
+"""RoundState + HeightVoteSet: the consensus state machine's data model.
+
+Parity: reference consensus/types/round_state.go:67 (RoundState, step
+enum) and consensus/types/height_vote_set.go:41 (HeightVoteSet — one
+prevote/precommit VoteSet per round, plus per-peer catchup-round
+admission limiting the rounds a peer may claim majorities for).
+"""
+
+from __future__ import annotations
+
+import enum
+
+from tendermint_tpu.types import BlockID, ValidatorSet, VoteSet
+from tendermint_tpu.types.basic import SignedMsgType
+
+
+class Step(enum.IntEnum):
+    NEW_HEIGHT = 1
+    NEW_ROUND = 2
+    PROPOSE = 3
+    PREVOTE = 4
+    PREVOTE_WAIT = 5
+    PRECOMMIT = 6
+    PRECOMMIT_WAIT = 7
+    COMMIT = 8
+
+
+class HeightVoteSet:
+    """Keeps one prevote + one precommit VoteSet for every round of one
+    height.  Peer-initiated rounds (vote-set catchup) are bounded to 2 per
+    peer (reference height_vote_set.go:24-30)."""
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self.round = 0
+        self._round_vote_sets: dict[int, dict[SignedMsgType, VoteSet]] = {}
+        self._peer_catchup_rounds: dict[str, list[int]] = {}
+        self._add_round(0)
+
+    def _add_round(self, round_: int) -> None:
+        if round_ in self._round_vote_sets:
+            return
+        self._round_vote_sets[round_] = {
+            SignedMsgType.PREVOTE: VoteSet(
+                self.chain_id, self.height, round_, SignedMsgType.PREVOTE, self.val_set
+            ),
+            SignedMsgType.PRECOMMIT: VoteSet(
+                self.chain_id, self.height, round_, SignedMsgType.PRECOMMIT, self.val_set
+            ),
+        }
+
+    def set_round(self, round_: int) -> None:
+        """Ensure vote sets exist up to round+1 (reference SetRound)."""
+        new_round = max(self.round, 0)
+        for r in range(new_round, round_ + 2):
+            self._add_round(r)
+        self.round = round_
+
+    def prevotes(self, round_: int) -> VoteSet | None:
+        return self._get(round_, SignedMsgType.PREVOTE)
+
+    def precommits(self, round_: int) -> VoteSet | None:
+        return self._get(round_, SignedMsgType.PRECOMMIT)
+
+    def _get(self, round_: int, t: SignedMsgType) -> VoteSet | None:
+        rvs = self._round_vote_sets.get(round_)
+        return rvs[t] if rvs else None
+
+    def add_vote(self, vote, peer_id: str = "") -> bool:
+        """Admit a vote; unexpected rounds from peers are allowed for at
+        most 2 catchup rounds per peer (DoS bound)."""
+        if vote.type not in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):
+            raise ValueError(f"unexpected vote type {vote.type}")
+        vote_set = self._get(vote.round, vote.type)
+        if vote_set is None:
+            rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+            if len(rounds) < 2:
+                self._add_round(vote.round)
+                vote_set = self._get(vote.round, vote.type)
+                rounds.append(vote.round)
+            else:
+                raise ValueError("peer exceeded catchup-round limit")
+        return vote_set.add_vote(vote)
+
+    def pol_info(self) -> tuple[int, BlockID | None]:
+        """Highest round with a prevote polka (reference POLInfo)."""
+        for r in sorted(self._round_vote_sets.keys(), reverse=True):
+            vs = self.prevotes(r)
+            if vs is not None:
+                maj = vs.two_thirds_majority()
+                if maj is not None:
+                    return r, maj
+        return -1, None
+
+    def set_peer_maj23(self, round_: int, t: SignedMsgType, peer_id: str, block_id) -> None:
+        self._add_round(round_)
+        self._get(round_, t).set_peer_maj23(peer_id, block_id)
+
+
+class RoundState:
+    """Mutable per-height round state (reference round_state.go:67)."""
+
+    def __init__(self):
+        self.height = 0
+        self.round = 0
+        self.step: Step = Step.NEW_HEIGHT
+        self.start_time_ns = 0
+        self.commit_time_ns = 0
+        self.validators: ValidatorSet | None = None
+        self.proposal = None  # Proposal
+        self.proposal_block = None  # Block
+        self.proposal_block_parts = None  # PartSet
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        self.valid_round = -1
+        self.valid_block = None
+        self.valid_block_parts = None
+        self.votes: HeightVoteSet | None = None
+        self.commit_round = -1
+        self.last_commit: VoteSet | None = None
+        self.last_validators: ValidatorSet | None = None
+        self.triggered_timeout_precommit = False
+
+    def height_round_step(self) -> tuple[int, int, int]:
+        return self.height, self.round, int(self.step)

@@ -1,0 +1,47 @@
+"""Timeout scheduler for the consensus state machine.
+
+Parity: reference consensus/ticker.go:20-134 — ONE pending timeout at a
+time; scheduling a new one replaces the old only when the new (height,
+round, step) is >= the pending one (stale ticks for earlier rounds are
+dropped).  The reference runs a timer goroutine with tick/tock channels;
+here a single asyncio task per scheduled timeout delivers the fired
+TimeoutInfo into an asyncio.Queue the state machine selects on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .messages import TimeoutInfo
+
+
+class TimeoutTicker:
+    def __init__(self):
+        self.tock: asyncio.Queue[TimeoutInfo] = asyncio.Queue()
+        self._pending: TimeoutInfo | None = None
+        self._task: asyncio.Task | None = None
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        """Replace the pending timeout iff ti is for a later (H,R,S)
+        (reference timeoutRoutine: new tick must be >= pending)."""
+        p = self._pending
+        if p is not None and (ti.height, ti.round, ti.step) < (p.height, p.round, p.step):
+            return
+        self._cancel()
+        self._pending = ti
+        self._task = asyncio.get_running_loop().create_task(self._fire(ti))
+
+    async def _fire(self, ti: TimeoutInfo) -> None:
+        await asyncio.sleep(ti.duration_ms / 1000.0)
+        if self._pending is ti:
+            self._pending = None
+            self.tock.put_nowait(ti)
+
+    def _cancel(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = None
+        self._pending = None
+
+    def stop(self) -> None:
+        self._cancel()

@@ -1,0 +1,12 @@
+from .cache import LRUTxCache, NopTxCache
+from .mempool import Mempool, MempoolTx, TxInCacheError, TxTooLargeError, MempoolFullError
+
+__all__ = [
+    "LRUTxCache",
+    "NopTxCache",
+    "Mempool",
+    "MempoolTx",
+    "TxInCacheError",
+    "TxTooLargeError",
+    "MempoolFullError",
+]

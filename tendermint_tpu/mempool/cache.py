@@ -1,0 +1,54 @@
+"""Tx cache: dedup filter in front of CheckTx.
+
+Parity: reference mempool/cache.go — LRU keyed by tx hash (map + list);
+`Push` returns False when already present, `Remove` evicts (used when a
+tx fails CheckTx so it can be resubmitted later).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from tendermint_tpu.crypto.tmhash import sum_sha256
+
+
+class LRUTxCache:
+    def __init__(self, size: int):
+        self._size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+
+    def reset(self) -> None:
+        self._map.clear()
+
+    def push(self, tx: bytes) -> bool:
+        """Returns True if tx was newly added, False if already cached."""
+        key = sum_sha256(tx)
+        if key in self._map:
+            self._map.move_to_end(key)
+            return False
+        self._map[key] = None
+        if len(self._map) > self._size:
+            self._map.popitem(last=False)
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        self._map.pop(sum_sha256(tx), None)
+
+    def has(self, tx: bytes) -> bool:
+        return sum_sha256(tx) in self._map
+
+
+class NopTxCache:
+    """Cache disabled (config cache_size=0)."""
+
+    def reset(self) -> None:
+        pass
+
+    def push(self, tx: bytes) -> bool:
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        pass
+
+    def has(self, tx: bytes) -> bool:
+        return False

@@ -1,0 +1,289 @@
+"""Mempool: ordered pool of CheckTx-validated transactions.
+
+Parity: reference mempool/clist_mempool.go:235-782 —
+check_tx (cache dedup → ABCI CheckTx → insert with gas/bytes accounting),
+reap_max_bytes_max_gas for proposals, update on commit (remove committed
+txs then re-CheckTx the remainder), pre/post-check filters from state
+(state/services.go), txs-available notification.
+
+TPU-first redesign notes: the reference's concurrent CList + per-peer
+goroutine iterators become a plain insertion-ordered dict walked by async
+gossip tasks; the app connection is the serialized local client, so the
+async CheckTx pipeline collapses to direct calls.  Fairness/ordering and
+recheck semantics are preserved exactly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from tendermint_tpu import abci
+from tendermint_tpu.crypto.tmhash import sum_sha256
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .cache import LRUTxCache, NopTxCache
+
+
+class TxInCacheError(Exception):
+    pass
+
+
+class TxTooLargeError(Exception):
+    def __init__(self, max_size: int, actual: int):
+        super().__init__(f"tx too large: max {max_size}, got {actual}")
+
+
+class MempoolFullError(Exception):
+    def __init__(self, num_txs: int, total_bytes: int):
+        super().__init__(f"mempool full: {num_txs} txs, {total_bytes} bytes")
+
+
+class PreCheckError(Exception):
+    pass
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000
+    max_txs_bytes: int = 1024 * 1024 * 1024  # 1GB
+    cache_size: int = 10000
+    max_tx_bytes: int = 1024 * 1024
+    keep_invalid_txs_in_cache: bool = False
+    recheck: bool = True
+
+
+@dataclass
+class MempoolTx:
+    tx: bytes
+    height: int  # height at which tx entered the pool
+    gas_wanted: int
+    senders: set[str] = field(default_factory=set)  # peer IDs that sent it
+
+
+def pre_check_max_bytes(max_bytes: int):
+    """PreCheckMaxBytes from state params (reference state/services.go)."""
+
+    def check(tx: bytes) -> None:
+        if len(tx) > max_bytes:
+            raise PreCheckError(f"tx size {len(tx)} exceeds block max data bytes {max_bytes}")
+
+    return check
+
+
+def post_check_max_gas(max_gas: int):
+    """PostCheckMaxGas (reference state/services.go)."""
+
+    def check(tx: bytes, res: abci.ResponseCheckTx) -> None:
+        if res.gas_wanted < 0:
+            raise PreCheckError("gas wanted cannot be negative")
+        if max_gas >= 0 and res.gas_wanted > max_gas:
+            raise PreCheckError(f"gas wanted {res.gas_wanted} exceeds block max gas {max_gas}")
+
+    return check
+
+
+class Mempool:
+    def __init__(
+        self,
+        config: MempoolConfig,
+        app_conn: "abci.LocalClient",
+        height: int = 0,
+        logger: Logger | None = None,
+    ):
+        self.config = config
+        self.app = app_conn
+        self.height = height
+        self.logger = logger or nop_logger()
+        self.cache = LRUTxCache(config.cache_size) if config.cache_size > 0 else NopTxCache()
+        self._txs: OrderedDict[bytes, MempoolTx] = OrderedDict()  # key: sha256(tx)
+        self._total_bytes = 0
+        self._lock = asyncio.Lock()  # held by consensus across Commit+Update
+        self._locked = False
+        self.pre_check = None  # callable(tx) -> None, raises to reject
+        self.post_check = None  # callable(tx, ResponseCheckTx) -> None
+        self._txs_available: asyncio.Event | None = None
+        self._notified_txs_available = False
+
+    # -- notification ---------------------------------------------------
+    def enable_txs_available(self) -> None:
+        self._txs_available = asyncio.Event()
+
+    def txs_available(self) -> asyncio.Event:
+        assert self._txs_available is not None, "call enable_txs_available first"
+        return self._txs_available
+
+    def _notify_txs_available(self) -> None:
+        if self._txs_available is not None and self._txs and not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available.set()
+
+    # -- size -----------------------------------------------------------
+    def size(self) -> int:
+        return len(self._txs)
+
+    def tx_bytes(self) -> int:
+        return self._total_bytes
+
+    def is_full(self, tx_len: int) -> None:
+        if (
+            len(self._txs) >= self.config.size
+            or tx_len + self._total_bytes > self.config.max_txs_bytes
+        ):
+            raise MempoolFullError(len(self._txs), self._total_bytes)
+
+    # -- lock (held by BlockExecutor.Commit) -----------------------------
+    def lock(self) -> None:
+        self._locked = True
+
+    def unlock(self) -> None:
+        self._locked = False
+
+    def flush_app_conn(self) -> None:
+        self.app.flush_sync()
+
+    # -- CheckTx ---------------------------------------------------------
+    def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        """Validate tx via cache + app and insert on OK.
+
+        Reference CheckTx (clist_mempool.go:235-362).  Raises on
+        structural rejection; returns the app's ResponseCheckTx otherwise
+        (res.code != 0 means app rejection; tx is not inserted).
+        """
+        if len(tx) > self.config.max_tx_bytes:
+            raise TxTooLargeError(self.config.max_tx_bytes, len(tx))
+        if self.pre_check is not None:
+            self.pre_check(tx)
+
+        if not self.cache.push(tx):
+            # record the new sender for an existing tx (gossip dedup)
+            key = sum_sha256(tx)
+            memtx = self._txs.get(key)
+            if memtx is not None and sender:
+                memtx.senders.add(sender)
+            raise TxInCacheError("tx already exists in cache")
+
+        try:
+            self.is_full(len(tx))
+        except MempoolFullError:
+            self.cache.remove(tx)
+            raise
+
+        res = self.app.check_tx_sync(abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW))
+        self._res_cb_first_time(tx, sender, res)
+        return res
+
+    def _res_cb_first_time(self, tx: bytes, sender: str, res: abci.ResponseCheckTx) -> None:
+        if res.code == abci.CodeTypeOK:
+            post_ok = True
+            if self.post_check is not None:
+                try:
+                    self.post_check(tx, res)
+                except Exception:
+                    post_ok = False
+            if post_ok:
+                memtx = MempoolTx(
+                    tx=tx, height=self.height, gas_wanted=res.gas_wanted
+                )
+                if sender:
+                    memtx.senders.add(sender)
+                self._txs[sum_sha256(tx)] = memtx
+                self._total_bytes += len(tx)
+                self._notify_txs_available()
+                return
+        # invalid: evict from cache unless configured to keep
+        if not self.config.keep_invalid_txs_in_cache:
+            self.cache.remove(tx)
+
+    # -- Reap ------------------------------------------------------------
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        """Collect txs in order up to byte/gas caps (reference :497-540).
+        max_bytes/max_gas < 0 mean unlimited."""
+        total_bytes = 0
+        total_gas = 0
+        out: list[bytes] = []
+        for memtx in self._txs.values():
+            n = len(memtx.tx)
+            if max_bytes > -1 and total_bytes + n > max_bytes:
+                break
+            total_bytes += n
+            new_gas = total_gas + memtx.gas_wanted
+            if max_gas > -1 and new_gas > max_gas:
+                break
+            total_gas = new_gas
+            out.append(memtx.tx)
+        return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        if n < 0:
+            n = len(self._txs)
+        return [m.tx for m in list(self._txs.values())[:n]]
+
+    # -- Update on commit -------------------------------------------------
+    def update(
+        self,
+        height: int,
+        txs: list[bytes],
+        deliver_tx_responses: list,
+        pre_check=None,
+        post_check=None,
+    ) -> None:
+        """Called by BlockExecutor under lock() after Commit
+        (reference Update :546-612): advance height, pin committed valid
+        txs in cache (so they can't re-enter), drop committed txs from the
+        pool, then recheck what remains."""
+        self.height = height
+        self._notified_txs_available = False
+        if self._txs_available is not None:
+            self._txs_available.clear()
+        if pre_check is not None:
+            self.pre_check = pre_check
+        if post_check is not None:
+            self.post_check = post_check
+
+        for tx, res in zip(txs, deliver_tx_responses):
+            if res.code == abci.CodeTypeOK:
+                self.cache.push(tx)  # committed: never valid again
+            elif not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            key = sum_sha256(tx)
+            memtx = self._txs.pop(key, None)
+            if memtx is not None:
+                self._total_bytes -= len(memtx.tx)
+
+        if self._txs and self.config.recheck:
+            self._recheck_txs()
+        self._notify_txs_available()
+
+    def _recheck_txs(self) -> None:
+        """Re-run CheckTx(RECHECK) over all remaining txs, evicting those
+        now invalid (reference recheckTxs :690-720)."""
+        for key in list(self._txs.keys()):
+            memtx = self._txs.get(key)
+            if memtx is None:
+                continue
+            res = self.app.check_tx_sync(
+                abci.RequestCheckTx(tx=memtx.tx, type=abci.CheckTxType.RECHECK)
+            )
+            valid = res.code == abci.CodeTypeOK
+            if valid and self.post_check is not None:
+                try:
+                    self.post_check(memtx.tx, res)
+                except Exception:
+                    valid = False
+            if not valid:
+                del self._txs[key]
+                self._total_bytes -= len(memtx.tx)
+                if not self.config.keep_invalid_txs_in_cache:
+                    self.cache.remove(memtx.tx)
+
+    def flush(self) -> None:
+        """Remove everything (RPC unsafe_flush_mempool)."""
+        self._txs.clear()
+        self._total_bytes = 0
+        self.cache.reset()
+
+    # -- gossip iteration --------------------------------------------------
+    def entries(self) -> list[MempoolTx]:
+        return list(self._txs.values())

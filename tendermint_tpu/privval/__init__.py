@@ -1,0 +1,3 @@
+from .file_pv import FilePV, DoubleSignError, load_or_gen_file_pv
+
+__all__ = ["FilePV", "DoubleSignError", "load_or_gen_file_pv"]

@@ -1,0 +1,220 @@
+"""File-backed private validator with last-sign-state protection.
+
+Parity: reference privval/file.go — key file (immutable) + state file
+(mutated on every sign); CheckHRS regression check (file.go:87-126)
+refuses to sign at a (height, round, step) lower than the last signed
+one, and at an equal HRS only re-returns the saved signature for an
+identical (or timestamp-only-differing) message.  Step ordering:
+Propose=1 < Prevote=2 < Precommit=3.
+
+State file writes go through a temp-file + atomic rename + fsync so a
+crash can never roll the sign-state backward (the double-sign guard).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from tendermint_tpu.crypto.keys import PrivKey, PubKey, gen_priv_key
+from tendermint_tpu.types import Proposal, Vote
+from tendermint_tpu.types.basic import SignedMsgType
+
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+_VOTE_STEP = {
+    SignedMsgType.PREVOTE: STEP_PREVOTE,
+    SignedMsgType.PRECOMMIT: STEP_PRECOMMIT,
+}
+
+
+class DoubleSignError(Exception):
+    pass
+
+
+def _atomic_write(path: str, data: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".pv-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class _LastSignState:
+    """privval/file.go FilePVLastSignState."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.height = 0
+        self.round = 0
+        self.step = 0
+        self.signature = b""
+        self.sign_bytes = b""
+
+    def load(self) -> None:
+        with open(self.path) as f:
+            d = json.load(f)
+        self.height = int(d.get("height", "0"))
+        self.round = int(d.get("round", 0))
+        self.step = int(d.get("step", 0))
+        self.signature = bytes.fromhex(d["signature"]) if d.get("signature") else b""
+        self.sign_bytes = bytes.fromhex(d["signbytes"]) if d.get("signbytes") else b""
+
+    def save(self) -> None:
+        _atomic_write(
+            self.path,
+            json.dumps(
+                {
+                    "height": str(self.height),
+                    "round": self.round,
+                    "step": self.step,
+                    "signature": self.signature.hex() if self.signature else None,
+                    "signbytes": self.sign_bytes.hex() if self.sign_bytes else None,
+                },
+                indent=2,
+            ),
+        )
+
+    def check_hrs(self, height: int, round_: int, step: int) -> bool:
+        """Returns True if we've signed at exactly this HRS before (caller
+        must then check sign-bytes equality); raises DoubleSignError on
+        regression.  Reference file.go:87-126."""
+        if self.height > height:
+            raise DoubleSignError(f"height regression: last {self.height}, new {height}")
+        if self.height == height:
+            if self.round > round_:
+                raise DoubleSignError(
+                    f"round regression at height {height}: last {self.round}, new {round_}"
+                )
+            if self.round == round_:
+                if self.step > step:
+                    raise DoubleSignError(
+                        f"step regression at {height}/{round_}: last {self.step}, new {step}"
+                    )
+                if self.step == step:
+                    if not self.sign_bytes:
+                        raise DoubleSignError("no sign bytes saved at same HRS")
+                    if not self.signature:
+                        raise RuntimeError("signature missing while sign bytes present")
+                    return True
+        return False
+
+
+class FilePV:
+    """types.PrivValidator backed by two JSON files."""
+
+    def __init__(self, priv_key: PrivKey, key_path: str, state_path: str):
+        self.priv_key = priv_key
+        self.key_path = key_path
+        self.state = _LastSignState(state_path)
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def generate(cls, key_path: str, state_path: str) -> "FilePV":
+        pv = cls(gen_priv_key(), key_path, state_path)
+        pv.save_key()
+        pv.state.save()
+        return pv
+
+    @classmethod
+    def load(cls, key_path: str, state_path: str) -> "FilePV":
+        with open(key_path) as f:
+            d = json.load(f)
+        priv = PrivKey(bytes.fromhex(d["priv_key"]))
+        pv = cls(priv, key_path, state_path)
+        pv.state.load()
+        return pv
+
+    def save_key(self) -> None:
+        pub = self.priv_key.pub_key()
+        _atomic_write(
+            self.key_path,
+            json.dumps(
+                {
+                    "address": pub.address().hex().upper(),
+                    "pub_key": pub.bytes_().hex(),
+                    "priv_key": self.priv_key.bytes_().hex(),
+                },
+                indent=2,
+            ),
+        )
+
+    # -- PrivValidator interface ----------------------------------------
+    def get_pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        """Sets vote.signature; raises DoubleSignError on conflict.
+        Reference signVote (file.go:275-320): at the same HRS, re-sign is
+        allowed only for identical sign-bytes or bytes differing solely in
+        timestamp (then the SAVED signature+timestamp are reused)."""
+        step = _VOTE_STEP.get(vote.type)
+        if step is None:
+            raise ValueError(f"unknown vote type {vote.type}")
+        height, round_ = vote.height, vote.round
+        same_hrs = self.state.check_hrs(height, round_, step)
+        sign_bytes = vote.sign_bytes(chain_id)
+
+        if same_hrs:
+            if sign_bytes == self.state.sign_bytes:
+                vote.signature = self.state.signature
+                return
+            saved = Vote.decode_sign_bytes_timestamp(self.state.sign_bytes)
+            new = Vote.decode_sign_bytes_timestamp(sign_bytes)
+            if saved is not None and new is not None and saved[1] == new[1]:
+                # differs only in timestamp: reuse saved timestamp + sig
+                vote.timestamp_ns = saved[0]
+                vote.signature = self.state.signature
+                return
+            raise DoubleSignError("conflicting vote data at same height/round/step")
+
+        sig = self.priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, step, sig, sign_bytes)
+        vote.signature = sig
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        height, round_ = proposal.height, proposal.round
+        same_hrs = self.state.check_hrs(height, round_, STEP_PROPOSE)
+        sign_bytes = proposal.sign_bytes(chain_id)
+
+        if same_hrs:
+            if sign_bytes == self.state.sign_bytes:
+                proposal.signature = self.state.signature
+                return
+            saved = Proposal.decode_sign_bytes_timestamp(self.state.sign_bytes)
+            new = Proposal.decode_sign_bytes_timestamp(sign_bytes)
+            if saved is not None and new is not None and saved[1] == new[1]:
+                proposal.timestamp_ns = saved[0]
+                proposal.signature = self.state.signature
+                return
+            raise DoubleSignError("conflicting proposal data at same height/round/step")
+
+        sig = self.priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, STEP_PROPOSE, sig, sign_bytes)
+        proposal.signature = sig
+
+    def _save_signed(
+        self, height: int, round_: int, step: int, sig: bytes, sign_bytes: bytes
+    ) -> None:
+        st = self.state
+        st.height, st.round, st.step = height, round_, step
+        st.signature, st.sign_bytes = sig, sign_bytes
+        st.save()
+
+
+def load_or_gen_file_pv(key_path: str, state_path: str) -> FilePV:
+    if os.path.exists(key_path):
+        return FilePV.load(key_path, state_path)
+    return FilePV.generate(key_path, state_path)

@@ -10,9 +10,14 @@ reference's TestVoteSignBytesTestVectors byte vectors.
 
 from __future__ import annotations
 
-from tendermint_tpu.wire.proto import ProtoWriter, encode_delimited
+from tendermint_tpu.wire.proto import (
+    ProtoWriter,
+    decode_delimited,
+    encode_delimited,
+    parse_message,
+)
 
-from .basic import BlockID, SignedMsgType, encode_timestamp
+from .basic import BlockID, SignedMsgType, decode_timestamp, encode_timestamp
 
 
 def _canonical_block_id(block_id: BlockID) -> bytes | None:
@@ -49,6 +54,30 @@ def vote_sign_bytes_raw(
         .string(6, chain_id)
     )
     return encode_delimited(w.bytes_out())
+
+
+def split_canonical_timestamp(
+    sign_bytes: bytes, ts_field: int
+) -> tuple[int, tuple] | None:
+    """Parse delimited canonical sign-bytes into (timestamp_ns, rest) where
+    `rest` is a hashable tuple of every non-timestamp field — the privval
+    "votes only differ by timestamp" check (reference
+    privval/file.go:320-345 checkVotesOnlyDifferByTimestamp).  Returns None
+    if the bytes don't parse."""
+    try:
+        msg, _ = decode_delimited(sign_bytes)
+        ts_ns = None
+        rest = []
+        for field, wire_type, value in parse_message(msg):
+            if field == ts_field:
+                ts_ns = decode_timestamp(value)
+            else:
+                rest.append((field, wire_type, value))
+        if ts_ns is None:
+            return None
+        return ts_ns, tuple(rest)
+    except Exception:
+        return None
 
 
 def proposal_sign_bytes_raw(

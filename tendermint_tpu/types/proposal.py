@@ -53,6 +53,14 @@ class Proposal:
         if len(self.signature) > 64:
             raise ValueError("signature too big")
 
+    @staticmethod
+    def decode_sign_bytes_timestamp(sign_bytes: bytes) -> tuple[int, tuple] | None:
+        """(timestamp_ns, non-timestamp fields) of canonical sign-bytes
+        (CanonicalProposal timestamp = field 6); None if unparseable."""
+        from .canonical import split_canonical_timestamp
+
+        return split_canonical_timestamp(sign_bytes, 6)
+
     def encode(self) -> bytes:
         return (
             ProtoWriter()

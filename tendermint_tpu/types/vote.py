@@ -84,6 +84,14 @@ class Vote:
         if len(self.signature) > 64:
             raise ValueError("signature too big")
 
+    @staticmethod
+    def decode_sign_bytes_timestamp(sign_bytes: bytes) -> tuple[int, tuple] | None:
+        """(timestamp_ns, non-timestamp fields) of canonical sign-bytes
+        (CanonicalVote timestamp = field 5); None if unparseable."""
+        from .canonical import split_canonical_timestamp
+
+        return split_canonical_timestamp(sign_bytes, 5)
+
     # -- wire (gossip) encoding ---------------------------------------
     def encode(self) -> bytes:
         return (

@@ -1,0 +1,123 @@
+"""Rotating file group: the WAL's storage substrate.
+
+Parity: reference libs/autofile/group.go:54-186 — a "head" file plus
+indexed chunks (`path.000`, `path.001`, …); when the head exceeds
+`head_size_limit` it is rotated to the next index; when the total size
+exceeds `total_size_limit` the oldest chunks are deleted.  The reference
+checks limits on a ticker; here rotation is checked on write (same
+guarantees, no background task needed).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class Group:
+    def __init__(
+        self,
+        head_path: str,
+        head_size_limit: int = 10 * 1024 * 1024,
+        total_size_limit: int = 1024 * 1024 * 1024,
+    ):
+        self.head_path = head_path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
+        self.dir = os.path.dirname(os.path.abspath(head_path)) or "."
+        os.makedirs(self.dir, exist_ok=True)
+        self._min_index, self._max_index = self._read_group_info()
+        self._head = open(head_path, "ab")
+
+    # -- index bookkeeping ---------------------------------------------
+    def _chunk_path(self, index: int) -> str:
+        return f"{self.head_path}.{index:03d}"
+
+    def _read_group_info(self) -> tuple[int, int]:
+        """Scan the dir for existing chunks; min/max of on-disk indices
+        (max_index is where the next rotation lands)."""
+        base = os.path.basename(self.head_path)
+        indices = []
+        for name in os.listdir(self.dir):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1 :]
+                if suffix.isdigit():
+                    indices.append(int(suffix))
+        if not indices:
+            return 0, 0
+        return min(indices), max(indices) + 1
+
+    @property
+    def min_index(self) -> int:
+        return self._min_index
+
+    @property
+    def max_index(self) -> int:
+        return self._max_index
+
+    # -- writing --------------------------------------------------------
+    def write(self, data: bytes) -> None:
+        self._head.write(data)
+
+    def flush(self) -> None:
+        self._head.flush()
+
+    def fsync(self) -> None:
+        self._head.flush()
+        os.fsync(self._head.fileno())
+
+    def head_size(self) -> int:
+        self._head.flush()
+        return os.path.getsize(self.head_path)
+
+    def total_size(self) -> int:
+        total = self.head_size()
+        for i in range(self._min_index, self._max_index):
+            p = self._chunk_path(i)
+            if os.path.exists(p):
+                total += os.path.getsize(p)
+        return total
+
+    def check_limits(self) -> None:
+        """Rotate the head / drop old chunks if over limits (the
+        reference's processTicks, group.go:240+)."""
+        if self.head_size_limit > 0 and self.head_size() >= self.head_size_limit:
+            self.rotate()
+        if self.total_size_limit > 0:
+            while self.total_size() > self.total_size_limit and self._min_index < self._max_index:
+                p = self._chunk_path(self._min_index)
+                if os.path.exists(p):
+                    os.unlink(p)
+                self._min_index += 1
+
+    def rotate(self) -> None:
+        self._head.flush()
+        os.fsync(self._head.fileno())
+        self._head.close()
+        os.replace(self.head_path, self._chunk_path(self._max_index))
+        self._max_index += 1
+        self._head = open(self.head_path, "ab")
+
+    # -- reading ---------------------------------------------------------
+    def paths_oldest_first(self) -> list[str]:
+        out = [
+            self._chunk_path(i)
+            for i in range(self._min_index, self._max_index)
+            if os.path.exists(self._chunk_path(i))
+        ]
+        if os.path.exists(self.head_path):
+            out.append(self.head_path)
+        return out
+
+    def read_all(self) -> bytes:
+        self._head.flush()
+        buf = bytearray()
+        for p in self.paths_oldest_first():
+            with open(p, "rb") as f:
+                buf += f.read()
+        return bytes(buf)
+
+    def close(self) -> None:
+        if not self._head.closed:
+            self._head.flush()
+            os.fsync(self._head.fileno())
+            self._head.close()

@@ -1,0 +1,148 @@
+"""BitArray: vote/part bitmap with proto round-trip.
+
+Parity: reference libs/bits/bit_array.go — fixed-size bit vector used for
+part-set tracking, vote bitmaps, and VoteSetBits gossip; `Sub`, `Or`,
+`Not`, `PickRandom` drive the gossip bitmap-diff logic
+(consensus/reactor.go:1053 PickSendVote).
+Wire form: proto libs/bits.proto BitArray{bits=1 (size), elems=2 (u64 LE
+words)}.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+class BitArray:
+    __slots__ = ("bits", "elems")
+
+    def __init__(self, bits: int):
+        if bits < 0:
+            bits = 0
+        self.bits = bits
+        self.elems = [0] * ((bits + 63) // 64)
+
+    @classmethod
+    def from_bools(cls, bools: list[bool]) -> "BitArray":
+        ba = cls(len(bools))
+        for i, b in enumerate(bools):
+            if b:
+                ba.set_index(i, True)
+        return ba
+
+    def size(self) -> int:
+        return self.bits
+
+    def get_index(self, i: int) -> bool:
+        if i < 0 or i >= self.bits:
+            return False
+        return bool(self.elems[i // 64] & (1 << (i % 64)))
+
+    def set_index(self, i: int, v: bool) -> bool:
+        if i < 0 or i >= self.bits:
+            return False
+        if v:
+            self.elems[i // 64] |= 1 << (i % 64)
+        else:
+            self.elems[i // 64] &= ~(1 << (i % 64))
+        return True
+
+    def copy(self) -> "BitArray":
+        ba = BitArray(self.bits)
+        ba.elems = list(self.elems)
+        return ba
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        """Union, sized to the larger operand."""
+        if other.bits > self.bits:
+            return other.or_(self)
+        ba = self.copy()
+        for i, w in enumerate(other.elems):
+            ba.elems[i] |= w
+        return ba
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        ba = BitArray(min(self.bits, other.bits))
+        for i in range(len(ba.elems)):
+            ba.elems[i] = self.elems[i] & other.elems[i]
+        return ba
+
+    def not_(self) -> "BitArray":
+        ba = BitArray(self.bits)
+        for i in range(len(ba.elems)):
+            ba.elems[i] = ~self.elems[i] & ((1 << 64) - 1)
+        # mask tail bits beyond size
+        tail = self.bits % 64
+        if tail and ba.elems:
+            ba.elems[-1] &= (1 << tail) - 1
+        return ba
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other (reference Sub: self AND NOT
+        other, sized to self)."""
+        ba = self.copy()
+        for i in range(min(len(self.elems), len(other.elems))):
+            ba.elems[i] &= ~other.elems[i] & ((1 << 64) - 1)
+        return ba
+
+    def is_empty(self) -> bool:
+        return all(w == 0 for w in self.elems)
+
+    def is_full(self) -> bool:
+        if self.bits == 0:
+            return True
+        full = (1 << 64) - 1
+        for w in self.elems[:-1]:
+            if w != full:
+                return False
+        tail = self.bits % 64 or 64
+        return self.elems[-1] == (1 << tail) - 1
+
+    def true_indices(self) -> list[int]:
+        return [i for i in range(self.bits) if self.get_index(i)]
+
+    def pick_random(self, rng: random.Random | None = None) -> tuple[int, bool]:
+        """A uniformly random set bit (reference PickRandom)."""
+        idxs = self.true_indices()
+        if not idxs:
+            return 0, False
+        return (rng or random).choice(idxs), True
+
+    # -- wire -----------------------------------------------------------
+    def encode(self) -> bytes:
+        from tendermint_tpu.wire.proto import ProtoWriter
+
+        w = ProtoWriter().varint(1, self.bits)
+        for word in self.elems:
+            w.varint(2, word, omit_zero=False)
+        return w.bytes_out()
+
+    MAX_BITS = 1 << 20  # DoS bound on peer-supplied sizes
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BitArray":
+        from tendermint_tpu.wire.proto import fields_to_dict
+
+        f = fields_to_dict(data)
+        bits = f.get(1, [0])[0]
+        words = f.get(2, [])
+        # peer-supplied: size must be sane and consistent with the words
+        # actually sent, or a tiny message could demand a huge allocation
+        if bits < 0 or bits > cls.MAX_BITS:
+            raise ValueError(f"BitArray bits {bits} out of range")
+        if (bits + 63) // 64 != len(words) and not (bits == 0 and not words):
+            raise ValueError("BitArray bits/elems length mismatch")
+        ba = cls(bits)
+        for i, wv in enumerate(words[: len(ba.elems)]):
+            ba.elems[i] = wv & ((1 << 64) - 1)
+        return ba
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, BitArray)
+            and self.bits == other.bits
+            and self.elems == other.elems
+        )
+
+    def __repr__(self) -> str:
+        return "BitArray{" + "".join("x" if self.get_index(i) else "_" for i in range(self.bits)) + "}"

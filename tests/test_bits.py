@@ -1,0 +1,57 @@
+"""BitArray ops + wire roundtrip (reference libs/bits/bit_array_test.go)."""
+
+from tendermint_tpu.utils.bits import BitArray
+
+
+def test_basic_ops():
+    ba = BitArray(70)
+    assert ba.size() == 70
+    assert ba.is_empty()
+    ba.set_index(0, True)
+    ba.set_index(69, True)
+    assert ba.get_index(0) and ba.get_index(69)
+    assert not ba.get_index(35)
+    assert not ba.set_index(70, True)  # out of range
+    assert ba.true_indices() == [0, 69]
+
+
+def test_not_masks_tail():
+    ba = BitArray(66)
+    inv = ba.not_()
+    assert inv.is_full()
+    assert inv.true_indices() == list(range(66))
+
+
+def test_sub_or_and():
+    a = BitArray.from_bools([True, True, False, False])
+    b = BitArray.from_bools([True, False, True, False])
+    assert a.sub(b).true_indices() == [1]
+    assert a.or_(b).true_indices() == [0, 1, 2]
+    assert a.and_(b).true_indices() == [0]
+
+
+def test_or_different_sizes():
+    a = BitArray.from_bools([True, False])
+    b = BitArray(130)
+    b.set_index(129, True)
+    c = a.or_(b)
+    assert c.size() == 130
+    assert c.true_indices() == [0, 129]
+
+
+def test_full_and_pick():
+    ba = BitArray.from_bools([True] * 64)
+    assert ba.is_full()
+    idx, ok = ba.pick_random()
+    assert ok and 0 <= idx < 64
+    empty = BitArray(5)
+    _, ok = empty.pick_random()
+    assert not ok
+
+
+def test_wire_roundtrip():
+    ba = BitArray(100)
+    for i in (0, 1, 63, 64, 99):
+        ba.set_index(i, True)
+    out = BitArray.decode(ba.encode())
+    assert out == ba

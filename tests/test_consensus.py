@@ -1,0 +1,176 @@
+"""Single-node consensus: the minimum end-to-end slice (SURVEY §7 stage 4).
+
+A one-validator chain producing blocks through the full FSM — propose →
+prevote → precommit → commit — with a kvstore app, real mempool, file
+privval, and a WAL; plus crash/restart recovery through the stores + WAL.
+Models reference consensus/state_test.go happy paths + replay_test.go
+restart basics.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import AppConns
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus.config import ConsensusConfig
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.mempool.mempool import MempoolConfig
+from tendermint_tpu.privval import load_or_gen_file_pv
+from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from tendermint_tpu.store import BlockStore, MemDB
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+class Node:
+    """Minimal single-validator node harness around ConsensusState."""
+
+    def __init__(self, tmp_path, state_db=None, block_db=None, app=None, config=None):
+        self.pv = load_or_gen_file_pv(
+            str(tmp_path / "pv_key.json"), str(tmp_path / "pv_state.json")
+        )
+        genesis = GenesisDoc(
+            chain_id="cs-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=self.pv.get_pub_key(), power=10)],
+        )
+        self.state_db = state_db if state_db is not None else MemDB()
+        self.block_db = block_db if block_db is not None else MemDB()
+        self.state_store = StateStore(self.state_db)
+        self.block_store = BlockStore(self.block_db)
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(genesis)
+            self.state_store.save(state)
+        self.app = app or KVStoreApplication()
+        conns = AppConns(self.app)
+        self.mempool = Mempool(MempoolConfig(), conns.mempool())
+        self.executor = BlockExecutor(
+            self.state_store, conns.consensus(), mempool=self.mempool
+        )
+        self.wal = WAL(str(tmp_path / "cs.wal"))
+        self.cs = ConsensusState(
+            config or ConsensusConfig.test_config(),
+            state,
+            self.executor,
+            self.block_store,
+            wal=self.wal,
+            priv_validator=self.pv,
+        )
+
+    async def wait_for_height(self, h, timeout=15.0):
+        async def poll():
+            while self.block_store.height() < h:
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(poll(), timeout)
+
+    async def stop(self):
+        await self.cs.stop()
+
+
+def test_single_node_produces_blocks(tmp_path):
+    async def run():
+        node = Node(tmp_path)
+        await node.cs.start()
+        await node.wait_for_height(3)
+        await node.stop()
+
+        # chain invariants: heights chained, commits verifiable
+        assert node.block_store.height() >= 3
+        state = node.state_store.load()
+        assert state.last_block_height >= 3
+        b1 = node.block_store.load_block(1)
+        b2 = node.block_store.load_block(2)
+        assert b2.last_commit.block_id.hash == b1.hash()
+        commit2 = node.block_store.load_block_commit(1)
+        state.last_validators  # noqa: B018
+        # verify stored commit for height 1 against the validator set
+        from tendermint_tpu.types.vote_set import commit_to_vote_set
+
+        vs = commit_to_vote_set("cs-chain", commit2, state.validators)
+        assert vs.has_two_thirds_majority()
+
+    asyncio.run(run())
+
+
+def test_txs_flow_into_blocks(tmp_path):
+    async def run():
+        node = Node(tmp_path)
+        await node.cs.start()
+        node.mempool.check_tx(b"alpha=1")
+        node.mempool.check_tx(b"beta=2")
+        await node.wait_for_height(2)
+        await node.stop()
+
+        committed = []
+        for h in range(1, node.block_store.height() + 1):
+            blk = node.block_store.load_block(h)
+            committed.extend(blk.data.txs)
+        assert b"alpha=1" in committed
+        assert b"beta=2" in committed
+        # app state reflects them
+        assert node.app.state.get(b"alpha") == b"1"
+        assert node.app.state.get(b"beta") == b"2"
+        # mempool drained
+        assert node.mempool.size() == 0
+
+    asyncio.run(run())
+
+
+def test_no_empty_blocks_waits_for_txs(tmp_path):
+    async def run():
+        cfg = ConsensusConfig.test_config()
+        cfg.create_empty_blocks = False
+        node = Node(tmp_path, config=cfg)
+        node.cs.set_tx_notifier(node.mempool)
+        await node.cs.start()
+        # without txs, no block should be produced
+        await asyncio.sleep(1.0)
+        assert node.block_store.height() == 0
+        # a tx arriving wakes consensus up
+        node.mempool.check_tx(b"wake=up")
+        await node.wait_for_height(1)
+        await node.stop()
+        blk = node.block_store.load_block(1)
+        assert blk.data.txs == [b"wake=up"]
+
+    asyncio.run(run())
+
+
+def test_restart_continues_chain(tmp_path):
+    async def run():
+        state_db, block_db = MemDB(), MemDB()
+        app = KVStoreApplication()
+        node = Node(tmp_path, state_db, block_db, app=app)
+        await node.cs.start()
+        node.mempool.check_tx(b"persist=yes")
+        await node.wait_for_height(2)
+        await node.stop()
+        h1 = node.block_store.height()
+
+        # "restart": same DBs + same WAL dir + same privval files
+        node2 = Node(tmp_path, state_db, block_db, app=app)
+        assert node2.cs.rs.height == h1 + 1
+        assert node2.cs.rs.last_commit is not None
+        await node2.cs.start()
+        await node2.wait_for_height(h1 + 2)
+        await node2.stop()
+        assert node2.block_store.height() >= h1 + 2
+        # chain linkage across the restart boundary
+        pre = node2.block_store.load_block(h1)
+        post = node2.block_store.load_block(h1 + 1)
+        assert post.last_commit.block_id.hash == pre.hash()
+        assert app.state.get(b"persist") == b"yes"
+
+    asyncio.run(run())

@@ -1,0 +1,130 @@
+"""Mempool: CheckTx admission, cache dedup, reap ordering, update/recheck.
+
+Models the reference's mempool/clist_mempool_test.go scenarios.
+"""
+
+import pytest
+
+from tendermint_tpu import abci
+from tendermint_tpu.abci import AppConns
+from tendermint_tpu.abci.kvstore import CounterApplication, KVStoreApplication
+from tendermint_tpu.mempool import Mempool, TxInCacheError, MempoolFullError, TxTooLargeError
+from tendermint_tpu.mempool.mempool import MempoolConfig, post_check_max_gas, pre_check_max_bytes
+
+
+def make_mempool(app=None, **cfg):
+    app = app or KVStoreApplication()
+    conns = AppConns(app)
+    return Mempool(MempoolConfig(**cfg), conns.mempool()), app
+
+
+def test_check_tx_insert_and_reap_order():
+    mp, _ = make_mempool()
+    txs = [b"k%d=v%d" % (i, i) for i in range(10)]
+    for tx in txs:
+        res = mp.check_tx(tx)
+        assert res.code == abci.CodeTypeOK
+    assert mp.size() == 10
+    assert mp.tx_bytes() == sum(len(t) for t in txs)
+    # reap preserves insertion order
+    assert mp.reap_max_bytes_max_gas(-1, -1) == txs
+    assert mp.reap_max_txs(3) == txs[:3]
+
+
+def test_cache_dedup():
+    mp, _ = make_mempool()
+    mp.check_tx(b"a=1")
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"a=1")
+    assert mp.size() == 1
+
+
+def test_reap_byte_and_gas_caps():
+    mp, _ = make_mempool()
+    for i in range(10):
+        mp.check_tx(b"k%d=v" % i)  # kvstore: gas_wanted=1 each
+    # byte cap cuts the list
+    one = len(b"k0=v")
+    assert len(mp.reap_max_bytes_max_gas(one * 3, -1)) == 3
+    # gas cap cuts the list
+    assert len(mp.reap_max_bytes_max_gas(-1, 5)) == 5
+
+
+def test_mempool_full():
+    mp, _ = make_mempool(size=2)
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    with pytest.raises(MempoolFullError):
+        mp.check_tx(b"c=3")
+    # rejected-for-capacity tx must be resubmittable later
+    mp.flush()
+    assert mp.check_tx(b"c=3").code == abci.CodeTypeOK
+
+
+def test_tx_too_large():
+    mp, _ = make_mempool(max_tx_bytes=8)
+    with pytest.raises(TxTooLargeError):
+        mp.check_tx(b"x" * 9)
+
+
+def test_update_removes_committed_and_blocks_replay():
+    mp, _ = make_mempool()
+    txs = [b"a=1", b"b=2", b"c=3"]
+    for tx in txs:
+        mp.check_tx(tx)
+    ok = abci.ResponseDeliverTx(code=abci.CodeTypeOK)
+    mp.update(1, [b"a=1", b"b=2"], [ok, ok])
+    assert mp.size() == 1
+    assert mp.reap_max_txs(-1) == [b"c=3"]
+    # committed txs are pinned in cache: re-submission is rejected
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"a=1")
+
+
+def test_update_recheck_evicts_now_invalid():
+    # counter app in serial mode: txs must arrive in numeric order, so
+    # after committing 0..2 every buffered tx below 3 fails recheck
+    app = CounterApplication(serial=True)
+    conns = AppConns(app)
+    mp = Mempool(MempoolConfig(), conns.mempool())
+    for i in range(5):
+        tx = i.to_bytes(8, "big")
+        assert mp.check_tx(tx).code == abci.CodeTypeOK
+    # app commits 0,1,2 (deliver them so its counter advances)
+    committed = [i.to_bytes(8, "big") for i in range(3)]
+    for tx in committed:
+        app.deliver_tx(abci.RequestDeliverTx(tx=tx))
+    ok = abci.ResponseDeliverTx(code=abci.CodeTypeOK)
+    mp.update(1, committed, [ok] * 3)
+    # 3 and 4 survive recheck (they're still future txs)
+    assert mp.reap_max_txs(-1) == [i.to_bytes(8, "big") for i in range(3, 5)]
+
+
+def test_pre_and_post_check():
+    mp, _ = make_mempool()
+    mp.pre_check = pre_check_max_bytes(4)
+    with pytest.raises(Exception):
+        mp.check_tx(b"abcdef=1")
+    mp.pre_check = None
+    mp.post_check = post_check_max_gas(0)  # kvstore wants gas 1 > 0
+    mp.check_tx(b"a=1")
+    assert mp.size() == 0  # rejected by post-check, not inserted
+
+
+def test_txs_available_notification():
+    import asyncio
+
+    async def run():
+        mp, _ = make_mempool()
+        mp.enable_txs_available()
+        ev = mp.txs_available()
+        assert not ev.is_set()
+        mp.check_tx(b"a=1")
+        assert ev.is_set()
+        # update clears the latch; remaining txs re-notify
+        ok = abci.ResponseDeliverTx(code=abci.CodeTypeOK)
+        mp.check_tx(b"b=2")
+        mp.update(1, [b"a=1"], [ok])
+        assert mp.txs_available().is_set()  # b=2 still pending
+
+    asyncio.run(run())

@@ -1,0 +1,114 @@
+"""FilePV: signing, HRS regression protection, timestamp-only re-sign,
+persistence. Models reference privval/file_test.go."""
+
+import pytest
+
+from tendermint_tpu.privval import DoubleSignError, FilePV, load_or_gen_file_pv
+from tendermint_tpu.types import BlockID, Proposal, Vote
+from tendermint_tpu.types.basic import PartSetHeader, SignedMsgType
+
+CHAIN = "pv-chain"
+
+
+@pytest.fixture
+def pv(tmp_path):
+    return FilePV.generate(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+
+
+def mkvote(height=1, round_=0, t=SignedMsgType.PREVOTE, ts=1_700_000_000_000_000_000, h=b"\x01" * 32, pv=None):
+    bid = BlockID(hash=h, part_set_header=PartSetHeader(total=1, hash=b"\x02" * 32)) if h else BlockID()
+    return Vote(
+        type=t,
+        height=height,
+        round=round_,
+        block_id=bid,
+        timestamp_ns=ts,
+        validator_address=pv.get_pub_key().address(),
+        validator_index=0,
+    )
+
+
+def test_sign_vote_and_verify(pv):
+    v = mkvote(pv=pv)
+    pv.sign_vote(CHAIN, v)
+    v.verify(CHAIN, pv.get_pub_key())
+
+
+def test_same_vote_resign_returns_same_sig(pv):
+    v1 = mkvote(pv=pv)
+    pv.sign_vote(CHAIN, v1)
+    v2 = mkvote(pv=pv)
+    pv.sign_vote(CHAIN, v2)
+    assert v1.signature == v2.signature
+
+
+def test_timestamp_only_difference_reuses_saved(pv):
+    v1 = mkvote(pv=pv, ts=1_700_000_000_000_000_000)
+    pv.sign_vote(CHAIN, v1)
+    v2 = mkvote(pv=pv, ts=1_700_000_005_000_000_000)  # later timestamp only
+    pv.sign_vote(CHAIN, v2)
+    assert v2.signature == v1.signature
+    assert v2.timestamp_ns == v1.timestamp_ns  # saved timestamp wins
+    v2.verify(CHAIN, pv.get_pub_key())
+
+
+def test_conflicting_block_same_hrs_raises(pv):
+    v1 = mkvote(pv=pv)
+    pv.sign_vote(CHAIN, v1)
+    v2 = mkvote(pv=pv, h=b"\x07" * 32)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, v2)
+
+
+def test_hrs_regression_raises(pv):
+    v = mkvote(pv=pv, height=5, round_=2, t=SignedMsgType.PRECOMMIT)
+    pv.sign_vote(CHAIN, v)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, mkvote(pv=pv, height=4))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, mkvote(pv=pv, height=5, round_=1))
+    # same h/r, lower step (precommit already signed → prevote refused)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, mkvote(pv=pv, height=5, round_=2, t=SignedMsgType.PREVOTE))
+    # higher round fine
+    pv.sign_vote(CHAIN, mkvote(pv=pv, height=5, round_=3))
+
+
+def test_proposal_then_prevote_ordering(pv):
+    p = Proposal(
+        height=3,
+        round=0,
+        pol_round=-1,
+        block_id=BlockID(hash=b"\x01" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x02" * 32)),
+        timestamp_ns=1_700_000_000_000_000_000,
+    )
+    pv.sign_proposal(CHAIN, p)
+    assert p.verify(CHAIN, pv.get_pub_key())
+    # step forward within same h/r is fine
+    pv.sign_vote(CHAIN, mkvote(pv=pv, height=3, round_=0))
+    # but another (different) proposal at same h/r must now fail
+    p2 = Proposal(
+        height=3, round=0, pol_round=-1,
+        block_id=BlockID(hash=b"\x09" * 32, part_set_header=PartSetHeader(total=1, hash=b"\x02" * 32)),
+        timestamp_ns=1_700_000_000_000_000_000,
+    )
+    with pytest.raises(DoubleSignError):
+        pv.sign_proposal(CHAIN, p2)
+
+
+def test_state_survives_reload(tmp_path):
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv1 = load_or_gen_file_pv(kp, sp)
+    v = mkvote(pv=pv1, height=7)
+    pv1.sign_vote(CHAIN, v)
+
+    pv2 = load_or_gen_file_pv(kp, sp)
+    assert pv2.get_pub_key() == pv1.get_pub_key()
+    assert pv2.state.height == 7
+    # conflicting vote after restart still refused
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN, mkvote(pv=pv2, height=7, h=b"\x0a" * 32))
+    # identical vote after restart returns the original signature
+    v2 = mkvote(pv=pv2, height=7)
+    pv2.sign_vote(CHAIN, v2)
+    assert v2.signature == v.signature
