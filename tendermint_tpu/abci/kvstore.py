@@ -29,6 +29,7 @@ class KVStoreApplication(abci.BaseApplication):
         self.size = 0
         self.val_updates: list[abci.ValidatorUpdate] = []
         self.validators: dict[bytes, int] = {}  # pubkey bytes -> power
+        self.byzantine_seen: list = []  # Misbehavior reports from BeginBlock
         self.retain_blocks = 0  # set >0 to exercise pruning
 
     # -- query connection ---------------------------------------------
@@ -64,6 +65,9 @@ class KVStoreApplication(abci.BaseApplication):
 
     def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
         self.val_updates = []
+        # record misbehaviour reports (reference e2e app logs these;
+        # tests assert byzantine validators reach the app)
+        self.byzantine_seen.extend(req.byzantine_validators)
         return abci.ResponseBeginBlock()
 
     def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:

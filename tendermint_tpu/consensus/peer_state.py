@@ -1,0 +1,161 @@
+"""Per-peer consensus state mirror.
+
+Parity: reference consensus/reactor.go:953+ (PeerState) and
+consensus/types/peer_round_state.go (PeerRoundState) — everything this
+node believes about a peer's round state and which proposals/parts/votes
+it already has, driving the bitmap-diff gossip (PickSendVote,
+reactor.go:1053).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.types.basic import PartSetHeader, SignedMsgType
+from tendermint_tpu.utils.bits import BitArray
+
+from .round_state import Step
+
+
+class PeerRoundState:
+    def __init__(self):
+        self.height = 0
+        self.round = -1
+        self.step: Step = Step.NEW_HEIGHT
+        self.start_time_ns = 0
+        self.proposal = False
+        self.proposal_block_part_set_header = PartSetHeader()
+        self.proposal_block_parts: BitArray | None = None
+        self.proposal_pol_round = -1
+        self.proposal_pol: BitArray | None = None
+        self.prevotes: BitArray | None = None
+        self.precommits: BitArray | None = None
+        self.last_commit_round = -1
+        self.last_commit: BitArray | None = None
+        self.catchup_commit_round = -1
+        self.catchup_commit: BitArray | None = None
+
+
+class PeerState:
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.prs = PeerRoundState()
+
+    # -- round-state updates (reference ApplyNewRoundStepMessage) --------
+    def apply_new_round_step(self, msg, num_validators: int) -> None:
+        prs = self.prs
+        ps_height, ps_round, ps_step = prs.height, prs.round, prs.step
+        prs.height = msg.height
+        prs.round = msg.round
+        prs.step = Step(msg.step)
+        prs.start_time_ns = 0  # informational only here
+
+        if ps_height != msg.height or ps_round != msg.round:
+            prs.proposal = False
+            prs.proposal_block_part_set_header = PartSetHeader()
+            prs.proposal_block_parts = None
+            prs.proposal_pol_round = -1
+            prs.proposal_pol = None
+            prs.prevotes = None
+            prs.precommits = None
+        if ps_height == msg.height and ps_round != msg.round and msg.round == prs.catchup_commit_round:
+            prs.precommits = prs.catchup_commit
+        if ps_height != msg.height:
+            # peer moved to a new height: shift commit tracking
+            if ps_height + 1 == msg.height and ps_round == msg.last_commit_round:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = prs.precommits
+            else:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = None
+            prs.catchup_commit_round = -1
+            prs.catchup_commit = None
+
+    def apply_new_valid_block(self, msg) -> None:
+        prs = self.prs
+        if prs.height != msg.height:
+            return
+        if prs.round != msg.round and not msg.is_commit:
+            return
+        prs.proposal_block_part_set_header = msg.block_part_set_header
+        prs.proposal_block_parts = msg.block_parts
+
+    def apply_proposal(self, proposal) -> None:
+        prs = self.prs
+        if prs.height != proposal.height or prs.round != proposal.round:
+            return
+        if prs.proposal:
+            return
+        prs.proposal = True
+        if prs.proposal_block_parts is None:
+            # otherwise already set by NewValidBlock
+            prs.proposal_block_part_set_header = proposal.block_id.part_set_header
+            prs.proposal_block_parts = BitArray(proposal.block_id.part_set_header.total)
+        prs.proposal_pol_round = proposal.pol_round
+        prs.proposal_pol = None  # arrives via ProposalPOL
+
+    def apply_proposal_pol(self, msg) -> None:
+        prs = self.prs
+        if prs.height != msg.height or prs.proposal_pol_round != msg.proposal_pol_round:
+            return
+        prs.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg, num_validators: int) -> None:
+        if self.prs.height != msg.height:
+            return
+        self.set_has_vote(msg.height, msg.round, msg.type, msg.index, num_validators)
+
+    def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:
+        prs = self.prs
+        if prs.height != height or prs.round != round_:
+            return
+        if prs.proposal_block_parts is not None:
+            prs.proposal_block_parts.set_index(index, True)
+
+    # -- vote bitmaps -----------------------------------------------------
+    def _ensure_vote_bitarrays(self, height: int, num_validators: int) -> None:
+        prs = self.prs
+        if prs.height == height:
+            if prs.prevotes is None:
+                prs.prevotes = BitArray(num_validators)
+            if prs.precommits is None:
+                prs.precommits = BitArray(num_validators)
+            if prs.catchup_commit is None:
+                prs.catchup_commit = BitArray(num_validators)
+            if prs.proposal_pol is None:
+                prs.proposal_pol = BitArray(num_validators)
+        elif prs.height == height + 1:
+            if prs.last_commit is None:
+                prs.last_commit = BitArray(num_validators)
+
+    def get_vote_bitarray(self, height: int, round_: int, t: SignedMsgType) -> BitArray | None:
+        prs = self.prs
+        if prs.height == height:
+            if round_ == prs.round:
+                return prs.prevotes if t == SignedMsgType.PREVOTE else prs.precommits
+            if round_ == prs.catchup_commit_round and t == SignedMsgType.PRECOMMIT:
+                return prs.catchup_commit
+            if round_ == prs.proposal_pol_round and t == SignedMsgType.PREVOTE:
+                return prs.proposal_pol
+            return None
+        if prs.height == height + 1:
+            if round_ == prs.last_commit_round and t == SignedMsgType.PRECOMMIT:
+                return prs.last_commit
+        return None
+
+    def set_has_vote(
+        self, height: int, round_: int, t: SignedMsgType, index: int, num_validators: int
+    ) -> None:
+        self._ensure_vote_bitarrays(height, num_validators)
+        ba = self.get_vote_bitarray(height, round_, t)
+        if ba is not None:
+            ba.set_index(index, True)
+
+    def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
+        """Reference EnsureCatchupCommitRound: peer is at `height`, we have
+        the canonical commit for it at `round_`."""
+        prs = self.prs
+        if prs.height != height:
+            return
+        if prs.catchup_commit_round == round_:
+            return
+        prs.catchup_commit_round = round_
+        prs.catchup_commit = BitArray(num_validators)

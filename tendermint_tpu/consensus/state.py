@@ -52,6 +52,12 @@ from .wal import NopWAL
 TIME_IOTA_NS = 1_000_000  # 1ms minimum inter-block time grain
 
 
+class ConsensusFailureError(Exception):
+    """Unrecoverable consensus-safety failure: the node must halt rather
+    than continue in an inconsistent state (the reference panics —
+    state.go:700-713, :1540-1557)."""
+
+
 class ConsensusState:
     def __init__(
         self,
@@ -176,10 +182,15 @@ class ConsensusState:
                     else:
                         self.wal.write(item)
                         self.handle_msg(item)
+                except (ConsensusFailureError, OSError):
+                    # safety failures (broken commit path, WAL/disk errors)
+                    # halt the node — continuing could double-sign or fork
+                    # (the reference panics here)
+                    self.logger.error("CONSENSUS FAILURE — halting")
+                    self._stopping = True
+                    raise
                 except Exception as e:
-                    # bad peer input must not kill consensus (the reference
-                    # logs and continues; consensus failures panic there and
-                    # re-raise here via finalize paths)
+                    # bad peer input must not kill consensus: log and go on
                     self.logger.error("consensus msg error", err=repr(e))
 
     def handle_msg(self, mi: MsgInfo) -> None:
@@ -604,17 +615,26 @@ class ConsensusState:
         block.validate_basic()
         self.block_exec.validate_block(self.state, block)
 
-        if self.block_store.height() < block.header.height:
-            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
-            self.block_store.save_block(block, block_parts, seen_commit)
+        # from here on, failure is a safety violation: +2/3 precommitted
+        # this block, so an error storing/applying it must halt the node
+        try:
+            if self.block_store.height() < block.header.height:
+                seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+                self.block_store.save_block(block, block_parts, seen_commit)
 
-        # crash barrier: replay resumes AFTER this record (reference
-        # state.go:1540-1557)
-        self.wal.write_sync(EndHeightMessage(height))
+            # crash barrier: replay resumes AFTER this record (reference
+            # state.go:1540-1557)
+            self.wal.write_sync(EndHeightMessage(height))
 
-        state_copy, retain_height = self.block_exec.apply_block(
-            self.state.copy(), block_id, block
-        )
+            state_copy, retain_height = self.block_exec.apply_block(
+                self.state.copy(), block_id, block
+            )
+        except ConsensusFailureError:
+            raise
+        except Exception as e:
+            raise ConsensusFailureError(
+                f"failed to commit block {height}: {e}"
+            ) from e
         if retain_height > 0:
             try:
                 pruned = self.block_store.prune_blocks(retain_height)
@@ -870,11 +890,18 @@ class ConsensusState:
     def catchup_replay(self) -> None:
         """Re-apply WAL messages recorded after the last committed height's
         end barrier, without re-writing them."""
-        height = self.rs.height
-        msgs, found = self.wal.search_for_end_height(height - 1)
-        if not found and height > (self.state.initial_height if self.state else 1):
-            # fresh WAL on an existing chain: nothing to replay
-            return
+        # the barrier before the first height of the chain is height 0 —
+        # NOT initial_height-1 (reference replay.go:126-137)
+        end_height = self.state.last_block_height
+        msgs, found = self.wal.search_for_end_height(end_height)
+        if not found:
+            if self.wal.all_messages():
+                # a WAL with content but no barrier for our height is
+                # corrupt/foreign: refuse to run on it (reference errors)
+                raise RuntimeError(
+                    f"WAL has no end-height barrier for height {end_height}"
+                )
+            return  # brand-new empty WAL (NopWAL): nothing to replay
         self.replay_mode = True
         try:
             for tm in msgs:
@@ -885,9 +912,10 @@ class ConsensusState:
                     except Exception as e:
                         self.logger.error("replay msg failed", err=str(e))
                 elif isinstance(m, TimeoutInfo):
-                    # timeouts are not replayed as actions; the live ticker
-                    # re-arms them (reference replays only msgInfo)
-                    pass
+                    # timeouts ARE replayed (reference readReplayMessage →
+                    # handleTimeout): round transitions must survive a crash
+                    # or the validator would double-sign at a stale round
+                    self.handle_timeout(m)
                 elif isinstance(m, EndHeightMessage):
                     pass
         finally:

@@ -1,0 +1,4 @@
+from .pool import EvidencePool
+from .verify import verify_duplicate_vote, verify_evidence
+
+__all__ = ["EvidencePool", "verify_duplicate_vote", "verify_evidence"]

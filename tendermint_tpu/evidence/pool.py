@@ -1,0 +1,149 @@
+"""Evidence pool: pending byzantine evidence awaiting block inclusion.
+
+Parity: reference evidence/pool.go:57-560 — DB-persisted pending evidence
+(prefix-keyed by height+hash), consensus reports conflicting votes which
+become DuplicateVoteEvidence at the next Update, proposed-block evidence
+checked via verify.py, committed evidence marked and pruned by the
+recency window.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tendermint_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    decode_evidence,
+)
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .verify import verify_evidence
+
+_PENDING = b"\x00"
+_COMMITTED = b"\x01"
+
+
+def _key(prefix: bytes, height: int, ev_hash: bytes) -> bytes:
+    return prefix + struct.pack(">q", height) + ev_hash
+
+
+class EvidencePool:
+    def __init__(self, db, state_store, block_store, logger: Logger | None = None):
+        self.db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.logger = logger or nop_logger()
+        self._conflicting_votes: list[tuple] = []  # (vote_a, vote_b) buffer
+        self.on_evidence = None  # callable(ev) — reactor gossip hook
+
+    # -- state ----------------------------------------------------------
+    def _state(self):
+        state = self.state_store.load()
+        if state is None:
+            raise RuntimeError("evidence pool requires a stored state")
+        return state
+
+    # -- queries ---------------------------------------------------------
+    def pending_evidence(self, max_bytes: int) -> list:
+        """Pending evidence up to max_bytes of encoded size (reference
+        PendingEvidence; max_bytes < 0 = unlimited)."""
+        out = []
+        total = 0
+        for k, v in self.db.iterate(_PENDING, _PENDING + b"\xff" * 9):
+            ev = decode_evidence(v)
+            sz = len(v)
+            if max_bytes >= 0 and total + sz > max_bytes:
+                break
+            total += sz
+            out.append(ev)
+        return out
+
+    def is_pending(self, ev) -> bool:
+        return self.db.get(_key(_PENDING, ev.height(), ev.hash())) is not None
+
+    def is_committed(self, ev) -> bool:
+        return self.db.get(_key(_COMMITTED, ev.height(), ev.hash())) is not None
+
+    # -- ingestion --------------------------------------------------------
+    def add_evidence(self, ev) -> None:
+        """Verify and persist gossiped/locally-generated evidence
+        (reference AddEvidence :136)."""
+        if self.is_pending(ev) or self.is_committed(ev):
+            return
+        ev.validate_basic()
+        state = self._state()
+        verify_evidence(ev, state, self.state_store, self.block_store)
+        self._add_pending(ev)
+        self.logger.info("added evidence", height=ev.height())
+        if self.on_evidence is not None:
+            self.on_evidence(ev)
+
+    def report_conflicting_votes(self, vote_a, vote_b) -> None:
+        """Called by consensus on equivocation (reference
+        ReportConflictingVotes :120): buffered until the next Update, when
+        the block time/validator set for the evidence become known."""
+        self._conflicting_votes.append((vote_a, vote_b))
+
+    def _add_pending(self, ev) -> None:
+        self.db.set(_key(_PENDING, ev.height(), ev.hash()), ev.encode())
+
+    # -- block validation --------------------------------------------------
+    def check_evidence(self, state, evidence_list: list) -> None:
+        """Validate all evidence in a proposed block (reference
+        CheckEvidence :160): no duplicates inside the block, none already
+        committed, each verifiable."""
+        seen = set()
+        for ev in evidence_list:
+            h = ev.hash()
+            if h in seen:
+                raise ValueError("duplicate evidence in block")
+            seen.add(h)
+            if self.is_committed(ev):
+                raise ValueError("evidence was already committed")
+            if not self.is_pending(ev):
+                ev.validate_basic()
+                verify_evidence(ev, state, self.state_store, self.block_store)
+                self._add_pending(ev)
+
+    # -- commit-time update ------------------------------------------------
+    def update(self, state, committed_evidence: list) -> None:
+        """Reference Update (:105): mark committed, generate evidence from
+        buffered conflicting votes, prune expired."""
+        for ev in committed_evidence:
+            self.db.set(_key(_COMMITTED, ev.height(), ev.hash()), b"\x01")
+            self.db.delete(_key(_PENDING, ev.height(), ev.hash()))
+        self._process_conflicting_votes(state)
+        self._prune_expired(state)
+
+    def _process_conflicting_votes(self, state) -> None:
+        pending, self._conflicting_votes = self._conflicting_votes, []
+        for vote_a, vote_b in pending:
+            height = vote_a.height
+            val_set = self.state_store.load_validators(height)
+            if val_set is None:
+                self.logger.error("no valset for conflicting votes", height=height)
+                continue
+            block_meta = self.block_store.load_block_meta(height)
+            if block_meta is None:
+                # height not yet committed (e.g. equivocation in the live
+                # round): retry at the next update
+                self._conflicting_votes.append((vote_a, vote_b))
+                continue
+            try:
+                ev = DuplicateVoteEvidence.from_votes(
+                    vote_a, vote_b, block_meta.header.time_ns, val_set
+                )
+                self.add_evidence(ev)
+            except Exception as e:
+                self.logger.error("failed to make duplicate-vote evidence", err=str(e))
+
+    def _prune_expired(self, state) -> None:
+        params = state.consensus_params.evidence
+        height = state.last_block_height
+        for k, v in list(self.db.iterate(_PENDING, _PENDING + b"\xff" * 9)):
+            ev_height = struct.unpack(">q", k[1:9])[0]
+            ev = decode_evidence(v)
+            age_blocks = height - ev_height
+            age_ns = state.last_block_time_ns - ev.timestamp_ns
+            if age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns:
+                self.db.delete(k)

@@ -1,0 +1,99 @@
+"""Evidence reactor: gossip pending evidence on channel 0x38.
+
+Parity: reference evidence/reactor.go — per-peer task walking the pending
+list (the reference iterates the pool's CList with per-peer throttling);
+received evidence is verified and added to the pool, which re-gossips it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.p2p import ChannelDescriptor, Envelope, PeerStatus
+from tendermint_tpu.types.evidence import decode_evidence
+from tendermint_tpu.utils.log import Logger, nop_logger
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .pool import EvidencePool
+
+EVIDENCE_CHANNEL = 0x38
+
+
+def encode_evidence_list(evs: list) -> bytes:
+    w = ProtoWriter()
+    for ev in evs:
+        w.bytes_(1, ev.encode(), omit_empty=False)
+    return w.bytes_out()
+
+
+def decode_evidence_list(data: bytes) -> list:
+    return [decode_evidence(raw) for raw in fields_to_dict(data).get(1, [])]
+
+
+class EvidenceReactor:
+    def __init__(self, pool: EvidencePool, router, logger: Logger | None = None,
+                 gossip_sleep_ms: int = 500):
+        self.pool = pool
+        self.router = router
+        self.logger = logger or nop_logger()
+        self.gossip_sleep = gossip_sleep_ms / 1000.0
+        self.ch = router.open_channel(
+            ChannelDescriptor(
+                channel_id=EVIDENCE_CHANNEL,
+                priority=4,
+                encode=encode_evidence_list,
+                decode=decode_evidence_list,
+            )
+        )
+        self.peer_updates = router.subscribe_peer_updates()
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._recv_loop()))
+        self._tasks.append(loop.create_task(self._peer_update_loop()))
+
+    async def stop(self) -> None:
+        for t in list(self._peer_tasks.values()) + self._tasks:
+            t.cancel()
+        await asyncio.gather(
+            *self._tasks, *self._peer_tasks.values(), return_exceptions=True
+        )
+
+    async def _peer_update_loop(self) -> None:
+        while True:
+            update = await self.peer_updates.get()
+            if update.status == PeerStatus.UP:
+                if update.node_id not in self._peer_tasks:
+                    self._peer_tasks[update.node_id] = asyncio.get_running_loop().create_task(
+                        self._gossip(update.node_id)
+                    )
+            else:
+                t = self._peer_tasks.pop(update.node_id, None)
+                if t is not None:
+                    t.cancel()
+
+    async def _recv_loop(self) -> None:
+        while True:
+            env = await self.ch.receive()
+            for ev in env.message:
+                try:
+                    self.pool.add_evidence(ev)
+                except Exception as e:
+                    self.logger.debug("gossiped evidence rejected", err=str(e))
+
+    async def _gossip(self, node_id: str) -> None:
+        sent: set[bytes] = set()
+        try:
+            while True:
+                fresh = [
+                    ev for ev in self.pool.pending_evidence(-1) if ev.hash() not in sent
+                ]
+                if fresh:
+                    for ev in fresh:
+                        sent.add(ev.hash())
+                    await self.ch.send(Envelope(message=fresh, to=node_id))
+                await asyncio.sleep(self.gossip_sleep)
+        except asyncio.CancelledError:
+            return

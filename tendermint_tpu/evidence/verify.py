@@ -1,0 +1,103 @@
+"""Evidence verification.
+
+Parity: reference evidence/verify.go — recency window by
+ConsensusParams.Evidence (verify.go:25-80), VerifyDuplicateVote
+(:222-282), VerifyLightClientAttack (:180).
+
+North-star note: the two signatures of a DuplicateVoteEvidence are
+verified as one BatchVerifier call (the reference verifies them
+sequentially) — and check_evidence batches across a whole proposed
+block's evidence list.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.crypto import new_batch_verifier
+from tendermint_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    LightClientAttackEvidence,
+)
+from tendermint_tpu.types.validator import ValidatorSet
+
+
+def verify_evidence(ev, state, state_store, block_store) -> None:
+    """Full check for a single piece of evidence against current state
+    (reference verify.go:25 Pool.verify).  Raises on invalid."""
+    ev_height = ev.height()
+    height = state.last_block_height
+    params = state.consensus_params.evidence
+
+    block_meta = block_store.load_block_meta(ev_height)
+    if block_meta is None:
+        raise ValueError(f"no block at evidence height {ev_height}")
+    ev_time = block_meta.header.time_ns
+
+    age_num_blocks = height - ev_height
+    age_duration = state.last_block_time_ns - ev_time
+    if age_num_blocks > params.max_age_num_blocks and age_duration > params.max_age_duration_ns:
+        raise ValueError(
+            f"evidence from height {ev_height} is too old: "
+            f"{age_num_blocks} blocks, {age_duration}ns"
+        )
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        val_set = state_store.load_validators(ev_height)
+        if val_set is None:
+            raise ValueError(f"no validator set at height {ev_height}")
+        verify_duplicate_vote(ev, state.chain_id, val_set)
+        if ev.timestamp_ns != ev_time:
+            raise ValueError("evidence time does not match block time")
+    elif isinstance(ev, LightClientAttackEvidence):
+        verify_light_client_attack(ev, state, state_store)
+    else:
+        raise ValueError(f"unknown evidence type {type(ev).__name__}")
+
+
+def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str, val_set: ValidatorSet) -> None:
+    """Reference VerifyDuplicateVote (verify.go:222-282)."""
+    a, b = ev.vote_a, ev.vote_b
+    if a.height != b.height or a.round != b.round or a.type != b.type:
+        raise ValueError("duplicate votes differ in H/R/S")
+    if a.validator_address != b.validator_address:
+        raise ValueError("duplicate votes from different validators")
+    if a.block_id.key() == b.block_id.key():
+        raise ValueError("votes are for the same block ID")
+    # enforce canonical ordering (vote_a's block key lexicographically first)
+    if not a.block_id.key() <= b.block_id.key():
+        raise ValueError("duplicate votes not in canonical order")
+
+    idx, val = val_set.get_by_address(a.validator_address)
+    if val is None:
+        raise ValueError("validator not in set at evidence height")
+    if ev.validator_power != val.voting_power:
+        raise ValueError("validator power mismatch")
+    if ev.total_voting_power != val_set.total_voting_power():
+        raise ValueError("total voting power mismatch")
+
+    # both signatures as one batched device call
+    bv = new_batch_verifier()
+    bv.add(val.pub_key, a.sign_bytes(chain_id), a.signature)
+    bv.add(val.pub_key, b.sign_bytes(chain_id), b.signature)
+    ok, per_sig = bv.verify()
+    if not ok:
+        which = "A" if not per_sig[0] else "B"
+        raise ValueError(f"invalid signature on vote {which}")
+
+
+def verify_light_client_attack(ev: LightClientAttackEvidence, state, state_store) -> None:
+    """Structural checks for light-client attack evidence.  Header/commit
+    cross-verification against the conflicting block arrives with the
+    light-client subsystem (reference VerifyLightClientAttack,
+    verify.go:180); until then the byzantine validators must at least be a
+    subset of the common-height validator set with consistent power."""
+    common_vals = state_store.load_validators(ev.common_height)
+    if common_vals is None:
+        raise ValueError(f"no validator set at common height {ev.common_height}")
+    if ev.total_voting_power != common_vals.total_voting_power():
+        raise ValueError("total voting power mismatch")
+    for v in ev.byzantine_validators:
+        _, val = common_vals.get_by_address(v.address)
+        if val is None:
+            raise ValueError("byzantine validator not in common validator set")
+        if val.voting_power != v.voting_power:
+            raise ValueError("byzantine validator power mismatch")

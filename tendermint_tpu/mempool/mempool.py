@@ -99,8 +99,6 @@ class Mempool:
         self.cache = LRUTxCache(config.cache_size) if config.cache_size > 0 else NopTxCache()
         self._txs: OrderedDict[bytes, MempoolTx] = OrderedDict()  # key: sha256(tx)
         self._total_bytes = 0
-        self._lock = asyncio.Lock()  # held by consensus across Commit+Update
-        self._locked = False
         self.pre_check = None  # callable(tx) -> None, raises to reject
         self.post_check = None  # callable(tx, ResponseCheckTx) -> None
         self._txs_available: asyncio.Event | None = None
@@ -134,11 +132,15 @@ class Mempool:
             raise MempoolFullError(len(self._txs), self._total_bytes)
 
     # -- lock (held by BlockExecutor.Commit) -----------------------------
+    # No-ops today: check_tx/update run synchronously on one event loop,
+    # so Commit+Update cannot interleave with CheckTx.  These are the
+    # interface points where real mutual exclusion goes if an async app
+    # connection (socket/grpc ABCI) is wired in.
     def lock(self) -> None:
-        self._locked = True
+        pass
 
     def unlock(self) -> None:
-        self._locked = False
+        pass
 
     def flush_app_conn(self) -> None:
         self.app.flush_sync()

@@ -1,0 +1,113 @@
+"""Mempool reactor: tx gossip on channel 0x30.
+
+Parity: reference mempool/reactor.go — one tx per message (batching
+deliberately disabled, reactor.go:244-245), per-peer iterator over the
+pool skipping txs the peer itself sent, catch-up sleep when drained;
+received txs go through CheckTx with the sender recorded for echo
+suppression.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.crypto.tmhash import sum_sha256
+from tendermint_tpu.p2p import ChannelDescriptor, Envelope, PeerStatus
+from tendermint_tpu.utils.log import Logger, nop_logger
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .mempool import Mempool, TxInCacheError
+
+MEMPOOL_CHANNEL = 0x30
+
+
+def encode_txs(txs: list[bytes]) -> bytes:
+    w = ProtoWriter()
+    for tx in txs:
+        w.bytes_(1, tx, omit_empty=False)
+    return w.bytes_out()
+
+
+def decode_txs(data: bytes) -> list[bytes]:
+    return fields_to_dict(data).get(1, [])
+
+
+class MempoolReactor:
+    def __init__(self, mempool: Mempool, router, logger: Logger | None = None,
+                 gossip_sleep_ms: int = 100):
+        self.mempool = mempool
+        self.router = router
+        self.logger = logger or nop_logger()
+        self.gossip_sleep = gossip_sleep_ms / 1000.0
+        self.ch = router.open_channel(
+            ChannelDescriptor(
+                channel_id=MEMPOOL_CHANNEL,
+                priority=5,
+                encode=encode_txs,
+                decode=decode_txs,
+            )
+        )
+        self.peer_updates = router.subscribe_peer_updates()
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._recv_loop()))
+        self._tasks.append(loop.create_task(self._peer_update_loop()))
+
+    async def stop(self) -> None:
+        for t in list(self._peer_tasks.values()) + self._tasks:
+            t.cancel()
+        await asyncio.gather(
+            *self._tasks, *self._peer_tasks.values(), return_exceptions=True
+        )
+
+    async def _peer_update_loop(self) -> None:
+        while True:
+            update = await self.peer_updates.get()
+            if update.status == PeerStatus.UP:
+                if update.node_id not in self._peer_tasks:
+                    self._peer_tasks[update.node_id] = asyncio.get_running_loop().create_task(
+                        self._gossip(update.node_id)
+                    )
+            else:
+                t = self._peer_tasks.pop(update.node_id, None)
+                if t is not None:
+                    t.cancel()
+
+    async def _recv_loop(self) -> None:
+        while True:
+            env = await self.ch.receive()
+            for tx in env.message:
+                try:
+                    self.mempool.check_tx(tx, sender=env.from_)
+                except TxInCacheError:
+                    pass  # normal gossip echo
+                except Exception as e:
+                    self.logger.debug("gossiped tx rejected", err=str(e))
+
+    async def _gossip(self, node_id: str) -> None:
+        """Walk the pool forever, sending each tx the peer hasn't sent us
+        (reference broadcastTxRoutine, reactor.go:199-260)."""
+        sent: set[bytes] = set()
+        try:
+            while True:
+                advanced = False
+                for memtx in self.mempool.entries():
+                    key = sum_sha256(memtx.tx)
+                    if key in sent:
+                        continue
+                    sent.add(key)
+                    advanced = True
+                    if node_id in memtx.senders:
+                        continue  # peer gave us this tx
+                    await self.ch.send(Envelope(message=[memtx.tx], to=node_id))
+                if not advanced:
+                    await asyncio.sleep(self.gossip_sleep)
+                    # bound the dedup set: drop hashes no longer in the pool
+                    if len(sent) > 4 * max(1, self.mempool.size()):
+                        live = {sum_sha256(m.tx) for m in self.mempool.entries()}
+                        sent &= live
+        except asyncio.CancelledError:
+            return

@@ -1,0 +1,16 @@
+from .types import ChannelDescriptor, Envelope, NodeID, PeerStatus, PeerUpdate
+from .channel import Channel
+from .memory import MemoryNetwork, MemoryTransport
+from .router import Router
+
+__all__ = [
+    "ChannelDescriptor",
+    "Envelope",
+    "NodeID",
+    "PeerStatus",
+    "PeerUpdate",
+    "Channel",
+    "MemoryNetwork",
+    "MemoryTransport",
+    "Router",
+]

@@ -1,0 +1,53 @@
+"""Typed bidirectional channel between a reactor and the router.
+
+Parity: reference p2p/channel.go:10-130 — a reactor sends Envelopes out
+(unicast or broadcast) and receives inbound Envelopes; errors on a peer
+are reported through `error()` which makes the router drop the peer
+(reference PeerError / StopPeerForError semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from .types import ChannelDescriptor, Envelope, NodeID
+
+
+@dataclass
+class PeerError:
+    node_id: NodeID
+    err: str
+
+
+class Channel:
+    def __init__(self, descriptor: ChannelDescriptor):
+        self.descriptor = descriptor
+        self.in_queue: asyncio.Queue[Envelope] = asyncio.Queue(
+            maxsize=descriptor.recv_buffer_capacity
+        )
+        self.out_queue: asyncio.Queue[Envelope] = asyncio.Queue(maxsize=1024)
+        self.err_queue: asyncio.Queue[PeerError] = asyncio.Queue(maxsize=256)
+
+    @property
+    def channel_id(self) -> int:
+        return self.descriptor.channel_id
+
+    async def send(self, envelope: Envelope) -> None:
+        envelope.channel_id = self.channel_id
+        await self.out_queue.put(envelope)
+
+    def try_send(self, envelope: Envelope) -> bool:
+        """Non-blocking send; drops on a full queue (reference TrySend)."""
+        envelope.channel_id = self.channel_id
+        try:
+            self.out_queue.put_nowait(envelope)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def receive(self) -> Envelope:
+        return await self.in_queue.get()
+
+    async def error(self, node_id: NodeID, err: str) -> None:
+        await self.err_queue.put(PeerError(node_id, err))

@@ -1,0 +1,104 @@
+"""In-memory transport: a process-local network of queue-backed
+connections keyed by NodeID.
+
+Parity: reference p2p/transport_memory.go:23-394 — the fake backend for
+multi-node tests without sockets.  Frames are (channel_id, bytes) pairs;
+each direction is a bounded asyncio.Queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .types import NodeID
+
+
+class MemoryConnection:
+    """One side of a bidirectional in-memory connection."""
+
+    def __init__(self, local_id: NodeID, remote_id: NodeID, send_q, recv_q):
+        self.local_id = local_id
+        self.remote_id = remote_id
+        self._send_q: asyncio.Queue = send_q
+        self._recv_q: asyncio.Queue = recv_q
+        self._closed = asyncio.Event()
+
+    async def send(self, channel_id: int, data: bytes) -> None:
+        if self._closed.is_set():
+            raise ConnectionError("connection closed")
+        await self._send_q.put((channel_id, data))
+
+    async def receive(self) -> tuple[int, bytes]:
+        """Returns (channel_id, payload); raises ConnectionError on close."""
+        if self._closed.is_set() and self._recv_q.empty():
+            raise ConnectionError("connection closed")
+        recv = asyncio.ensure_future(self._recv_q.get())
+        closed = asyncio.ensure_future(self._closed.wait())
+        done, _ = await asyncio.wait({recv, closed}, return_when=asyncio.FIRST_COMPLETED)
+        if recv in done:
+            closed.cancel()
+            item = recv.result()
+            if item is None:
+                self._closed.set()
+                raise ConnectionError("connection closed by peer")
+            return item
+        recv.cancel()
+        raise ConnectionError("connection closed")
+
+    async def close(self) -> None:
+        if not self._closed.is_set():
+            self._closed.set()
+            try:
+                self._send_q.put_nowait(None)  # EOF marker for the peer
+            except asyncio.QueueFull:
+                pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class MemoryTransport:
+    """Per-node endpoint in a MemoryNetwork."""
+
+    def __init__(self, network: "MemoryNetwork", node_id: NodeID):
+        self.network = network
+        self.node_id = node_id
+        self._accept_q: asyncio.Queue[MemoryConnection] = asyncio.Queue()
+        self._closed = False
+
+    async def accept(self) -> MemoryConnection:
+        conn = await self._accept_q.get()
+        if conn is None:
+            raise ConnectionError("transport closed")
+        return conn
+
+    async def dial(self, remote_id: NodeID) -> MemoryConnection:
+        remote = self.network.nodes.get(remote_id)
+        if remote is None or remote._closed:
+            raise ConnectionError(f"no node {remote_id} in memory network")
+        q_ab: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        q_ba: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        local_conn = MemoryConnection(self.node_id, remote_id, q_ab, q_ba)
+        remote_conn = MemoryConnection(remote_id, self.node_id, q_ba, q_ab)
+        await remote._accept_q.put(remote_conn)
+        return local_conn
+
+    async def close(self) -> None:
+        self._closed = True
+        self.network.nodes.pop(self.node_id, None)
+        await self._accept_q.put(None)
+
+
+class MemoryNetwork:
+    """Registry of in-process transports (reference MemoryNetwork)."""
+
+    def __init__(self):
+        self.nodes: dict[NodeID, MemoryTransport] = {}
+
+    def create_transport(self, node_id: NodeID) -> MemoryTransport:
+        if node_id in self.nodes:
+            raise ValueError(f"node {node_id} already in network")
+        t = MemoryTransport(self, node_id)
+        self.nodes[node_id] = t
+        return t

@@ -1,0 +1,192 @@
+"""Router: moves Envelopes between local reactor channels and peer
+connections.
+
+Parity: reference p2p/router.go:15-525 — the new-architecture router the
+reference prototyped but never wired (SURVEY §1); here it IS the
+production stack.  Per peer: one recv task (frames → decode → channel
+in-queues) and one send task (priority queue → frames); per channel: one
+route task (out-queue → peer queues) and one error task (peer errors →
+disconnect).  Peer lifecycle changes are published to subscribers
+(reference PeerUpdates), which is how reactors learn to start/stop
+per-peer gossip.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .channel import Channel
+from .types import ChannelDescriptor, Envelope, NodeID, PeerStatus, PeerUpdate
+
+
+class _Peer:
+    def __init__(self, node_id: NodeID, conn):
+        self.node_id = node_id
+        self.conn = conn
+        # (negated priority, seq) orders the heap: higher priority first,
+        # FIFO within a priority class (reference mconn channel priorities)
+        self.send_q: asyncio.PriorityQueue = asyncio.PriorityQueue(maxsize=4096)
+        self.tasks: list[asyncio.Task] = []
+
+
+class Router:
+    def __init__(self, node_id: NodeID, transport, logger: Logger | None = None):
+        self.node_id = node_id
+        self.transport = transport
+        self.logger = logger or nop_logger()
+        self.channels: dict[int, Channel] = {}
+        self.peers: dict[NodeID, _Peer] = {}
+        self._peer_update_subs: list[asyncio.Queue] = []
+        self._tasks: list[asyncio.Task] = []
+        self._seq = itertools.count()
+        self._stopping = False
+
+    # -- channels --------------------------------------------------------
+    def open_channel(self, descriptor: ChannelDescriptor) -> Channel:
+        if descriptor.channel_id in self.channels:
+            raise ValueError(f"channel {descriptor.channel_id:#x} already open")
+        ch = Channel(descriptor)
+        self.channels[descriptor.channel_id] = ch
+        return ch
+
+    # -- peer updates ----------------------------------------------------
+    def subscribe_peer_updates(self) -> asyncio.Queue:
+        q: asyncio.Queue[PeerUpdate] = asyncio.Queue(maxsize=256)
+        self._peer_update_subs.append(q)
+        return q
+
+    def _publish_peer_update(self, update: PeerUpdate) -> None:
+        for q in self._peer_update_subs:
+            try:
+                q.put_nowait(update)
+            except asyncio.QueueFull:
+                self.logger.error("peer update subscriber overflowed")
+
+    def peer_ids(self) -> list[NodeID]:
+        return list(self.peers.keys())
+
+    # -- lifecycle -------------------------------------------------------
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._accept_loop()))
+        for ch in self.channels.values():
+            self._tasks.append(loop.create_task(self._route_channel(ch)))
+            self._tasks.append(loop.create_task(self._route_errors(ch)))
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for peer in list(self.peers.values()):
+            await self._disconnect(peer.node_id, notify=False)
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.transport.close()
+
+    # -- dialing/accepting ------------------------------------------------
+    async def dial(self, remote_id: NodeID) -> None:
+        if remote_id in self.peers or remote_id == self.node_id:
+            return
+        conn = await self.transport.dial(remote_id)
+        self._add_peer(remote_id, conn)
+
+    async def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn = await self.transport.accept()
+            except (ConnectionError, asyncio.CancelledError):
+                return
+            remote_id = conn.remote_id
+            if remote_id in self.peers:
+                await conn.close()
+                continue
+            self._add_peer(remote_id, conn)
+
+    def _add_peer(self, node_id: NodeID, conn) -> None:
+        peer = _Peer(node_id, conn)
+        loop = asyncio.get_running_loop()
+        peer.tasks.append(loop.create_task(self._peer_recv(peer)))
+        peer.tasks.append(loop.create_task(self._peer_send(peer)))
+        self.peers[node_id] = peer
+        self.logger.info("peer up", peer=node_id[:8])
+        self._publish_peer_update(PeerUpdate(node_id, PeerStatus.UP))
+
+    async def _disconnect(self, node_id: NodeID, notify: bool = True) -> None:
+        peer = self.peers.pop(node_id, None)
+        if peer is None:
+            return
+        await peer.conn.close()
+        for t in peer.tasks:
+            t.cancel()
+        self.logger.info("peer down", peer=node_id[:8])
+        if notify:
+            self._publish_peer_update(PeerUpdate(node_id, PeerStatus.DOWN))
+
+    # -- per-peer tasks ----------------------------------------------------
+    async def _peer_recv(self, peer: _Peer) -> None:
+        try:
+            while True:
+                channel_id, data = await peer.conn.receive()
+                ch = self.channels.get(channel_id)
+                if ch is None:
+                    continue  # unknown channel: drop silently
+                if len(data) > ch.descriptor.max_msg_bytes:
+                    raise ValueError(f"oversized message on channel {channel_id:#x}")
+                try:
+                    msg = ch.descriptor.decode(data)
+                except Exception as e:
+                    raise ValueError(f"undecodable message: {e}")
+                await ch.in_queue.put(
+                    Envelope(message=msg, from_=peer.node_id, channel_id=channel_id)
+                )
+        except asyncio.CancelledError:
+            return
+        except (ConnectionError, Exception) as e:
+            if not self._stopping and peer.node_id in self.peers:
+                self.logger.info("peer recv ended", peer=peer.node_id[:8], err=str(e))
+                asyncio.get_running_loop().create_task(self._disconnect(peer.node_id))
+
+    async def _peer_send(self, peer: _Peer) -> None:
+        try:
+            while True:
+                _, _, channel_id, data = await peer.send_q.get()
+                await peer.conn.send(channel_id, data)
+        except asyncio.CancelledError:
+            return
+        except ConnectionError:
+            if not self._stopping and peer.node_id in self.peers:
+                asyncio.get_running_loop().create_task(self._disconnect(peer.node_id))
+
+    # -- channel routing ----------------------------------------------------
+    async def _route_channel(self, ch: Channel) -> None:
+        """Drain a channel's out-queue into peer send queues."""
+        prio = -ch.descriptor.priority
+        while True:
+            try:
+                env = await ch.out_queue.get()
+            except asyncio.CancelledError:
+                return
+            data = ch.descriptor.encode(env.message)
+            if env.broadcast:
+                targets = [p for pid, p in self.peers.items() if pid != env.from_]
+            else:
+                p = self.peers.get(env.to)
+                targets = [p] if p is not None else []
+            for p in targets:
+                try:
+                    p.send_q.put_nowait((prio, next(self._seq), ch.channel_id, data))
+                except asyncio.QueueFull:
+                    # backpressure: drop lowest-urgency gossip rather than
+                    # stall the whole channel (reference TrySend semantics)
+                    self.logger.debug("peer send queue full", peer=p.node_id[:8])
+
+    async def _route_errors(self, ch: Channel) -> None:
+        while True:
+            try:
+                perr = await ch.err_queue.get()
+            except asyncio.CancelledError:
+                return
+            self.logger.info("peer error", peer=perr.node_id[:8], err=perr.err)
+            await self._disconnect(perr.node_id)

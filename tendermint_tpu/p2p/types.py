@@ -1,0 +1,61 @@
+"""P2P data model: node identity, envelopes, channel descriptors.
+
+Parity: reference p2p/channel.go:10-58 (Envelope), p2p/transport.go:19
+(ChannelDescriptor via conn.ChannelDescriptor), p2p/peer.go NodeID =
+hex-encoded address of the node's ed25519 pubkey (p2p/key.go).
+
+Design note (SURVEY §5.8): this is the new-style Channel/Router stack —
+the reference's legacy Switch/Reactor model and its ReactorShim bridge
+are skipped entirely; reactors here speak typed Envelopes natively.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable
+
+from tendermint_tpu.crypto.keys import PubKey
+
+
+def node_id_from_pubkey(pub: PubKey) -> str:
+    """NodeID = lowercase hex of the 20-byte pubkey address."""
+    return pub.address().hex()
+
+
+NodeID = str  # lowercase hex address string
+
+
+@dataclass
+class Envelope:
+    """One routed message (reference p2p/channel.go Envelope)."""
+
+    message: object
+    from_: NodeID = ""
+    to: NodeID = ""
+    broadcast: bool = False
+    channel_id: int = 0
+
+
+@dataclass
+class ChannelDescriptor:
+    """Static channel config registered by a reactor (reference
+    conn.ChannelDescriptor + message codec)."""
+
+    channel_id: int
+    priority: int = 1
+    encode: Callable[[object], bytes] = None
+    decode: Callable[[bytes], object] = None
+    recv_buffer_capacity: int = 1024
+    max_msg_bytes: int = 1024 * 1024
+
+
+class PeerStatus(enum.Enum):
+    UP = "up"
+    DOWN = "down"
+
+
+@dataclass
+class PeerUpdate:
+    node_id: NodeID
+    status: PeerStatus

@@ -27,6 +27,13 @@ from .store import ABCIResponses, StateStore
 from .validation import validate_block, weighted_median_time
 
 
+def max_data_bytes_no_evidence(max_block_bytes: int, val_count: int) -> int:
+    """Conservative room left for txs in a block: header/overhead plus a
+    worst-case commit signature per validator (reference
+    types.MaxDataBytesNoEvidence)."""
+    return max_block_bytes - 2048 - 300 * val_count
+
+
 class _NullMempool:
     def lock(self):
         pass
@@ -37,7 +44,7 @@ class _NullMempool:
     def reap_max_bytes_max_gas(self, max_bytes, max_gas):
         return []
 
-    def update(self, height, txs, deliver_tx_responses, pre_check=None):
+    def update(self, height, txs, deliver_tx_responses, pre_check=None, post_check=None):
         pass
 
     def flush_app_conn(self):
@@ -85,7 +92,7 @@ class BlockExecutor:
         # leave generous room for header/commit/evidence (reference
         # types.MaxDataBytes is exact and panics when negative; a negative
         # cap must never reach the mempool, where it means "unlimited")
-        data_cap = max_bytes - 2048 - 300 * len(last_commit.signatures)
+        data_cap = max_data_bytes_no_evidence(max_bytes, len(last_commit.signatures))
         if data_cap < 0:
             raise ValueError(
                 f"block.max_bytes {max_bytes} too small for "
@@ -254,13 +261,28 @@ class BlockExecutor:
         )
 
     def _commit(self, state: State, block: Block, abci_responses: ABCIResponses) -> tuple[bytes, int]:
-        """App commit under mempool lock (reference :210-260)."""
+        """App commit under mempool lock (reference :210-260).  The
+        mempool's admission filters are refreshed from the NEW state
+        (reference TxPreCheck/TxPostCheck, state/services.go)."""
+        from tendermint_tpu.mempool.mempool import (
+            post_check_max_gas,
+            pre_check_max_bytes,
+        )
+
+        params = state.consensus_params
+        max_data_bytes = max_data_bytes_no_evidence(
+            params.block.max_bytes, state.validators.size()
+        )
         self.mempool.lock()
         try:
             self.mempool.flush_app_conn()
             res = self.app.commit_sync()
             self.mempool.update(
-                block.header.height, block.data.txs, abci_responses.deliver_txs
+                block.header.height,
+                block.data.txs,
+                abci_responses.deliver_txs,
+                pre_check=pre_check_max_bytes(max_data_bytes),
+                post_check=post_check_max_gas(params.block.max_gas),
             )
             return res.data, res.retain_height
         finally:

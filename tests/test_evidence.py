@@ -1,0 +1,173 @@
+"""Evidence pool: duplicate-vote verification, pending/committed lifecycle,
+conflicting-vote reporting, pruning. Models reference evidence/pool_test.go
++ verify_test.go."""
+
+import pytest
+
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.evidence import EvidencePool, verify_duplicate_vote
+from tendermint_tpu.evidence.verify import verify_evidence
+from tendermint_tpu.store import MemDB
+from tendermint_tpu.types import BlockID, Vote
+from tendermint_tpu.types.basic import PartSetHeader, SignedMsgType
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+from test_state_execution import ChainDriver
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def make_conflicting_votes(driver, height, val_idx=0):
+    """Two signed votes for the same H/R/S but different blocks."""
+    state = driver.state_store.load_validators(height)
+    val = state.get_by_index(val_idx)
+    key = driver.key_by_addr[val.address]
+
+    def mk(h):
+        bid = BlockID(hash=h, part_set_header=PartSetHeader(1, b"\x05" * 32))
+        v = Vote(
+            type=SignedMsgType.PREVOTE,
+            height=height,
+            round=0,
+            block_id=bid,
+            timestamp_ns=1_700_000_100 * 10**9,
+            validator_address=val.address,
+            validator_index=val_idx,
+        )
+        v.signature = key.sign(v.sign_bytes(driver.state.chain_id))
+        return v
+
+    return mk(b"\x01" * 32), mk(b"\x02" * 32)
+
+
+def make_pool(driver):
+    return EvidencePool(MemDB(), driver.state_store, driver.block_store)
+
+
+def test_verify_duplicate_vote():
+    driver = ChainDriver()
+    driver.step([b"a=1"])
+    va, vb = make_conflicting_votes(driver, 1)
+    vals = driver.state_store.load_validators(1)
+    ev = DuplicateVoteEvidence.from_votes(va, vb, 1_700_000_000 * 10**9, vals)
+    verify_duplicate_vote(ev, driver.state.chain_id, vals)
+
+    # same block id on both sides rejected
+    bad = DuplicateVoteEvidence.from_votes(va, va, 0, vals)
+    with pytest.raises(ValueError):
+        verify_duplicate_vote(bad, driver.state.chain_id, vals)
+
+    # tampered signature rejected
+    ev2 = DuplicateVoteEvidence.from_votes(va, vb, 0, vals)
+    ev2.vote_b.signature = bytes(64)
+    with pytest.raises(ValueError):
+        verify_duplicate_vote(ev2, driver.state.chain_id, vals)
+
+    # wrong power metadata rejected
+    ev3 = DuplicateVoteEvidence.from_votes(va, vb, 0, vals)
+    ev3.validator_power += 1
+    with pytest.raises(ValueError):
+        verify_duplicate_vote(ev3, driver.state.chain_id, vals)
+
+
+def test_pool_add_and_pending_lifecycle():
+    driver = ChainDriver()
+    driver.step([b"a=1"])
+    driver.step([b"b=2"])
+    pool = make_pool(driver)
+
+    va, vb = make_conflicting_votes(driver, 1)
+    vals = driver.state_store.load_validators(1)
+    # evidence time must equal the block time at its height
+    block_time = driver.block_store.load_block_meta(1).header.time_ns
+    ev = DuplicateVoteEvidence.from_votes(va, vb, block_time, vals)
+    pool.add_evidence(ev)
+    assert pool.is_pending(ev)
+    pending = pool.pending_evidence(-1)
+    assert len(pending) == 1 and pending[0].hash() == ev.hash()
+
+    # check_evidence accepts it inside a proposed block
+    pool.check_evidence(driver.state, [ev])
+    # duplicate inside one block rejected
+    with pytest.raises(ValueError):
+        pool.check_evidence(driver.state, [ev, ev])
+
+    # commit it: moves pending → committed, re-inclusion rejected
+    pool.update(driver.state, [ev])
+    assert not pool.is_pending(ev)
+    assert pool.is_committed(ev)
+    with pytest.raises(ValueError):
+        pool.check_evidence(driver.state, [ev])
+    assert pool.pending_evidence(-1) == []
+
+
+def test_report_conflicting_votes_generates_evidence():
+    driver = ChainDriver()
+    driver.step([b"a=1"])
+    pool = make_pool(driver)
+    va, vb = make_conflicting_votes(driver, 1)
+    pool.report_conflicting_votes(va, vb)
+    assert pool.pending_evidence(-1) == []
+    pool.update(driver.state, [])
+    pending = pool.pending_evidence(-1)
+    assert len(pending) == 1
+    ev = pending[0]
+    assert isinstance(ev, DuplicateVoteEvidence)
+    # generated with the block time at the evidence height
+    assert ev.timestamp_ns == driver.block_store.load_block_meta(1).header.time_ns
+    verify_evidence(ev, driver.state, driver.state_store, driver.block_store)
+
+
+def test_conflicting_votes_for_uncommitted_height_retry():
+    driver = ChainDriver()
+    driver.step([b"a=1"])
+    pool = make_pool(driver)
+    # votes for height 2, which is not yet committed
+    state2 = driver.state
+    val = state2.validators.get_by_index(0)
+    key = driver.key_by_addr[val.address]
+
+    def mk(h):
+        v = Vote(
+            type=SignedMsgType.PREVOTE,
+            height=2,
+            round=0,
+            block_id=BlockID(hash=h, part_set_header=PartSetHeader(1, b"\x05" * 32)),
+            timestamp_ns=1_700_000_200 * 10**9,
+            validator_address=val.address,
+            validator_index=0,
+        )
+        v.signature = key.sign(v.sign_bytes(driver.state.chain_id))
+        return v
+
+    pool.report_conflicting_votes(mk(b"\x01" * 32), mk(b"\x02" * 32))
+    pool.update(driver.state, [])
+    assert pool.pending_evidence(-1) == []  # buffered, not lost
+    driver.step([b"b=2"])
+    pool.update(driver.state, [])
+    assert len(pool.pending_evidence(-1)) == 1
+
+
+def test_expired_evidence_rejected_and_pruned():
+    driver = ChainDriver()
+    driver.step([b"a=1"])
+    # shrink the window so height-1 evidence expires fast
+    driver.state.consensus_params.evidence.max_age_num_blocks = 1
+    driver.state.consensus_params.evidence.max_age_duration_ns = 1
+    pool = make_pool(driver)
+    va, vb = make_conflicting_votes(driver, 1)
+    vals = driver.state_store.load_validators(1)
+    block_time = driver.block_store.load_block_meta(1).header.time_ns
+    ev = DuplicateVoteEvidence.from_votes(va, vb, block_time, vals)
+    pool._add_pending(ev)  # bypass verify to test pruning
+    driver.step([b"b=2"])
+    driver.step([b"c=3"])
+    with pytest.raises(ValueError):
+        verify_evidence(ev, driver.state, driver.state_store, driver.block_store)
+    pool.update(driver.state, [])
+    assert pool.pending_evidence(-1) == []

@@ -111,6 +111,15 @@ def test_pre_and_post_check():
     assert mp.size() == 0  # rejected by post-check, not inserted
 
 
+def test_update_wires_pre_check_filter():
+    mp, _ = make_mempool()
+    ok = abci.ResponseDeliverTx(code=abci.CodeTypeOK)
+    mp.update(1, [], [], pre_check=pre_check_max_bytes(8))
+    with pytest.raises(Exception):
+        mp.check_tx(b"definitely=longer-than-8-bytes")
+    assert mp.check_tx(b"a=1").code == abci.CodeTypeOK
+
+
 def test_txs_available_notification():
     import asyncio
 
