@@ -1,0 +1,23 @@
+"""Fast sync (reference blockchain/v0): download, batch-verify, and apply
+the chain from peers, then hand off to consensus."""
+
+from .messages import (
+    BlockRequest,
+    BlockResponse,
+    NoBlockResponse,
+    StatusRequest,
+    StatusResponse,
+)
+from .pool import BlockPool
+from .reactor import BLOCKSYNC_CHANNEL, BlocksyncReactor
+
+__all__ = [
+    "BLOCKSYNC_CHANNEL",
+    "BlockPool",
+    "BlockRequest",
+    "BlockResponse",
+    "BlocksyncReactor",
+    "NoBlockResponse",
+    "StatusRequest",
+    "StatusResponse",
+]

@@ -1,0 +1,110 @@
+"""Fast-sync wire messages (channel 0x40).
+
+Parity: reference proto/tendermint/blockchain/types.proto — the
+blockchain/v0 reactor's message set (blockchain/v0/reactor.go).
+Message oneof: block_request=1, no_block_response=2, block_response=3,
+status_request=4, status_response=5.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict, to_int64
+
+
+@dataclass
+class BlockRequest:
+    """BlockRequest{height=1}."""
+
+    height: int
+
+    def encode(self) -> bytes:
+        return ProtoWriter().varint(1, self.height).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockRequest":
+        f = fields_to_dict(data)
+        return cls(to_int64(f.get(1, [0])[0]))
+
+
+@dataclass
+class NoBlockResponse:
+    """NoBlockResponse{height=1} — peer has no block at that height."""
+
+    height: int
+
+    def encode(self) -> bytes:
+        return ProtoWriter().varint(1, self.height).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NoBlockResponse":
+        f = fields_to_dict(data)
+        return cls(to_int64(f.get(1, [0])[0]))
+
+
+@dataclass
+class BlockResponse:
+    """BlockResponse{block=1}."""
+
+    block: Block
+
+    def encode(self) -> bytes:
+        return ProtoWriter().message(1, self.block.encode(), always=True).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockResponse":
+        f = fields_to_dict(data)
+        return cls(Block.decode(f[1][0]))
+
+
+@dataclass
+class StatusRequest:
+    """StatusRequest{} — ask a peer for its (base, height) range."""
+
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StatusRequest":
+        return cls()
+
+
+@dataclass
+class StatusResponse:
+    """StatusResponse{height=1, base=2}."""
+
+    height: int
+    base: int = 0
+
+    def encode(self) -> bytes:
+        return ProtoWriter().varint(1, self.height).varint(2, self.base).bytes_out()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StatusResponse":
+        f = fields_to_dict(data)
+        return cls(to_int64(f.get(1, [0])[0]), to_int64(f.get(2, [0])[0]))
+
+
+_TYPES: list[type] = [
+    BlockRequest,
+    NoBlockResponse,
+    BlockResponse,
+    StatusRequest,
+    StatusResponse,
+]
+_FIELD = {t: i + 1 for i, t in enumerate(_TYPES)}
+
+
+def encode_blocksync_message(msg) -> bytes:
+    fld = _FIELD[type(msg)]
+    return ProtoWriter().message(fld, msg.encode(), always=True).bytes_out()
+
+
+def decode_blocksync_message(data: bytes):
+    f = fields_to_dict(data)
+    for t, fld in _FIELD.items():
+        if fld in f:
+            return t.decode(f[fld][0])
+    raise ValueError("unknown blocksync message")

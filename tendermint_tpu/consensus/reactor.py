@@ -363,6 +363,10 @@ class ConsensusReactor:
             rs.proposal_block_parts is not None
             and rs.height == prs.height
             and prs.proposal_block_parts is not None
+            # reference HasHeader check (reactor.go:495): the peer's bitmap
+            # must track THIS part set, or we'd diff bitmaps of different
+            # blocks and permanently mark-as-sent parts the peer rejected
+            and rs.proposal_block_parts.header() == prs.proposal_block_part_set_header
         ):
             ours = BitArray.from_bools(rs.proposal_block_parts.bit_array())
             needed = ours.sub(prs.proposal_block_parts)

@@ -895,13 +895,22 @@ class ConsensusState:
         end_height = self.state.last_block_height
         msgs, found = self.wal.search_for_end_height(end_height)
         if not found:
-            if self.wal.all_messages():
-                # a WAL with content but no barrier for our height is
-                # corrupt/foreign: refuse to run on it (reference errors)
+            # No barrier for our height.  A WAL whose newest barrier is
+            # BEHIND the chain is normal: the state advanced without
+            # consensus (fast sync / state sync / fresh WAL at its initial
+            # EndHeight(0) on an existing chain) — nothing to replay.  A
+            # barrier AHEAD of the chain means this WAL belongs to a
+            # different data dir: refuse to run on it.
+            last_barrier = -1
+            for tm in self.wal.all_messages():
+                if isinstance(tm.msg, EndHeightMessage):
+                    last_barrier = max(last_barrier, tm.msg.height)
+            if last_barrier > end_height:
                 raise RuntimeError(
-                    f"WAL has no end-height barrier for height {end_height}"
+                    f"WAL is ahead of the chain: barrier {last_barrier} > "
+                    f"state height {end_height}"
                 )
-            return  # brand-new empty WAL (NopWAL): nothing to replay
+            return
         self.replay_mode = True
         try:
             for tm in msgs:

@@ -106,15 +106,24 @@ class BlockExecutor:
         return state.make_block(height, txs, last_commit, evidence, proposer_addr, time_ns)
 
     # -- validation -----------------------------------------------------
-    def validate_block(self, state: State, block: Block) -> None:
-        validate_block(state, block, self.evpool)
+    def validate_block(
+        self, state: State, block: Block, commit_sigs_verified: bool = False
+    ) -> None:
+        validate_block(state, block, self.evpool, commit_sigs_verified)
 
     # -- execution ------------------------------------------------------
-    def apply_block(self, state: State, block_id: BlockID, block: Block) -> tuple[State, int]:
+    def apply_block(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        commit_sigs_verified: bool = False,
+    ) -> tuple[State, int]:
         """Execute the block against the app, persist responses, advance
         state, commit the app, update mempool/evidence.  Returns
-        (new_state, retain_height)."""
-        self.validate_block(state, block)
+        (new_state, retain_height).  commit_sigs_verified: see
+        validation.validate_block (fast-sync batch pre-verification)."""
+        self.validate_block(state, block, commit_sigs_verified)
 
         abci_responses = self._exec_block_on_app(state, block)
         self.store.save_abci_responses(block.header.height, abci_responses)

@@ -36,8 +36,16 @@ def weighted_median_time(commit, val_set) -> int:
     return weighted[-1][0]
 
 
-def validate_block(state: State, block: Block, evidence_pool=None) -> None:
-    """Raises ValueError when the block is invalid for this state."""
+def validate_block(
+    state: State, block: Block, evidence_pool=None, commit_sigs_verified: bool = False
+) -> None:
+    """Raises ValueError when the block is invalid for this state.
+
+    commit_sigs_verified=True skips only the LastCommit signature check —
+    used by the fast-sync pipeline, which has already full-verified this
+    exact commit inside a cross-block device batch
+    (types.batch_verify_commits); every structural check still runs.
+    """
     block.validate_basic()
     h = block.header
 
@@ -80,9 +88,10 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
                 f"want {state.last_validators.size()}"
             )
         # ONE batched device call for the whole commit (validation.go:92)
-        state.last_validators.verify_commit(
-            state.chain_id, state.last_block_id, h.height - 1, block.last_commit
-        )
+        if not commit_sigs_verified:
+            state.last_validators.verify_commit(
+                state.chain_id, state.last_block_id, h.height - 1, block.last_commit
+            )
 
     # time rules
     if h.height > state.initial_height:
