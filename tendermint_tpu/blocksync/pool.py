@@ -54,6 +54,7 @@ class BlockPool:
         self._newly_banned: list[str] = []  # drained by the reactor → disconnect
         self._started_at = time.monotonic()
         self._grace = startup_grace_s
+        self._max_seen_height = 0  # monotonic; survives peer bans/removals
 
     # -- peers -----------------------------------------------------------
     def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
@@ -62,6 +63,7 @@ class BlockPool:
             return
         p = self.peers.setdefault(peer_id, _PoolPeer())
         p.base, p.height = base, height
+        self._max_seen_height = max(self._max_seen_height, height)
         self.schedule()
 
     def remove_peer(self, peer_id: str) -> None:
@@ -215,5 +217,9 @@ class BlockPool:
         we sync all the way to max_peer_height-1 applied)."""
         if time.monotonic() - self._started_at <= self._grace:
             return False
-        mph = self.max_peer_height()
-        return mph == 0 or self.height >= mph
+        # Monotonic target: banning/losing the peer that advertised the
+        # chain tip must NOT flip us to "caught up" while its heights are
+        # still unapplied (reference keeps maxPeerHeight monotonic too).
+        return self.height >= self._max_seen_height or (
+            self._max_seen_height == 0 and not self.peers
+        )

@@ -180,12 +180,7 @@ class BlocksyncReactor:
                    + light pair-check of the newest applied block's commit
                    (carried by its successor's LastCommit).
         """
-        cur_hash = self.state.validators.hash()
-        applied = []
-        for b in window[:-1]:
-            if b.header.validators_hash != cur_hash:
-                break
-            applied.append(b)
+        applied = self._static_valset_prefix(window)
         if not applied:
             return [], []
         chain_id = self.state.chain_id
@@ -253,6 +248,18 @@ class BlocksyncReactor:
             try:
                 applied, jobs = self._window_jobs(window)
                 if not applied:
+                    # An honest block at the apply point always carries
+                    # ValidatorsHash == current valset hash; an empty
+                    # prefix means the first pending block is forged —
+                    # refetch it from another peer and ban the sender
+                    # (without this the loop would spin forever on the
+                    # bad block).
+                    self.logger.info(
+                        "bad validators hash at sync point, refetching",
+                        height=window[0].header.height,
+                    )
+                    self.pool.redo(window[0].header.height)
+                    await self._disconnect_banned()
                     self.pool.blocks_available.clear()
                     continue
                 # ONE device call for the whole window's signatures
@@ -277,7 +284,8 @@ class BlocksyncReactor:
                     )
                     self.store.save_block(b, part_set, self._commit_for(b, window))
                     self.state, _ = self.executor.apply_block(
-                        self.state, block_id, b, commit_sigs_verified=True
+                        self.state, block_id, b,
+                        commit_sigs_verified=True, pre_validated=True,
                     )
                 except ValueError as e:
                     # structural failure (hashes, time, proposer…): the
@@ -291,6 +299,18 @@ class BlocksyncReactor:
             await self._disconnect_banned()
             # yield so request/recv tasks keep the pipeline full
             await asyncio.sleep(0)
+
+    def _static_valset_prefix(self, window: list) -> list:
+        """Leading blocks of the window whose ValidatorsHash matches the
+        current set — the slice batch verification and per-block redo must
+        both scan (past the valset boundary different signers apply)."""
+        cur_hash = self.state.validators.hash()
+        prefix = []
+        for b in window[:-1]:
+            if b.header.validators_hash != cur_hash:
+                break
+            prefix.append(b)
+        return prefix
 
     def _commit_for(self, block, window: list):
         """SeenCommit for a fast-synced block = its successor's LastCommit."""
@@ -312,12 +332,7 @@ class BlocksyncReactor:
         past the valset boundary different signers apply and honest blocks
         would fail a naive check."""
         state = self.state
-        cur_hash = state.validators.hash()
-        applied = []
-        for b in window[:-1]:
-            if b.header.validators_hash != cur_hash:
-                break
-            applied.append(b)
+        applied = self._static_valset_prefix(window)
         for i, b in enumerate(applied):
             try:
                 if b.header.height > state.initial_height:

@@ -1,0 +1,57 @@
+"""Light client (reference light/): header verification at light-node
+trust, with sequential + skipping modes, witness cross-checks, and
+batched commit verification on device."""
+
+from .client import (
+    Client,
+    SEQUENTIAL,
+    SKIPPING,
+    TrustOptions,
+)
+from .errors import (
+    ErrInvalidHeader,
+    ErrLightBlockNotFound,
+    ErrLightClientAttack,
+    ErrNewValSetCantBeTrusted,
+    ErrNoResponse,
+    ErrOldHeaderExpired,
+    ErrVerificationFailed,
+    LightClientError,
+)
+from .provider import MemoryProvider, NodeBackedProvider, Provider
+from .store import LightBlockStore
+from .verifier import (
+    DEFAULT_TRUST_LEVEL,
+    header_expired,
+    validate_trust_level,
+    verify,
+    verify_adjacent,
+    verify_adjacent_range,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "Client",
+    "SEQUENTIAL",
+    "SKIPPING",
+    "TrustOptions",
+    "MemoryProvider",
+    "NodeBackedProvider",
+    "Provider",
+    "LightBlockStore",
+    "DEFAULT_TRUST_LEVEL",
+    "header_expired",
+    "validate_trust_level",
+    "verify",
+    "verify_adjacent",
+    "verify_adjacent_range",
+    "verify_non_adjacent",
+    "ErrInvalidHeader",
+    "ErrLightBlockNotFound",
+    "ErrLightClientAttack",
+    "ErrNewValSetCantBeTrusted",
+    "ErrNoResponse",
+    "ErrOldHeaderExpired",
+    "ErrVerificationFailed",
+    "LightClientError",
+]

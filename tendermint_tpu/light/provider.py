@@ -1,0 +1,98 @@
+"""Light-block providers (reference light/provider/provider.go).
+
+A Provider serves LightBlocks by height (0 = latest) and accepts
+evidence reports.  The in-memory provider mirrors the reference's mock
+(light/provider/mock/mock.go) and backs tests and in-proc nodes; an
+RPC-backed provider plugs in at the same interface once the RPC client
+exists (reference light/provider/http/http.go).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from tendermint_tpu.types.light import LightBlock
+
+from .errors import ErrLightBlockNotFound, ErrNoResponse
+
+
+class Provider(Protocol):
+    def chain_id(self) -> str: ...
+
+    def light_block(self, height: int) -> LightBlock:
+        """Return the LightBlock at height (0 or negative = latest).
+        Raises ErrLightBlockNotFound / ErrNoResponse."""
+        ...
+
+    def report_evidence(self, ev) -> None: ...
+
+
+class MemoryProvider:
+    """Dict-backed provider (reference light/provider/mock/mock.go:16-79)."""
+
+    def __init__(self, chain_id: str, light_blocks: dict[int, LightBlock] | None = None):
+        self._chain_id = chain_id
+        self.light_blocks: dict[int, LightBlock] = dict(light_blocks or {})
+        self.evidence: list = []
+        self.fail = False  # simulate a dead provider
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def add(self, lb: LightBlock) -> None:
+        self.light_blocks[lb.height] = lb
+
+    def latest_height(self) -> int:
+        return max(self.light_blocks) if self.light_blocks else 0
+
+    def light_block(self, height: int) -> LightBlock:
+        if self.fail:
+            raise ErrNoResponse("provider is down")
+        if height <= 0:
+            if not self.light_blocks:
+                raise ErrLightBlockNotFound("provider has no blocks")
+            height = self.latest_height()
+        lb = self.light_blocks.get(height)
+        if lb is None:
+            raise ErrLightBlockNotFound(f"no light block at height {height}")
+        return lb
+
+    def report_evidence(self, ev) -> None:
+        self.evidence.append(ev)
+
+
+class NodeBackedProvider:
+    """Provider reading straight from a local node's stores — the in-proc
+    analog of the reference's http provider, used by statesync tests and
+    light proxies colocated with a full node."""
+
+    def __init__(self, chain_id: str, block_store, state_store):
+        self._chain_id = chain_id
+        self.block_store = block_store
+        self.state_store = state_store
+        self.evidence: list = []
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        from tendermint_tpu.types.light import SignedHeader
+
+        if height <= 0:
+            height = self.block_store.height()
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_seen_commit(height) if (
+            height == self.block_store.height()
+        ) else self.block_store.load_block_commit(height)
+        if meta is None or commit is None:
+            raise ErrLightBlockNotFound(f"no block at height {height}")
+        vals = self.state_store.load_validators(height)
+        if vals is None:
+            raise ErrLightBlockNotFound(f"no validators at height {height}")
+        return LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validator_set=vals,
+        )
+
+    def report_evidence(self, ev) -> None:
+        self.evidence.append(ev)

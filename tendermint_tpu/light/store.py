@@ -1,0 +1,85 @@
+"""Trusted light-block store (reference light/store/store.go + db/db.go).
+
+Keyed by height over the framework's KVStore interface; works over MemDB
+for in-proc clients and SQLiteDB for the light proxy daemon.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from tendermint_tpu.store.db import KVStore, MemDB
+from tendermint_tpu.types.light import LightBlock
+
+_LB_PREFIX = b"lb/"
+
+
+def _key(height: int) -> bytes:
+    return _LB_PREFIX + struct.pack(">Q", height)
+
+
+class LightBlockStore:
+    """reference light/store/db/db.go:24-213 (dbs struct)."""
+
+    def __init__(self, db: KVStore | None = None):
+        self.db = db if db is not None else MemDB()
+        self._mtx = threading.Lock()
+        self._size = sum(1 for _ in self.db.iterate(_LB_PREFIX, _LB_PREFIX + b"\xff"))
+
+    def save_light_block(self, lb: LightBlock) -> None:
+        if lb.height <= 0:
+            raise ValueError("height <= 0")
+        with self._mtx:
+            exists = self.db.get(_key(lb.height)) is not None
+            self.db.set(_key(lb.height), lb.encode())
+            if not exists:
+                self._size += 1
+
+    def delete_light_block(self, height: int) -> None:
+        with self._mtx:
+            if self.db.get(_key(height)) is not None:
+                self.db.delete(_key(height))
+                self._size -= 1
+
+    def light_block(self, height: int) -> LightBlock | None:
+        raw = self.db.get(_key(height))
+        return LightBlock.decode(raw) if raw is not None else None
+
+    def latest_light_block(self) -> LightBlock | None:
+        last = None
+        for _, v in self.db.iterate(_LB_PREFIX, _LB_PREFIX + b"\xff"):
+            last = v
+        return LightBlock.decode(last) if last is not None else None
+
+    def first_light_block(self) -> LightBlock | None:
+        for _, v in self.db.iterate(_LB_PREFIX, _LB_PREFIX + b"\xff"):
+            return LightBlock.decode(v)
+        return None
+
+    def light_block_before(self, height: int) -> LightBlock | None:
+        """Largest stored height strictly below `height`
+        (reference db.go:152-176, used by backwards verification)."""
+        best = None
+        for k, v in self.db.iterate(_LB_PREFIX, _key(height)):
+            best = v
+        return LightBlock.decode(best) if best is not None else None
+
+    def size(self) -> int:
+        return self._size
+
+    def prune(self, target_size: int) -> None:
+        """Delete oldest blocks until at most target_size remain
+        (reference db.go:178-213)."""
+        with self._mtx:
+            excess = self._size - target_size
+            if excess <= 0:
+                return
+            doomed = []
+            for k, _ in self.db.iterate(_LB_PREFIX, _LB_PREFIX + b"\xff"):
+                if len(doomed) >= excess:
+                    break
+                doomed.append(k)
+            for k in doomed:
+                self.db.delete(k)
+            self._size -= len(doomed)

@@ -118,12 +118,16 @@ class BlockExecutor:
         block_id: BlockID,
         block: Block,
         commit_sigs_verified: bool = False,
+        pre_validated: bool = False,
     ) -> tuple[State, int]:
         """Execute the block against the app, persist responses, advance
         state, commit the app, update mempool/evidence.  Returns
         (new_state, retain_height).  commit_sigs_verified: see
-        validation.validate_block (fast-sync batch pre-verification)."""
-        self.validate_block(state, block, commit_sigs_verified)
+        validation.validate_block (fast-sync batch pre-verification).
+        pre_validated: caller already ran validate_block on this exact
+        (state, block) — skip re-validating (fast-sync hot path)."""
+        if not pre_validated:
+            self.validate_block(state, block, commit_sigs_verified)
 
         abci_responses = self._exec_block_on_app(state, block)
         self.store.save_abci_responses(block.header.height, abci_responses)

@@ -1,0 +1,125 @@
+"""SignedHeader and LightBlock — the light-client / statesync trust bundle.
+
+Semantics parity: reference types/light.go (LightBlock :18-98,
+SignedHeader :100-175).  A SignedHeader is a header plus the commit that
+signed it; a LightBlock adds the validator set that produced the commit,
+with the cross-check that the set hashes to the header's ValidatorsHash.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .block import Header
+from .commit import Commit
+from .validator import ValidatorSet
+
+
+@dataclass
+class SignedHeader:
+    header: Header
+    commit: Commit
+
+    @property
+    def height(self) -> int:
+        return self.header.height
+
+    def hash(self) -> bytes:
+        return self.header.hash() or b""
+
+    def validate_basic(self, chain_id: str) -> None:
+        """reference types/light.go:141-175."""
+        if self.header is None:
+            raise ValueError("missing header")
+        if self.commit is None:
+            raise ValueError("missing commit")
+        self.header.validate_basic()
+        self.commit.validate_basic()
+        if self.header.chain_id != chain_id:
+            raise ValueError(
+                f"header belongs to another chain {self.header.chain_id!r}, "
+                f"not {chain_id!r}"
+            )
+        if self.commit.height != self.header.height:
+            raise ValueError(
+                f"header and commit height mismatch: {self.header.height} vs "
+                f"{self.commit.height}"
+            )
+        hhash, chash = self.header.hash(), self.commit.block_id.hash
+        if hhash != chash:
+            raise ValueError(
+                f"commit signs block {chash.hex()}, header is block {hhash.hex()}"
+            )
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .message(1, self.header.encode(), always=True)
+            .message(2, self.commit.encode(), always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignedHeader":
+        f = fields_to_dict(data)
+        return cls(
+            header=Header.decode(f[1][0]),
+            commit=Commit.decode(f[2][0]),
+        )
+
+
+@dataclass
+class LightBlock:
+    signed_header: SignedHeader
+    validator_set: ValidatorSet
+
+    @property
+    def height(self) -> int:
+        return self.signed_header.height
+
+    @property
+    def header(self) -> Header:
+        return self.signed_header.header
+
+    @property
+    def commit(self) -> Commit:
+        return self.signed_header.commit
+
+    @property
+    def time_ns(self) -> int:
+        return self.signed_header.header.time_ns
+
+    def hash(self) -> bytes:
+        return self.signed_header.hash()
+
+    def validate_basic(self, chain_id: str) -> None:
+        """reference types/light.go:60-84: both parts valid, and the
+        validator set must hash to the header's ValidatorsHash."""
+        if self.signed_header is None:
+            raise ValueError("missing signed header")
+        if self.validator_set is None:
+            raise ValueError("missing validator set")
+        self.signed_header.validate_basic(chain_id)
+        self.validator_set.validate_basic()
+        if self.validator_set.hash() != self.signed_header.header.validators_hash:
+            raise ValueError(
+                "expected validator hash of header to match validator set hash"
+            )
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .message(1, self.signed_header.encode(), always=True)
+            .message(2, self.validator_set.encode(), always=True)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LightBlock":
+        f = fields_to_dict(data)
+        return cls(
+            signed_header=SignedHeader.decode(f[1][0]),
+            validator_set=ValidatorSet.decode(f[2][0]),
+        )

@@ -301,55 +301,17 @@ class ValidatorSet:
         """All non-absent signatures must be valid; ForBlock power > 2/3.
         One device call for the whole commit.  Raises ValueError on failure.
         (reference :662-712)"""
-        self._check_commit_basics(chain_id, block_id, height, commit)
-        bv = new_batch_verifier()
-        idxs = []
-        for idx, cs in enumerate(commit.signatures):
-            if cs.absent():
-                continue
-            val = self.validators[idx]
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
-            idxs.append(idx)
-        _, oks = bv.verify()
-        tallied = 0
-        for ok, idx in zip(oks, idxs):
-            if not ok:
-                raise ValueError(f"wrong signature (#{idx})")
-            if commit.signatures[idx].for_block():
-                tallied += self.validators[idx].voting_power
-        needed = self.total_voting_power() * 2 // 3
-        if tallied <= needed:
-            raise ValueError(f"insufficient voting power: got {tallied}, needed >{needed}")
+        batch_verify_commits(
+            [CommitVerifyJob(self, chain_id, block_id, height, commit, mode="full")]
+        )
 
     def verify_commit_light(self, chain_id: str, block_id: BlockID, height: int, commit) -> None:
-        """ForBlock signatures verified until cumulative power > 2/3.
-
-        Batched while preserving the reference's in-order early exit
-        (:720-766): signatures after the cutoff index are never consulted.
-        """
-        self._check_commit_basics(chain_id, block_id, height, commit)
-        needed = self.total_voting_power() * 2 // 3
-        bv = new_batch_verifier()
-        entries = []  # (idx, power)
-        running = 0
-        for idx, cs in enumerate(commit.signatures):
-            if not cs.for_block():
-                continue
-            val = self.validators[idx]
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
-            entries.append((idx, val.voting_power))
-            running += val.voting_power
-            if running > needed:
-                break  # the reference never verifies beyond the cutoff
-        _, oks = bv.verify()
-        tallied = 0
-        for ok, (idx, power) in zip(oks, entries):
-            if not ok:
-                raise ValueError(f"wrong signature (#{idx})")
-            tallied += power
-            if tallied > needed:
-                return
-        raise ValueError(f"insufficient voting power: got {tallied}, needed >{needed}")
+        """ForBlock signatures verified until cumulative power > 2/3,
+        preserving the reference's in-order early exit (:720-766):
+        signatures after the cutoff index are never consulted."""
+        batch_verify_commits(
+            [CommitVerifyJob(self, chain_id, block_id, height, commit, mode="light")]
+        )
 
     def verify_commit_light_trusting(self, chain_id: str, commit, trust_level: Fraction) -> None:
         """Address-matched verification to trust_level of this set's power

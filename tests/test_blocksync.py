@@ -340,3 +340,62 @@ def test_consensus_starts_with_fresh_wal_on_synced_chain(tmp_path):
         await cs.stop()
 
     asyncio.run(run())
+
+
+def test_fast_sync_recovers_from_forged_validators_hash():
+    """A block whose header.ValidatorsHash doesn't match the current set
+    makes the static-valset prefix empty at the apply point; the reactor
+    must redo + ban (not spin), then complete from an honest peer."""
+
+    async def run():
+        import copy
+
+        chain = ChainBuilder(n_vals=4).build(12)
+
+        evil_store = BlockStore(MemDB())
+        for h in range(1, 13):
+            b = chain.block_store.load_block(h)
+            sc = chain.block_store.load_seen_commit(h)
+            if h == 3:
+                b = copy.deepcopy(b)
+                b.header.validators_hash = b"\x11" * 32
+            evil_store.save_block(b, b.make_part_set(), sc)
+
+        network = MemoryNetwork()
+        evil_router, evil = _make_node(
+            chain.genesis, network, "cc" * 20, block_store=evil_store
+        )
+        evil.state = chain.state
+        honest_router, honest = _make_node(
+            chain.genesis, network, "aa" * 20, block_store=chain.block_store
+        )
+        honest.state = chain.state
+
+        caught_up = asyncio.Event()
+        client_router, client = _make_node(
+            chain.genesis, network, "bb" * 20, on_caught_up=lambda s: caught_up.set()
+        )
+
+        for r in (evil_router, honest_router, client_router):
+            await r.start()
+        for re in (evil, honest, client):
+            await re.start()
+        # evil first: heights are assigned to it before honest joins
+        await client_router.dial("cc" * 20)
+        await asyncio.sleep(1.0)
+        await client_router.dial("aa" * 20)
+
+        await asyncio.wait_for(caught_up.wait(), timeout=30)
+        assert client.store.height() == 11
+        for h in range(1, 12):
+            assert (
+                client.store.load_block(h).hash()
+                == chain.block_store.load_block(h).hash()
+            )
+
+        for re in (evil, honest, client):
+            await re.stop()
+        for r in (evil_router, honest_router, client_router):
+            await r.stop()
+
+    asyncio.run(run())
