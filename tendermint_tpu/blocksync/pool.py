@@ -33,6 +33,8 @@ class _PoolPeer:
     base: int = 0
     height: int = 0
     pending: set = field(default_factory=set)  # heights requested from this peer
+    reported: bool = True  # False until the first StatusResponse arrives
+    connected_at: float = field(default_factory=time.monotonic)
 
 
 @dataclass
@@ -57,12 +59,21 @@ class BlockPool:
         self._max_seen_height = 0  # monotonic; survives peer bans/removals
 
     # -- peers -----------------------------------------------------------
+    def add_peer(self, peer_id: str) -> None:
+        """Peer connected, StatusResponse not yet in: its chain tip is
+        unknown, so it blocks the caught-up verdict (bounded by the
+        grace window in is_caught_up)."""
+        if peer_id in self.banned or peer_id in self.peers:
+            return
+        self.peers[peer_id] = _PoolPeer(reported=False)
+
     def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
         """StatusResponse from a peer (pool.go SetPeerRange)."""
         if peer_id in self.banned:
             return
         p = self.peers.setdefault(peer_id, _PoolPeer())
         p.base, p.height = base, height
+        p.reported = True
         self._max_seen_height = max(self._max_seen_height, height)
         self.schedule()
 
@@ -215,11 +226,18 @@ class BlockPool:
         """True once the startup grace has passed and no known peer is
         ahead of us (reference pool.go:176, slightly more conservative:
         we sync all the way to max_peer_height-1 applied)."""
-        if time.monotonic() - self._started_at <= self._grace:
+        now = time.monotonic()
+        if now - self._started_at <= self._grace:
             return False
+        # Connected peers whose StatusResponse hasn't arrived yet block
+        # the caught-up verdict (reference pool.go:180 requires peers
+        # before declaring caught up) — their status may still reveal a
+        # higher chain tip.  Each unreported peer blocks for at most the
+        # grace window so a silent peer can't wedge the sync forever.
+        for p in self.peers.values():
+            if not p.reported and now - p.connected_at <= self._grace:
+                return False
         # Monotonic target: banning/losing the peer that advertised the
         # chain tip must NOT flip us to "caught up" while its heights are
         # still unapplied (reference keeps maxPeerHeight monotonic too).
-        return self.height >= self._max_seen_height or (
-            self._max_seen_height == 0 and not self.peers
-        )
+        return self.height >= self._max_seen_height

@@ -131,6 +131,7 @@ class BlocksyncReactor:
         while True:
             update = await self.peer_updates.get()
             if update.status == PeerStatus.UP:
+                self.pool.add_peer(update.node_id)
                 # announce our range + ask for theirs (reference AddPeer)
                 await self._send_status(to=update.node_id)
                 await self.channel.send(

@@ -88,6 +88,7 @@ class ConsensusState:
         self._tx_notifier = None  # Mempool with txs_available enabled
         self.done_height: asyncio.Event = asyncio.Event()  # pulsed every commit
         self.on_event = None  # callable(name: str, payload) — reactor hook
+        self.event_bus = None  # types.events.EventBus — external observers
         self._task: asyncio.Task | None = None
         self._stopping = False
 
@@ -234,12 +235,26 @@ class ConsensusState:
         elif step == Step.NEW_ROUND:
             self.enter_propose(ti.height, 0)
         elif step == Step.PROPOSE:
+            self._publish_timeout("propose")
             self.enter_prevote(ti.height, ti.round)
         elif step == Step.PREVOTE_WAIT:
+            self._publish_timeout("wait")
             self.enter_precommit(ti.height, ti.round)
         elif step == Step.PRECOMMIT_WAIT:
+            self._publish_timeout("wait")
             self.enter_precommit(ti.height, ti.round)
             self.enter_new_round(ti.height, ti.round + 1)
+
+    def _publish_timeout(self, kind: str) -> None:
+        if self.event_bus is None or self.replay_mode:
+            return
+        from tendermint_tpu.types import events as tmevents
+
+        rs = tmevents.EventDataRoundState(self.rs.height, self.rs.round, self.rs.step.name)
+        if kind == "propose":
+            self.event_bus.publish_timeout_propose(rs)
+        else:
+            self.event_bus.publish_timeout_wait(rs)
 
     # ------------------------------------------------------------------
     # state resets
@@ -325,6 +340,43 @@ class ConsensusState:
     def _emit(self, name: str, payload=None) -> None:
         if self.on_event is not None:
             self.on_event(name, payload if payload is not None else self.rs)
+        if self.event_bus is not None and not self.replay_mode:
+            self._publish_event(name, payload)
+
+    def _publish_event(self, name: str, payload) -> None:
+        """Mirror reactor-hook events onto the EventBus (reference
+        consensus/state.go publishes EventDataRoundState family via the
+        bus at the same transition points)."""
+        from tendermint_tpu.types import events as tmevents
+
+        rs = tmevents.EventDataRoundState(self.rs.height, self.rs.round, self.rs.step.name)
+        bus = self.event_bus
+        if name == "new_round_step":
+            bus.publish_new_round_step(rs)
+        elif name == "polka":
+            bus.publish_polka(rs)
+        elif name == "lock":
+            bus.publish_lock(rs)
+        elif name == "relock":
+            bus.publish_relock(rs)
+        elif name == "unlock":
+            bus.publish_unlock(rs)
+        elif name == "valid_block":
+            bus.publish_valid_block(rs)
+        elif name == "complete_proposal":
+            block = payload
+            bid = None
+            if block is not None:
+                from tendermint_tpu.types.basic import BlockID
+
+                bid = BlockID(block.hash(), self.rs.proposal_block_parts.header())
+            bus.publish_complete_proposal(
+                tmevents.EventDataCompleteProposal(
+                    self.rs.height, self.rs.round, self.rs.step.name, bid
+                )
+            )
+        elif name == "vote":
+            bus.publish_vote(payload)
 
     def _schedule(self, duration_ms: int, height: int, round_: int, step: Step) -> None:
         self.ticker.schedule_timeout(TimeoutInfo(duration_ms, height, round_, int(step)))
@@ -351,6 +403,19 @@ class ConsensusState:
             rs.proposal_block_parts = None
         rs.votes.set_round(round_ + 1)
         rs.triggered_timeout_precommit = False
+        if self.event_bus is not None and not self.replay_mode:
+            from tendermint_tpu.types import events as tmevents
+
+            proposer = rs.validators.get_proposer()
+            self.event_bus.publish_new_round(
+                tmevents.EventDataNewRound(
+                    height,
+                    round_,
+                    Step.NEW_ROUND.name,
+                    proposer.address if proposer else b"",
+                    rs.validators.get_by_address(proposer.address)[0] if proposer else -1,
+                )
+            )
 
         wait_for_txs = (
             not self.config.create_empty_blocks and round_ == 0 and not self._txs_available()

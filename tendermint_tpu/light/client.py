@@ -319,9 +319,16 @@ class Client:
     def _backwards(self, height: int, now: int) -> LightBlock:
         """Hash-chain verification below the trusted head
         (reference client.go:994-1044)."""
-        trusted = self.store.light_block_before(height + 1)
+        # Anchor on the closest trusted block ABOVE the target: the hash
+        # chain (LastBlockID) only links downward, so a trusted block
+        # below the target can't vouch for it.
+        trusted = self.store.light_block_after(height)
         if trusted is None:
             trusted = self.latest_trusted
+        if trusted is None or trusted.height <= height:
+            raise ErrLightBlockNotFound(
+                f"no trusted header above height {height} to verify backwards from"
+            )
         if verifier.header_expired(
             trusted.signed_header, self.trusting_period_ns, now
         ):
@@ -369,13 +376,12 @@ class Client:
                 lb = self._light_block_from(w, height)
             except LightClientError:
                 continue
-            old_primary = self.primary
             self.primary = w
             self.witnesses.pop(i)
-            # Keep the old primary around as a witness so divergence
-            # checks still cover it (reference keeps it out; we keep it —
-            # more cross-checking, strictly safer).
-            self.witnesses.append(old_primary)
+            # The failed primary is dropped from rotation (reference
+            # client.go:1046-1090): re-adding it would let two colluding
+            # providers swap places forever, turning a verification
+            # failure into unbounded retries.
             return lb
         return None
 

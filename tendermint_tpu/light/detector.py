@@ -70,18 +70,19 @@ def _handle_conflicting_headers(
         client.remove_witness(witness)
         return False
 
-    # Evidence against the primary (witness's view is the conflict proof)
-    # goes to the witness's chain... and vice versa: each side receives
-    # the OTHER side's block as the conflicting one (detector.go:120-147).
-    ev_against_primary = _make_evidence(common, witness_lb)
-    witness.report_evidence(ev_against_primary)
+    # Each side receives the OTHER side's block as the conflicting one
+    # (detector.go:120-147): the witness gets evidence packaging the
+    # PRIMARY's divergent header (so the honest chain sees the forgery),
+    # and the primary gets evidence packaging the witness's header.
     try:
         primary_at = next(
             lb for lb in reversed(primary_trace) if lb.height == witness_lb.height
         )
     except StopIteration:
         primary_at = primary_trace[-1]
-    ev_against_witness = _make_evidence(common, primary_at)
+    ev_against_primary = _make_evidence(common, primary_at)
+    witness.report_evidence(ev_against_primary)
+    ev_against_witness = _make_evidence(common, witness_lb)
     client.primary.report_evidence(ev_against_witness)
     return True
 

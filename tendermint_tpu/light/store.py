@@ -65,6 +65,13 @@ class LightBlockStore:
             best = v
         return LightBlock.decode(best) if best is not None else None
 
+    def light_block_after(self, height: int) -> LightBlock | None:
+        """Smallest stored height strictly above `height` — the anchor
+        for backwards (hash-chain) verification."""
+        for _, v in self.db.iterate(_key(height + 1), _LB_PREFIX + b"\xff"):
+            return LightBlock.decode(v)
+        return None
+
     def size(self) -> int:
         return self._size
 

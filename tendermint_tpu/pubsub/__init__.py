@@ -1,0 +1,13 @@
+from .pubsub import Server, Subscription, SubscriptionCancelledError
+from .query import ALL, Condition, Op, Query, parse
+
+__all__ = [
+    "ALL",
+    "Condition",
+    "Op",
+    "Query",
+    "Server",
+    "Subscription",
+    "SubscriptionCancelledError",
+    "parse",
+]

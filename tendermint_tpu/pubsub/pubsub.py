@@ -1,0 +1,149 @@
+"""In-process pub/sub with query-based subscriptions.
+
+Parity: reference libs/pubsub/pubsub.go:91-433 (Server, Subscribe /
+SubscribeUnbuffered / Unsubscribe / UnsubscribeAll / PublishWithEvents).
+
+Design difference (deliberate, asyncio-first): the reference serializes
+all mutations through a server goroutine reading a command channel and
+*blocks the publisher* when a subscriber's channel is full.  Here the
+runtime is a single-threaded event loop, so subscription state is plain
+dicts and publish never blocks: a buffered subscription whose queue
+overflows is CANCELLED with ``SubscriptionCancelledError("out of
+capacity")`` — the slow-client-eviction policy the reference implements
+one layer up (rpc/core/events.go closes slow websocket clients).  This
+keeps consensus liveness independent of event consumers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from .query import Query
+
+
+class SubscriptionCancelledError(Exception):
+    """Delivered to (and raised by) a cancelled subscription's consumer."""
+
+
+@dataclass
+class Message:
+    data: object
+    events: dict[str, list[str]] = field(default_factory=dict)
+
+
+class Subscription:
+    def __init__(self, client_id: str, query: Query, capacity: int):
+        self.client_id = client_id
+        self.query = query
+        self.capacity = capacity
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=capacity)
+        self._cancel_reason: str | None = None
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel_reason is not None
+
+    @property
+    def cancel_reason(self) -> str | None:
+        return self._cancel_reason
+
+    async def next(self) -> Message:
+        """Await the next matching message; raises once cancelled and drained."""
+        if self._cancel_reason is not None:
+            try:
+                item = self._q.get_nowait()
+            except asyncio.QueueEmpty:
+                raise SubscriptionCancelledError(self._cancel_reason) from None
+        else:
+            item = await self._q.get()
+        if item is _CANCEL:
+            raise SubscriptionCancelledError(self._cancel_reason or "cancelled")
+        return item
+
+    def _deliver(self, msg: Message) -> bool:
+        try:
+            self._q.put_nowait(msg)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def _cancel(self, reason: str) -> None:
+        if self._cancel_reason is not None:
+            return
+        self._cancel_reason = reason
+        try:
+            self._q.put_nowait(_CANCEL)
+        except asyncio.QueueFull:
+            pass  # consumer will see the reason after draining
+
+
+_CANCEL = object()
+
+
+class Server:
+    """Query-routed fan-out of published messages to subscriptions."""
+
+    def __init__(self, buffer_capacity: int = 100):
+        self.buffer_capacity = buffer_capacity
+        # client_id -> query string -> Subscription
+        self._subs: dict[str, dict[str, Subscription]] = {}
+
+    # -- subscribe management -------------------------------------------
+    def subscribe(self, client_id: str, query: Query, capacity: int | None = None) -> Subscription:
+        cap = self.buffer_capacity if capacity is None else capacity
+        if cap <= 0:
+            raise ValueError("capacity must be positive (no blocking publishers)")
+        by_query = self._subs.setdefault(client_id, {})
+        if str(query) in by_query:
+            raise ValueError(f"{client_id} already subscribed to {query!s}")
+        sub = Subscription(client_id, query, cap)
+        by_query[str(query)] = sub
+        return sub
+
+    def unsubscribe(self, client_id: str, query: Query | str) -> None:
+        qs = str(query)
+        by_query = self._subs.get(client_id)
+        if not by_query or qs not in by_query:
+            raise KeyError(f"{client_id} not subscribed to {qs}")
+        by_query.pop(qs)._cancel("unsubscribed")
+        if not by_query:
+            del self._subs[client_id]
+
+    def unsubscribe_all(self, client_id: str) -> None:
+        by_query = self._subs.pop(client_id, None)
+        if not by_query:
+            raise KeyError(f"{client_id} has no subscriptions")
+        for sub in by_query.values():
+            sub._cancel("unsubscribed")
+
+    def num_clients(self) -> int:
+        return len(self._subs)
+
+    def num_client_subscriptions(self, client_id: str) -> int:
+        return len(self._subs.get(client_id, ()))
+
+    # -- publish ---------------------------------------------------------
+    def publish(self, data: object, events: dict[str, list[str]] | None = None) -> None:
+        events = events or {}
+        msg = Message(data, events)
+        evicted: list[tuple[str, str]] = []
+        for client_id, by_query in self._subs.items():
+            for qs, sub in by_query.items():
+                if sub.cancelled or not sub.query.matches(events):
+                    continue
+                if not sub._deliver(msg):
+                    sub._cancel("out of capacity")
+                    evicted.append((client_id, qs))
+        for client_id, qs in evicted:
+            by_query = self._subs.get(client_id)
+            if by_query and qs in by_query:
+                del by_query[qs]
+                if not by_query:
+                    del self._subs[client_id]
+
+    def shutdown(self) -> None:
+        for by_query in self._subs.values():
+            for sub in by_query.values():
+                sub._cancel("server shutdown")
+        self._subs.clear()

@@ -1,0 +1,241 @@
+"""Event query language: ``tm.event='NewBlock' AND tx.height>5``.
+
+Parity: reference libs/pubsub/query/query.go (semantics; the reference
+uses a PEG-generated parser, here a hand-written recursive-descent one —
+the grammar is small enough that a parser generator buys nothing).
+
+Semantics replicated exactly:
+- conditions are joined by AND only (the reference grammar has no OR);
+- a condition is ``<composite key> <op> <operand>``;
+- operators: = < <= > >= CONTAINS EXISTS;
+- operands: single-quoted strings, integer/float numbers,
+  ``TIME <RFC3339>``, ``DATE <YYYY-MM-DD>``;
+- events are a map of composite key ("type.attr") → list of string
+  values; a condition matches when ANY value for its key satisfies it,
+  and a query matches when ALL its conditions match
+  (libs/pubsub/query/query.go:154-192 Matches);
+- for numeric comparisons against a string value, the number embedded in
+  the value is extracted with the reference's ``([0-9\\.]+)`` regex
+  (query.go:21, matchValue).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import enum
+import re
+from dataclasses import dataclass
+
+_NUM_RE = re.compile(r"([0-9\.]+)")
+_TAG_RE = re.compile(r"[A-Za-z0-9._\-/]+")
+
+
+class Op(enum.Enum):
+    LE = "<="
+    GE = ">="
+    LT = "<"
+    GT = ">"
+    EQ = "="
+    CONTAINS = "CONTAINS"
+    EXISTS = "EXISTS"
+
+
+@dataclass(frozen=True)
+class Condition:
+    composite_key: str
+    op: Op
+    operand: object = None  # str | int | float | datetime | None
+
+
+class QueryError(ValueError):
+    pass
+
+
+class _Lexer:
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def skip_ws(self) -> None:
+        while self.i < len(self.s) and self.s[self.i].isspace():
+            self.i += 1
+
+    def eof(self) -> bool:
+        self.skip_ws()
+        return self.i >= len(self.s)
+
+    def keyword(self, kw: str) -> bool:
+        self.skip_ws()
+        if self.s[self.i : self.i + len(kw)].upper() == kw:
+            end = self.i + len(kw)
+            # keywords are word-delimited
+            if end >= len(self.s) or not (self.s[end].isalnum() or self.s[end] == "_"):
+                self.i = end
+                return True
+        return False
+
+    def tag(self) -> str:
+        self.skip_ws()
+        m = _TAG_RE.match(self.s, self.i)
+        if not m:
+            raise QueryError(f"expected event attribute at {self.i}: {self.s!r}")
+        self.i = m.end()
+        return m.group(0)
+
+    def op(self) -> Op:
+        self.skip_ws()
+        for tok, op in (
+            ("<=", Op.LE),
+            (">=", Op.GE),
+            ("<", Op.LT),
+            (">", Op.GT),
+            ("=", Op.EQ),
+        ):
+            if self.s.startswith(tok, self.i):
+                self.i += len(tok)
+                return op
+        if self.keyword("CONTAINS"):
+            return Op.CONTAINS
+        if self.keyword("EXISTS"):
+            return Op.EXISTS
+        raise QueryError(f"expected operator at {self.i}: {self.s!r}")
+
+    def operand(self, op: Op) -> object:
+        self.skip_ws()
+        if self.s.startswith("'", self.i):
+            end = self.s.find("'", self.i + 1)
+            if end < 0:
+                raise QueryError("unterminated string operand")
+            val = self.s[self.i + 1 : end]
+            self.i = end + 1
+            return val
+        if self.keyword("TIME"):
+            self.skip_ws()
+            tok = self._word()
+            try:
+                return _dt.datetime.fromisoformat(tok.replace("Z", "+00:00"))
+            except ValueError as e:
+                raise QueryError(f"bad TIME operand {tok!r}") from e
+        if self.keyword("DATE"):
+            self.skip_ws()
+            tok = self._word()
+            try:
+                d = _dt.date.fromisoformat(tok)
+            except ValueError as e:
+                raise QueryError(f"bad DATE operand {tok!r}") from e
+            return _dt.datetime(d.year, d.month, d.day, tzinfo=_dt.timezone.utc)
+        tok = self._word()
+        if not tok:
+            raise QueryError(f"expected operand at {self.i}: {self.s!r}")
+        try:
+            if "." in tok:
+                return float(tok)
+            return int(tok)
+        except ValueError as e:
+            if op is Op.CONTAINS:
+                return tok  # bare word allowed for CONTAINS in practice
+            raise QueryError(f"bad operand {tok!r}") from e
+
+    def _word(self) -> str:
+        start = self.i
+        while self.i < len(self.s) and not self.s[self.i].isspace():
+            self.i += 1
+        return self.s[start : self.i]
+
+
+def parse(s: str) -> "Query":
+    """Parse a query string; raises QueryError on bad grammar."""
+    lex = _Lexer(s)
+    conditions: list[Condition] = []
+    if lex.eof():
+        raise QueryError("empty query")
+    while True:
+        key = lex.tag()
+        op = lex.op()
+        operand = None if op is Op.EXISTS else lex.operand(op)
+        conditions.append(Condition(key, op, operand))
+        if lex.eof():
+            break
+        if not lex.keyword("AND"):
+            raise QueryError(f"expected AND at {lex.i}: {s!r}")
+    return Query(s, tuple(conditions))
+
+
+def _match_value(value: str, op: Op, operand: object) -> bool:
+    if op is Op.EXISTS:
+        return True
+    if isinstance(operand, _dt.datetime):
+        m = re.search(r"[0-9T:\-\+\.Z]+", value)
+        if not m:
+            return False
+        try:
+            v = _dt.datetime.fromisoformat(m.group(0).replace("Z", "+00:00"))
+        except ValueError:
+            return False
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=_dt.timezone.utc)
+        return _cmp(v, op, operand)
+    if isinstance(operand, (int, float)):
+        m = _NUM_RE.search(value)
+        if not m:
+            return False
+        try:
+            v: float | int = float(m.group(0)) if "." in m.group(0) else int(m.group(0))
+        except ValueError:
+            return False
+        return _cmp(v, op, operand)
+    # string operand
+    if op is Op.EQ:
+        return value == operand
+    if op is Op.CONTAINS:
+        return str(operand) in value
+    return False  # ordered comparison on strings is not defined (reference parity)
+
+
+def _cmp(v, op: Op, operand) -> bool:
+    if op is Op.EQ:
+        return v == operand
+    if op is Op.LT:
+        return v < operand
+    if op is Op.LE:
+        return v <= operand
+    if op is Op.GT:
+        return v > operand
+    if op is Op.GE:
+        return v >= operand
+    return False
+
+
+@dataclass(frozen=True)
+class Query:
+    """A parsed query. Construct via parse()."""
+
+    s: str
+    conditions: tuple[Condition, ...] = ()
+
+    def matches(self, events: dict[str, list[str]]) -> bool:
+        if not events and self.conditions:
+            return False
+        for cond in self.conditions:
+            values = events.get(cond.composite_key)
+            if not values:
+                return False
+            if not any(_match_value(v, cond.op, cond.operand) for v in values):
+                return False
+        return True
+
+    def __str__(self) -> str:
+        return self.s
+
+
+class _All(Query):
+    """Matches every message (reference libs/pubsub/query/empty.go)."""
+
+    def __init__(self):
+        super().__init__("")
+
+    def matches(self, events: dict[str, list[str]]) -> bool:  # noqa: ARG002
+        return True
+
+
+ALL = _All()

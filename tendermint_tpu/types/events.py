@@ -1,0 +1,245 @@
+"""Typed events + EventBus over pubsub.
+
+Parity: reference types/events.go (event names, reserved composite keys,
+canned queries) and types/event_bus.go (EventBus wrapper: stringifies
+ABCI events into "type.attr" composite keys and adds the reserved
+``tm.event`` key).  Sync publish — see pubsub.Server for why publishing
+never blocks here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu import pubsub
+from tendermint_tpu.pubsub.query import Query, parse
+
+# -- event names (reference types/events.go:19-46) ---------------------------
+EventNewBlock = "NewBlock"
+EventNewBlockHeader = "NewBlockHeader"
+EventNewEvidence = "NewEvidence"
+EventTx = "Tx"
+EventVote = "Vote"
+EventValidBlock = "ValidBlock"
+EventNewRoundStep = "NewRoundStep"
+EventNewRound = "NewRound"
+EventCompleteProposal = "CompleteProposal"
+EventPolka = "Polka"
+EventRelock = "Relock"
+EventLock = "Lock"
+EventUnlock = "Unlock"
+EventTimeoutPropose = "TimeoutPropose"
+EventTimeoutWait = "TimeoutWait"
+EventValidatorSetUpdates = "ValidatorSetUpdates"
+
+# -- reserved composite keys (reference types/events.go:131-138) -------------
+EventTypeKey = "tm.event"
+TxHashKey = "tx.hash"
+TxHeightKey = "tx.height"
+
+
+def query_for_event(event_type: str) -> Query:
+    return parse(f"{EventTypeKey}='{event_type}'")
+
+
+EventQueryNewBlock = query_for_event(EventNewBlock)
+EventQueryNewBlockHeader = query_for_event(EventNewBlockHeader)
+EventQueryNewEvidence = query_for_event(EventNewEvidence)
+EventQueryTx = query_for_event(EventTx)
+EventQueryVote = query_for_event(EventVote)
+EventQueryValidBlock = query_for_event(EventValidBlock)
+EventQueryNewRoundStep = query_for_event(EventNewRoundStep)
+EventQueryNewRound = query_for_event(EventNewRound)
+EventQueryCompleteProposal = query_for_event(EventCompleteProposal)
+EventQueryPolka = query_for_event(EventPolka)
+EventQueryLock = query_for_event(EventLock)
+EventQueryUnlock = query_for_event(EventUnlock)
+EventQueryRelock = query_for_event(EventRelock)
+EventQueryTimeoutPropose = query_for_event(EventTimeoutPropose)
+EventQueryTimeoutWait = query_for_event(EventTimeoutWait)
+EventQueryValidatorSetUpdates = query_for_event(EventValidatorSetUpdates)
+
+
+def query_for_tx_hash(tx_hash_hex: str) -> Query:
+    return parse(f"{EventTypeKey}='{EventTx}' AND {TxHashKey}='{tx_hash_hex.upper()}'")
+
+
+# -- event data (reference types/events.go:53-128) ---------------------------
+@dataclass
+class EventDataNewBlock:
+    block: object
+    block_id: object
+    result_begin_block_events: list = field(default_factory=list)
+    result_end_block_events: list = field(default_factory=list)
+
+
+@dataclass
+class EventDataNewBlockHeader:
+    header: object
+    num_txs: int
+    result_begin_block_events: list = field(default_factory=list)
+    result_end_block_events: list = field(default_factory=list)
+
+
+@dataclass
+class TxResult:
+    """abci.TxResult (proto/tendermint/abci/types.proto) — also the tx
+    indexer's stored record."""
+
+    height: int
+    index: int
+    tx: bytes
+    result: object  # ResponseDeliverTx
+
+
+@dataclass
+class EventDataTx:
+    tx_result: TxResult
+
+
+@dataclass
+class EventDataRoundState:
+    height: int
+    round: int
+    step: str
+
+
+@dataclass
+class EventDataNewRound:
+    height: int
+    round: int
+    step: str
+    proposer_address: bytes = b""
+    proposer_index: int = -1
+
+
+@dataclass
+class EventDataCompleteProposal:
+    height: int
+    round: int
+    step: str
+    block_id: object = None
+
+
+@dataclass
+class EventDataVote:
+    vote: object
+
+
+@dataclass
+class EventDataNewEvidence:
+    evidence: object
+    height: int
+
+
+@dataclass
+class EventDataValidatorSetUpdates:
+    validator_updates: list
+
+
+def stringify_abci_events(abci_events) -> dict[str, list[str]]:
+    """ABCI events → {"type.attr": [values]} composite map
+    (reference types/event_bus.go:112-132)."""
+    out: dict[str, list[str]] = {}
+    for ev in abci_events or ():
+        if not ev.type:
+            continue
+        for attr in ev.attributes:
+            if not attr.key:
+                continue
+            key = f"{ev.type}.{attr.key.decode('utf-8', 'replace') if isinstance(attr.key, bytes) else attr.key}"
+            val = attr.value.decode("utf-8", "replace") if isinstance(attr.value, bytes) else str(attr.value)
+            out.setdefault(key, []).append(val)
+    return out
+
+
+class EventBus:
+    """Typed publisher over a pubsub.Server (reference types/event_bus.go)."""
+
+    def __init__(self, server: pubsub.Server | None = None):
+        self.pubsub = server or pubsub.Server()
+
+    # subscription surface (delegates)
+    def subscribe(self, client_id: str, query: Query, capacity: int | None = None):
+        return self.pubsub.subscribe(client_id, query, capacity)
+
+    def unsubscribe(self, client_id: str, query) -> None:
+        self.pubsub.unsubscribe(client_id, query)
+
+    def unsubscribe_all(self, client_id: str) -> None:
+        self.pubsub.unsubscribe_all(client_id)
+
+    def shutdown(self) -> None:
+        self.pubsub.shutdown()
+
+    # -- typed publishers ------------------------------------------------
+    def _publish(self, event_type: str, data, extra: dict[str, list[str]] | None = None) -> None:
+        events = dict(extra or {})
+        events.setdefault(EventTypeKey, []).append(event_type)
+        self.pubsub.publish(data, events)
+
+    def publish_new_block(self, block, block_id, abci_responses) -> None:
+        begin = list(getattr(abci_responses, "begin_block_events", None) or [])
+        end_block = getattr(abci_responses, "end_block", None)
+        end = list(getattr(end_block, "events", None) or [])
+        data = EventDataNewBlock(block, block_id, begin, end)
+        self._publish(EventNewBlock, data, stringify_abci_events(begin + end))
+
+    def publish_new_block_header(self, header, num_txs: int, abci_responses) -> None:
+        begin = list(getattr(abci_responses, "begin_block_events", None) or [])
+        end_block = getattr(abci_responses, "end_block", None)
+        end = list(getattr(end_block, "events", None) or [])
+        data = EventDataNewBlockHeader(header, num_txs, begin, end)
+        self._publish(EventNewBlockHeader, data, stringify_abci_events(begin + end))
+
+    def publish_tx(self, height: int, index: int, tx, deliver_tx) -> None:
+        """Adds reserved tx.hash / tx.height keys on top of the result's own
+        events (reference types/event_bus.go:176-188)."""
+        from tendermint_tpu.crypto import tmhash
+
+        tx_bytes = bytes(tx)
+        events = stringify_abci_events(getattr(deliver_tx, "events", None))
+        events.setdefault(TxHashKey, []).append(tmhash.sum_sha256(tx_bytes).hex().upper())
+        events.setdefault(TxHeightKey, []).append(str(height))
+        data = EventDataTx(TxResult(height, index, tx_bytes, deliver_tx))
+        self._publish(EventTx, data, events)
+
+    def publish_vote(self, vote) -> None:
+        self._publish(EventVote, EventDataVote(vote))
+
+    def publish_new_evidence(self, evidence, height: int) -> None:
+        self._publish(EventNewEvidence, EventDataNewEvidence(evidence, height))
+
+    def publish_validator_set_updates(self, val_updates) -> None:
+        self._publish(EventValidatorSetUpdates, EventDataValidatorSetUpdates(list(val_updates)))
+
+    # round-state family (consensus)
+    def publish_new_round_step(self, rs: EventDataRoundState) -> None:
+        self._publish(EventNewRoundStep, rs)
+
+    def publish_new_round(self, data: EventDataNewRound) -> None:
+        self._publish(EventNewRound, data)
+
+    def publish_complete_proposal(self, data: EventDataCompleteProposal) -> None:
+        self._publish(EventCompleteProposal, data)
+
+    def publish_valid_block(self, rs: EventDataRoundState) -> None:
+        self._publish(EventValidBlock, rs)
+
+    def publish_polka(self, rs: EventDataRoundState) -> None:
+        self._publish(EventPolka, rs)
+
+    def publish_lock(self, rs: EventDataRoundState) -> None:
+        self._publish(EventLock, rs)
+
+    def publish_relock(self, rs: EventDataRoundState) -> None:
+        self._publish(EventRelock, rs)
+
+    def publish_unlock(self, rs: EventDataRoundState) -> None:
+        self._publish(EventUnlock, rs)
+
+    def publish_timeout_propose(self, rs: EventDataRoundState) -> None:
+        self._publish(EventTimeoutPropose, rs)
+
+    def publish_timeout_wait(self, rs: EventDataRoundState) -> None:
+        self._publish(EventTimeoutWait, rs)

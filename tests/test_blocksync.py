@@ -399,3 +399,35 @@ def test_fast_sync_recovers_from_forged_validators_hash():
             await r.stop()
 
     asyncio.run(run())
+
+
+def test_unreported_peer_blocks_caught_up():
+    """Regression: a connected peer whose StatusResponse hasn't arrived
+    must block is_caught_up (its status may reveal a higher tip), bounded
+    by the grace window so a silent peer can't wedge the sync."""
+    import time as _time
+
+    async def run():
+        pool = BlockPool(1, startup_grace_s=0.05)
+        pool.add_peer("quiet")
+        _time.sleep(0.06)  # past the startup grace
+        # connected-but-unreported peer within its own grace → not caught up
+        pool.peers["quiet"].connected_at = _time.monotonic()
+        assert not pool.is_caught_up()
+        # once it reports an equal height, we are caught up
+        pool.set_peer_range("quiet", 0, 1)
+        assert pool.is_caught_up()
+
+    asyncio.run(run())
+
+
+def test_silent_peer_cannot_wedge_caught_up():
+    async def run():
+        pool = BlockPool(1, startup_grace_s=0.05)
+        pool.add_peer("silent")
+        import time as _time
+
+        _time.sleep(0.12)  # past startup grace AND the peer's own grace
+        assert pool.is_caught_up()
+
+    asyncio.run(run())

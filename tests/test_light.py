@@ -415,3 +415,60 @@ def test_client_store_clean_after_detected_attack(chain):
             f"forged block at height {h} persisted to trusted store"
         )
     assert c.last_trusted_height() == 1
+
+
+def test_backwards_returns_requested_height_with_lower_trusted_blocks(chain):
+    """Regression: _backwards must anchor on the closest trusted block
+    ABOVE the target.  With blocks both below and above the target in the
+    store (root of trust at 1, verified head at 12), asking for an
+    unstored intermediate height must return THAT height, hash-verified —
+    not the nearest lower stored block."""
+    c = _client(chain, mode=SKIPPING, height=1)
+    c.verify_light_block_at_height(12, now_at(12))  # store now holds 1, pivots, 12
+    lb = c.verify_light_block_at_height(4, now_at(12))
+    assert lb.height == 4
+    assert lb.hash() == chain.blocks[4].hash()
+
+
+def test_detector_reports_forged_block_to_honest_chain(chain):
+    """Regression: the witness must receive evidence packaging the
+    PRIMARY's conflicting header, and the primary the witness's
+    (detector.go:120-147) — not their own blocks back."""
+    evil = chain.fork()
+    evil.blocks = {h: lb for h, lb in evil.blocks.items() if h <= 6}
+    evil.last_block_id = evil.blocks[6].commit.block_id
+    evil.extend(6, app_hash=b"\x66" * 32)
+    w = evil.provider()
+    primary = chain.provider()
+    c = Client(
+        CHAIN_ID,
+        TrustOptions(period_ns=PERIOD, height=1, hash=chain.blocks[1].hash()),
+        primary,
+        [w],
+        now_fn=lambda: now_at(12),
+    )
+    with pytest.raises(ErrLightClientAttack):
+        c.verify_light_block_at_height(12, now_at(12))
+    assert w.evidence and primary.evidence
+    # witness got the primary's block as the conflict proof
+    assert w.evidence[0].conflicting_header_hash == chain.blocks[12].hash()
+    # primary got the witness's forged block
+    assert primary.evidence[0].conflicting_header_hash == evil.blocks[12].hash()
+
+
+def test_promoted_primary_is_dropped_from_rotation(chain):
+    """Regression: a replaced primary must leave the provider pool —
+    re-adding it lets two bad providers swap places forever."""
+    dead = MemoryProvider(CHAIN_ID, {1: chain.blocks[1]})
+    witness = chain.provider()
+    c = Client(
+        CHAIN_ID,
+        TrustOptions(period_ns=PERIOD, height=1, hash=chain.blocks[1].hash()),
+        dead,
+        [witness],
+        now_fn=lambda: now_at(12),
+    )
+    dead.fail = True
+    c.verify_light_block_at_height(12, now_at(12))
+    assert c.primary is witness
+    assert dead not in c.witnesses

@@ -1,0 +1,213 @@
+"""Pubsub server + query language + EventBus.
+
+Scenario parity: reference libs/pubsub/pubsub_test.go and
+libs/pubsub/query/query_test.go (operator matrix, AND semantics,
+number-embedded-in-string extraction) and types/event_bus_test.go
+(composite-key stringification, reserved tx.hash/tx.height keys).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu import pubsub
+from tendermint_tpu.pubsub.query import ALL, Op, QueryError, parse
+from tendermint_tpu.types import events as tmevents
+
+
+# ---------------------------------------------------------------------------
+# query language
+# ---------------------------------------------------------------------------
+
+def test_parse_conditions():
+    q = parse("tm.event='NewBlock' AND tx.height>5")
+    assert len(q.conditions) == 2
+    assert q.conditions[0].composite_key == "tm.event"
+    assert q.conditions[0].op is Op.EQ
+    assert q.conditions[0].operand == "NewBlock"
+    assert q.conditions[1].op is Op.GT
+    assert q.conditions[1].operand == 5
+
+
+@pytest.mark.parametrize(
+    "qs,events,want",
+    [
+        # reference query_test.go matrix (subset, same semantics)
+        ("tm.events.type='NewBlock'", {"tm.events.type": ["NewBlock"]}, True),
+        ("tm.events.type='NewBlock'", {"tm.events.type": ["NewTx"]}, False),
+        ("tx.gas>7", {"tx.gas": ["8"]}, True),
+        ("tx.gas>7", {"tx.gas": ["7"]}, False),
+        ("tx.gas>=7", {"tx.gas": ["7"]}, True),
+        ("tx.gas<7", {"tx.gas": ["6.5"]}, True),
+        ("body.weight>=3.5", {"body.weight": ["3.5"]}, True),
+        ("body.weight<=4.5", {"body.weight": ["4.5"]}, True),
+        # number embedded in a string value is extracted (numRegex)
+        ("account.balance>100", {"account.balance": ["1000ATOM"]}, True),
+        ("msg.text CONTAINS 'hello'", {"msg.text": ["why hello there"]}, True),
+        ("msg.text CONTAINS 'hello'", {"msg.text": ["goodbye"]}, False),
+        ("account.owner EXISTS", {"account.owner": ["Ivan"]}, True),
+        ("account.owner EXISTS", {"other.key": ["x"]}, False),
+        # AND: all conditions must hold; any value per key may satisfy
+        (
+            "tm.event='Tx' AND tx.height=5",
+            {"tm.event": ["Tx"], "tx.height": ["5"]},
+            True,
+        ),
+        (
+            "tm.event='Tx' AND tx.height=5",
+            {"tm.event": ["Tx"], "tx.height": ["6"]},
+            False,
+        ),
+        ("k='a'", {"k": ["b", "a"]}, True),
+        # dates/times
+        (
+            "tx.date>DATE 2013-05-03",
+            {"tx.date": ["2013-05-04T00:00:00Z"]},
+            True,
+        ),
+        (
+            "tx.time>=TIME 2013-05-03T14:45:00Z",
+            {"tx.time": ["2013-05-03T14:45:00Z"]},
+            True,
+        ),
+    ],
+)
+def test_query_matches(qs, events, want):
+    assert parse(qs).matches(events) is want
+
+
+def test_query_errors():
+    for bad in ["", "=", "tm.event=", "tm.event='x' OR tm.event='y'", "tm.event='unterminated"]:
+        with pytest.raises(QueryError):
+            parse(bad)
+
+
+def test_all_matches_everything():
+    assert ALL.matches({}) and ALL.matches({"a": ["b"]})
+
+
+# ---------------------------------------------------------------------------
+# pubsub server
+# ---------------------------------------------------------------------------
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def test_subscribe_publish_unsubscribe():
+    async def main():
+        s = pubsub.Server()
+        sub = s.subscribe("client", parse("tm.event='Tx'"))
+        s.publish("msg1", {"tm.event": ["Tx"]})
+        s.publish("other", {"tm.event": ["NewBlock"]})
+        msg = await sub.next()
+        assert msg.data == "msg1"
+        s.unsubscribe("client", parse("tm.event='Tx'"))
+        with pytest.raises(pubsub.SubscriptionCancelledError):
+            await sub.next()
+        assert s.num_clients() == 0
+
+    run(main())
+
+
+def test_duplicate_subscribe_rejected():
+    s = pubsub.Server()
+    s.subscribe("c", parse("a='b'"))
+    with pytest.raises(ValueError):
+        s.subscribe("c", parse("a='b'"))
+
+
+def test_slow_client_evicted():
+    async def main():
+        s = pubsub.Server()
+        sub = s.subscribe("slow", ALL, capacity=2)
+        for i in range(5):
+            s.publish(i, {"k": ["v"]})
+        # first two delivered, then evicted
+        assert (await sub.next()).data == 0
+        assert (await sub.next()).data == 1
+        with pytest.raises(pubsub.SubscriptionCancelledError) as ei:
+            await sub.next()
+        assert "capacity" in str(ei.value)
+        assert s.num_clients() == 0
+
+    run(main())
+
+
+def test_unsubscribe_all():
+    s = pubsub.Server()
+    s.subscribe("c", parse("a='1'"))
+    s.subscribe("c", parse("b='2'"))
+    assert s.num_client_subscriptions("c") == 2
+    s.unsubscribe_all("c")
+    assert s.num_clients() == 0
+    with pytest.raises(KeyError):
+        s.unsubscribe_all("c")
+
+
+# ---------------------------------------------------------------------------
+# event bus
+# ---------------------------------------------------------------------------
+
+def _deliver_tx_with_events():
+    from tendermint_tpu.abci import types as abci
+
+    return abci.ResponseDeliverTx(
+        code=0,
+        events=[
+            abci.Event(
+                type="transfer",
+                attributes=[
+                    abci.EventAttribute(key=b"sender", value=b"alice", index=True),
+                    abci.EventAttribute(key=b"amount", value=b"100", index=True),
+                ],
+            )
+        ],
+    )
+
+
+def test_event_bus_tx_reserved_keys():
+    async def main():
+        from tendermint_tpu.crypto import tmhash
+
+        bus = tmevents.EventBus()
+        tx = b"hello-tx"
+        h = tmhash.sum_sha256(tx).hex().upper()
+        sub = bus.subscribe("rpc", parse(f"tm.event='Tx' AND tx.hash='{h}'"))
+        other = bus.subscribe("rpc2", parse("transfer.sender='alice'"))
+        bus.publish_tx(12, 0, tx, _deliver_tx_with_events())
+        msg = await sub.next()
+        assert msg.data.tx_result.height == 12
+        assert msg.data.tx_result.tx == tx
+        assert (await other.next()).data.tx_result.index == 0
+
+    run(main())
+
+
+def test_event_bus_consensus_wiring(tmp_path):
+    """A running 1-validator chain publishes NewBlock/NewRound events."""
+    from tendermint_tpu.crypto.batch import set_default_backend
+    from tests.test_consensus import Node
+
+    set_default_backend("cpu")
+
+    async def main():
+        n = Node(tmp_path)
+        bus = tmevents.EventBus()
+        n.cs.event_bus = bus
+        n.executor.event_bus = bus
+        nb = bus.subscribe("t", tmevents.EventQueryNewBlock)
+        nr = bus.subscribe("t2", tmevents.EventQueryNewRound)
+        await n.cs.start()
+        try:
+            msg = await asyncio.wait_for(nb.next(), timeout=20)
+            assert msg.data.block.header.height >= 1
+            rmsg = await asyncio.wait_for(nr.next(), timeout=20)
+            assert rmsg.data.height >= 1
+        finally:
+            await n.stop()
+
+    try:
+        run(main())
+    finally:
+        set_default_backend("auto")
