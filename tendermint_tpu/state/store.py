@@ -243,22 +243,40 @@ def _decode_event(raw: bytes):
     return Event(type=t.decode() if isinstance(t, bytes) else "", attributes=attrs)
 
 
+def encode_deliver_tx(dtx) -> bytes:
+    """ResponseDeliverTx → proto bytes (abci/types.proto field numbers —
+    shared by ABCIResponses persistence and the tx indexer)."""
+    dw = (
+        ProtoWriter()
+        .varint(1, dtx.code)
+        .bytes_(2, dtx.data)
+        .string(3, dtx.log)
+        .varint(5, dtx.gas_wanted)
+        .varint(6, dtx.gas_used)
+    )
+    for ev in dtx.events:
+        dw.message(7, _encode_event(ev), always=True)
+    return dw.bytes_out()
+
+
+def decode_deliver_tx(raw: bytes) -> ResponseDeliverTx:
+    df = fields_to_dict(raw)
+    return ResponseDeliverTx(
+        code=df.get(1, [0])[0],
+        data=df.get(2, [b""])[0],
+        log=df.get(3, [b""])[0].decode() if df.get(3) else "",
+        gas_wanted=df.get(5, [0])[0],
+        gas_used=df.get(6, [0])[0],
+        events=[_decode_event(e) for e in df.get(7, [])],
+    )
+
+
 def _encode_abci_responses(r: ABCIResponses) -> bytes:
     from tendermint_tpu.types.validator import pub_key_proto_bytes
 
     w = ProtoWriter()
     for dtx in r.deliver_txs:
-        dw = (
-            ProtoWriter()
-            .varint(1, dtx.code)
-            .bytes_(2, dtx.data)
-            .string(3, dtx.log)
-            .varint(5, dtx.gas_wanted)
-            .varint(6, dtx.gas_used)
-        )
-        for ev in dtx.events:
-            dw.message(7, _encode_event(ev), always=True)
-        w.message(1, dw.bytes_out(), always=True)
+        w.message(1, encode_deliver_tx(dtx), always=True)
     if r.end_block is not None:
         ew = ProtoWriter()
         for vu in r.end_block.validator_updates:
@@ -354,19 +372,7 @@ def _decode_abci_responses(raw: bytes) -> ABCIResponses:
     from tendermint_tpu.crypto.keys import PubKey
 
     f = fields_to_dict(raw)
-    dtxs = []
-    for b in f.get(1, []):
-        df = fields_to_dict(b)
-        dtxs.append(
-            ResponseDeliverTx(
-                code=df.get(1, [0])[0],
-                data=df.get(2, [b""])[0],
-                log=df.get(3, [b""])[0].decode() if df.get(3) else "",
-                gas_wanted=df.get(5, [0])[0],
-                gas_used=df.get(6, [0])[0],
-                events=[_decode_event(e) for e in df.get(7, [])],
-            )
-        )
+    dtxs = [decode_deliver_tx(b) for b in f.get(1, [])]
     eb = None
     if f.get(2):
         eb = ResponseEndBlock()

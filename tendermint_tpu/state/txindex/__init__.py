@@ -1,0 +1,5 @@
+from .indexer import NullTxIndexer, TxIndexer
+from .kv import KVTxIndexer
+from .service import IndexerService
+
+__all__ = ["IndexerService", "KVTxIndexer", "NullTxIndexer", "TxIndexer"]

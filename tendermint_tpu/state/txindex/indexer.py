@@ -1,0 +1,28 @@
+"""Tx indexer interface (reference state/txindex/indexer.go)."""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from tendermint_tpu.types.events import TxResult
+
+
+class TxIndexer(Protocol):
+    def index(self, result: TxResult) -> None: ...
+
+    def get(self, tx_hash: bytes) -> TxResult | None: ...
+
+    def search(self, query) -> list[TxResult]: ...
+
+
+class NullTxIndexer:
+    """reference state/txindex/null/null.go — indexing disabled."""
+
+    def index(self, result: TxResult) -> None:  # noqa: ARG002
+        return
+
+    def get(self, tx_hash: bytes) -> TxResult | None:  # noqa: ARG002
+        return None
+
+    def search(self, query) -> list[TxResult]:  # noqa: ARG002
+        raise RuntimeError("transaction indexing is disabled")

@@ -1,0 +1,186 @@
+"""KV tx indexer: hash → result plus composite-key secondary index.
+
+Parity: reference state/txindex/kv/kv.go (NewTxIndex :32, Index, Get,
+Search :175).  Key scheme:
+
+- ``tx_hash/<hash>``                      → encoded TxResult
+- ``<type>.<attr>/<value>/<height>/<index>`` → hash   (indexed attrs only)
+- ``tx.height/<height>/<height>/<index>``    → hash   (always)
+
+Height/index segments are zero-padded decimals so lexicographic order ==
+numeric order; the reserved ``tx.height`` key also pads its VALUE
+segment, so integer range conditions on tx.height ride an ordered range
+scan instead of the reference's full-prefix scan + per-key parse.
+Arbitrary attribute values can't be padded (they're opaque strings), so
+numeric conditions on app-defined keys scan that key's space — same as
+the reference.  Values may contain '/' — the trailing two segments are
+parsed from the end, same ambiguity tolerance as the reference
+(kv.go parseValueFromEventKey).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.pubsub.query import Op, Query
+from tendermint_tpu.state.store import decode_deliver_tx, encode_deliver_tx
+from tendermint_tpu.store.db import KVStore, MemDB
+from tendermint_tpu.types.events import TxHashKey, TxHeightKey, TxResult
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+_HASH_PREFIX = b"tx_hash/"
+_PAD = 20  # zero-pad width for height/index (enough for int64)
+
+
+def _encode_tx_result(r: TxResult) -> bytes:
+    return (
+        ProtoWriter()
+        .varint(1, r.height)
+        .varint(2, r.index)
+        .bytes_(3, r.tx)
+        .message(4, encode_deliver_tx(r.result), always=True)
+        .bytes_out()
+    )
+
+
+def _decode_tx_result(raw: bytes) -> TxResult:
+    f = fields_to_dict(raw)
+    return TxResult(
+        height=f.get(1, [0])[0],
+        index=f.get(2, [0])[0],
+        tx=f.get(3, [b""])[0],
+        result=decode_deliver_tx(f.get(4, [b""])[0]),
+    )
+
+
+def _event_key(composite_key: str, value: str, height: int, index: int) -> bytes:
+    return (
+        f"{composite_key}/{value}/{height:0{_PAD}d}/{index:0{_PAD}d}".encode()
+    )
+
+
+class KVTxIndexer:
+    def __init__(self, db: KVStore | None = None):
+        self.db = db if db is not None else MemDB()
+
+    # -- write -----------------------------------------------------------
+    def index(self, result: TxResult) -> None:
+        from tendermint_tpu.crypto import tmhash
+
+        tx_hash = tmhash.sum_sha256(result.tx)
+        sets: list[tuple[bytes, bytes]] = []
+        for ev in getattr(result.result, "events", None) or ():
+            if not ev.type:
+                continue
+            for attr in ev.attributes:
+                if not getattr(attr, "index", False) or not attr.key:
+                    continue
+                key = attr.key.decode("utf-8", "replace") if isinstance(attr.key, bytes) else attr.key
+                val = attr.value.decode("utf-8", "replace") if isinstance(attr.value, bytes) else str(attr.value)
+                sets.append(
+                    (_event_key(f"{ev.type}.{key}", val, result.height, result.index), tx_hash)
+                )
+        # reserved height key, always indexed (kv.go:92-98); value padded
+        # so integer ranges scan ordered key space
+        sets.append(
+            (
+                _event_key(
+                    TxHeightKey, f"{result.height:0{_PAD}d}", result.height, result.index
+                ),
+                tx_hash,
+            )
+        )
+        sets.append((_HASH_PREFIX + tx_hash, _encode_tx_result(result)))
+        self.db.write_batch(sets, [])
+
+    # -- read ------------------------------------------------------------
+    def get(self, tx_hash: bytes) -> TxResult | None:
+        raw = self.db.get(_HASH_PREFIX + tx_hash)
+        return _decode_tx_result(raw) if raw is not None else None
+
+    def search(self, query: Query) -> list[TxResult]:
+        """Hash-set intersection across conditions (kv.go:175-260)."""
+        conditions = list(query.conditions)
+        # tx.hash='...' short-circuits everything (kv.go:190-203)
+        for c in conditions:
+            if c.composite_key == TxHashKey and c.op is Op.EQ:
+                try:
+                    res = self.get(bytes.fromhex(str(c.operand)))
+                except ValueError:
+                    return []
+                return [res] if res is not None else []
+
+        result_set: set[bytes] | None = None
+        for c in conditions:
+            hashes = self._match_condition(c)
+            result_set = hashes if result_set is None else (result_set & hashes)
+            if not result_set:
+                return []
+        if result_set is None:
+            return []
+        out = [r for h in result_set if (r := self.get(h)) is not None]
+        out.sort(key=lambda r: (r.height, r.index))
+        return out
+
+    def _match_condition(self, c) -> set[bytes]:
+        prefix = f"{c.composite_key}/".encode()
+        if (
+            c.composite_key == TxHeightKey
+            and isinstance(c.operand, int)
+            and c.op in (Op.EQ, Op.LT, Op.LE, Op.GT, Op.GE)
+        ):
+            return self._height_range(c)
+        if c.op is Op.EQ and not isinstance(c.operand, (int, float)):
+            lo = f"{c.composite_key}/{c.operand}/".encode()
+            # the prefix scan alone would also match values that merely
+            # START with operand+'/' (e.g. 'a/b' for operand 'a') — the
+            # value segment must match exactly
+            return {
+                v
+                for k, v in self.db.iterate(lo, lo + b"\xff")
+                if self._value_segment(k, len(prefix)) == str(c.operand)
+            }
+        # numeric / EXISTS / CONTAINS: scan the composite key's space and
+        # filter on the value segment
+        out: set[bytes] = set()
+        for k, v in self.db.iterate(prefix, prefix + b"\xff"):
+            value = self._value_segment(k, len(prefix))
+            if value is None:
+                continue
+            if self._satisfies(value, c):
+                out.add(v)
+        return out
+
+    def _height_range(self, c) -> set[bytes]:
+        """Ordered range scan over the padded tx.height value segment —
+        O(matches), not O(total indexed txs)."""
+        prefix = f"{TxHeightKey}/".encode()
+        x = int(c.operand)
+
+        def bound(n: int) -> bytes:
+            return prefix + f"{max(n, 0):0{_PAD}d}/".encode()
+
+        lo, hi = prefix, prefix + b"\xff"
+        if c.op is Op.EQ:
+            lo, hi = bound(x), bound(x) + b"\xff"
+        elif c.op is Op.GE:
+            lo = bound(x)
+        elif c.op is Op.GT:
+            lo = bound(x + 1)
+        elif c.op is Op.LE:
+            hi = bound(x + 1)
+        elif c.op is Op.LT:
+            hi = bound(x)
+        return {v for _, v in self.db.iterate(lo, hi)}
+
+    @staticmethod
+    def _value_segment(key: bytes, prefix_len: int) -> str | None:
+        rest = key[prefix_len:].decode("utf-8", "replace")
+        parts = rest.rsplit("/", 2)  # value may itself contain '/'
+        if len(parts) != 3:
+            return None
+        return parts[0]
+
+    @staticmethod
+    def _satisfies(value: str, c) -> bool:
+        from tendermint_tpu.pubsub.query import _match_value  # shared op matrix
+
+        return _match_value(value, c.op, c.operand)

@@ -1,0 +1,73 @@
+"""IndexerService: EventBus → TxIndexer pump.
+
+Parity: reference state/txindex/indexer_service.go:82 — subscribes to
+the EventBus Tx stream and writes each result to the indexer.  Runs as
+one asyncio task; index writes are synchronous KV batch puts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.pubsub import SubscriptionCancelledError
+from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+SUBSCRIBER = "IndexerService"
+
+
+class IndexerService:
+    def __init__(self, indexer, event_bus, logger: Logger | None = None):
+        self.indexer = indexer
+        self.event_bus = event_bus
+        self.logger = logger or nop_logger()
+        self._task: asyncio.Task | None = None
+        self._sub = None
+
+    async def start(self) -> None:
+        # a block's txs arrive as individual Tx events; capacity scales
+        # with the max txs per block (indexer_service.go subscribes
+        # unbuffered; here buffered — see pubsub.Server eviction note)
+        self._sub = self.event_bus.subscribe(
+            SUBSCRIBER, tmevents.EventQueryTx, capacity=10000
+        )
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        try:
+            self.event_bus.unsubscribe_all(SUBSCRIBER)
+        except KeyError:
+            pass
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                msg = await self._sub.next()
+            except SubscriptionCancelledError as e:
+                if "capacity" not in str(e):
+                    return  # clean unsubscribe / shutdown
+                # Evicted for falling behind: some txs were dropped from
+                # the stream, but dying silently would leave ALL future
+                # txs unindexed.  Log the gap and resubscribe.
+                self.logger.error(
+                    "indexer fell behind and lost tx events; resubscribing",
+                    reason=str(e),
+                )
+                try:
+                    self._sub = self.event_bus.subscribe(
+                        SUBSCRIBER, tmevents.EventQueryTx, capacity=10000
+                    )
+                except ValueError:
+                    return  # stopped concurrently
+                continue
+            try:
+                self.indexer.index(msg.data.tx_result)
+            except Exception as e:  # index failures must not kill the pump
+                self.logger.error("failed to index tx", err=str(e))

@@ -1,0 +1,168 @@
+"""Tx indexer: index/get/search + the EventBus-driven IndexerService.
+
+Scenario parity: reference state/txindex/kv/kv_test.go (TestTxIndex,
+TestTxSearch — equality, ranges, CONTAINS/EXISTS, hash lookup,
+multi-condition intersection, result ordering)."""
+
+import asyncio
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.pubsub.query import parse
+from tendermint_tpu.state.txindex import IndexerService, KVTxIndexer, NullTxIndexer
+from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.types.events import TxResult
+
+
+def _result(height, index, tx, events=()):
+    return TxResult(
+        height=height,
+        index=index,
+        tx=tx,
+        result=abci.ResponseDeliverTx(code=0, data=b"", log="", events=list(events)),
+    )
+
+
+def _ev(type_, **attrs):
+    return abci.Event(
+        type=type_,
+        attributes=[
+            abci.EventAttribute(key=k.encode(), value=str(v).encode(), index=True)
+            for k, v in attrs.items()
+        ],
+    )
+
+
+def test_index_and_get_roundtrip():
+    idx = KVTxIndexer()
+    tx = b"hello-world-tx"
+    r = _result(5, 2, tx, [_ev("transfer", sender="alice", amount=100)])
+    idx.index(r)
+    got = idx.get(tmhash.sum_sha256(tx))
+    assert got is not None
+    assert (got.height, got.index, got.tx) == (5, 2, tx)
+    assert got.result.events[0].type == "transfer"
+    assert idx.get(b"\x00" * 32) is None
+
+
+def test_search_equality_and_hash():
+    idx = KVTxIndexer()
+    idx.index(_result(1, 0, b"tx-a", [_ev("transfer", sender="alice")]))
+    idx.index(_result(2, 0, b"tx-b", [_ev("transfer", sender="bob")]))
+
+    res = idx.search(parse("transfer.sender='alice'"))
+    assert [r.tx for r in res] == [b"tx-a"]
+
+    h = tmhash.sum_sha256(b"tx-b").hex().upper()
+    res = idx.search(parse(f"tx.hash='{h}'"))
+    assert [r.tx for r in res] == [b"tx-b"]
+    assert idx.search(parse("tx.hash='00ff'")) == []
+    assert idx.search(parse("tx.hash='zz'")) == []
+
+
+def test_search_height_ranges_and_order():
+    idx = KVTxIndexer()
+    for h in range(1, 11):
+        idx.index(_result(h, 0, b"tx-%d" % h, [_ev("app", creator="c")]))
+    # insert out of order to check result ordering
+    idx.index(_result(3, 1, b"tx-3b", [_ev("app", creator="c")]))
+
+    res = idx.search(parse("tx.height>=4 AND tx.height<7"))
+    assert [r.height for r in res] == [4, 5, 6]
+
+    res = idx.search(parse("app.creator='c' AND tx.height<=3"))
+    assert [(r.height, r.index) for r in res] == [(1, 0), (2, 0), (3, 0), (3, 1)]
+
+
+def test_search_contains_exists_numeric():
+    idx = KVTxIndexer()
+    idx.index(_result(1, 0, b"t1", [_ev("acct", owner="Ivan Ivanov", balance="1000ATOM")]))
+    idx.index(_result(2, 0, b"t2", [_ev("acct", owner="Oleg", balance="50ATOM")]))
+
+    assert [r.tx for r in idx.search(parse("acct.owner CONTAINS 'Ivan'"))] == [b"t1"]
+    assert len(idx.search(parse("acct.owner EXISTS"))) == 2
+    # numeric extraction from "1000ATOM" (reference numRegex semantics)
+    assert [r.tx for r in idx.search(parse("acct.balance>100"))] == [b"t1"]
+    assert idx.search(parse("missing.key EXISTS")) == []
+
+
+def test_search_multi_condition_intersection():
+    idx = KVTxIndexer()
+    idx.index(_result(1, 0, b"t1", [_ev("transfer", sender="a", amount=5)]))
+    idx.index(_result(1, 1, b"t2", [_ev("transfer", sender="a", amount=50)]))
+    idx.index(_result(2, 0, b"t3", [_ev("transfer", sender="b", amount=50)]))
+    res = idx.search(parse("transfer.sender='a' AND transfer.amount>10"))
+    assert [r.tx for r in res] == [b"t2"]
+
+
+def test_unindexed_attributes_not_searchable():
+    idx = KVTxIndexer()
+    ev = abci.Event(
+        type="transfer",
+        attributes=[abci.EventAttribute(key=b"sender", value=b"x", index=False)],
+    )
+    idx.index(_result(1, 0, b"t", [ev]))
+    assert idx.search(parse("transfer.sender='x'")) == []
+    # but the tx itself is still retrievable by hash
+    assert idx.get(tmhash.sum_sha256(b"t")) is not None
+
+
+def test_null_indexer():
+    import pytest
+
+    n = NullTxIndexer()
+    n.index(_result(1, 0, b"t"))
+    assert n.get(b"x" * 32) is None
+    with pytest.raises(RuntimeError):
+        n.search(parse("tx.height=1"))
+
+
+def test_indexer_service_pumps_event_bus():
+    async def main():
+        bus = tmevents.EventBus()
+        idx = KVTxIndexer()
+        svc = IndexerService(idx, bus)
+        await svc.start()
+        tx = b"service-tx"
+        bus.publish_tx(7, 0, tx, abci.ResponseDeliverTx(code=0, events=[_ev("m", k="v")]))
+        await asyncio.sleep(0.05)
+        got = idx.get(tmhash.sum_sha256(tx))
+        assert got is not None and got.height == 7
+        assert [r.tx for r in idx.search(parse("m.k='v'"))] == [tx]
+        await svc.stop()
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(main())
+
+
+def test_search_equality_value_with_slash_not_false_positive():
+    """Regression: value 'a/b' must not match a search for 'a' (the
+    prefix scan alone would)."""
+    idx = KVTxIndexer()
+    idx.index(_result(1, 0, b"t-slash", [_ev("transfer", sender="a/b")]))
+    idx.index(_result(2, 0, b"t-plain", [_ev("transfer", sender="a")]))
+    assert [r.tx for r in idx.search(parse("transfer.sender='a'"))] == [b"t-plain"]
+    assert [r.tx for r in idx.search(parse("transfer.sender='a/b'"))] == [b"t-slash"]
+
+
+def test_indexer_service_resubscribes_after_eviction():
+    """Regression: an evicted (slow) indexer subscription must log and
+    resubscribe, not die silently."""
+
+    async def main():
+        bus = tmevents.EventBus()
+        idx = KVTxIndexer()
+        svc = IndexerService(idx, bus)
+        await svc.start()
+        # overflow the subscription before the pump task ever runs
+        svc._sub.capacity = 4
+        svc._sub._q = asyncio.Queue(maxsize=4)
+        for i in range(10):
+            bus.publish_tx(1, i, b"burst-%d" % i, abci.ResponseDeliverTx(code=0))
+        await asyncio.sleep(0.05)
+        # pump must be alive on a fresh subscription: new txs still index
+        bus.publish_tx(2, 0, b"after-eviction", abci.ResponseDeliverTx(code=0))
+        await asyncio.sleep(0.05)
+        assert idx.get(tmhash.sum_sha256(b"after-eviction")) is not None
+        await svc.stop()
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(main())
