@@ -19,10 +19,12 @@ from tendermint_tpu.crypto.keys import PubKey
 from . import types as abci
 
 VALIDATOR_TX_PREFIX = b"val:"
+SNAPSHOT_FORMAT = 1
+SNAPSHOTS_KEPT = 5
 
 
 class KVStoreApplication(abci.BaseApplication):
-    def __init__(self):
+    def __init__(self, snapshot_interval: int = 0, snapshot_chunk_bytes: int = 1 << 16):
         self.state: dict[bytes, bytes] = {}
         self.height = 0
         self.app_hash = b""
@@ -31,6 +33,12 @@ class KVStoreApplication(abci.BaseApplication):
         self.validators: dict[bytes, int] = {}  # pubkey bytes -> power
         self.byzantine_seen: list = []  # Misbehavior reports from BeginBlock
         self.retain_blocks = 0  # set >0 to exercise pruning
+        # snapshots (reference test/e2e/app/snapshots.go): taken every
+        # snapshot_interval heights, chunked, per-chunk hashes in metadata
+        self.snapshot_interval = snapshot_interval
+        self.snapshot_chunk_bytes = snapshot_chunk_bytes
+        self.snapshots: dict[tuple[int, int], tuple[abci.Snapshot, list[bytes]]] = {}
+        self._restore: tuple[abci.Snapshot, list[bytes | None]] | None = None
 
     # -- query connection ---------------------------------------------
     def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
@@ -99,17 +107,113 @@ class KVStoreApplication(abci.BaseApplication):
     def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
         return abci.ResponseEndBlock(validator_updates=list(self.val_updates))
 
-    def commit(self) -> abci.ResponseCommit:
-        self.height += 1
+    def _compute_app_hash(self) -> bytes:
         h = hashlib.sha256()
         for k in sorted(self.state):
             h.update(len(k).to_bytes(4, "big") + k)
             h.update(len(self.state[k]).to_bytes(4, "big") + self.state[k])
-        self.app_hash = h.digest()
+        return h.digest()
+
+    def commit(self) -> abci.ResponseCommit:
+        self.height += 1
+        self.app_hash = self._compute_app_hash()
         retain = 0
         if self.retain_blocks > 0 and self.height > self.retain_blocks:
             retain = self.height - self.retain_blocks
+        if self.snapshot_interval > 0 and self.height % self.snapshot_interval == 0:
+            self._take_snapshot()
         return abci.ResponseCommit(data=self.app_hash, retain_height=retain)
+
+    # -- snapshot connection -------------------------------------------
+    def _serialize_state(self) -> bytes:
+        return json.dumps(
+            {
+                "height": self.height,
+                "state": {k.hex(): v.hex() for k, v in sorted(self.state.items())},
+                "validators": {k.hex(): p for k, p in sorted(self.validators.items())},
+            },
+            sort_keys=True,
+        ).encode()
+
+    def _take_snapshot(self) -> None:
+        blob = self._serialize_state()
+        n = self.snapshot_chunk_bytes
+        chunks = [blob[i : i + n] for i in range(0, len(blob), n)] or [b""]
+        chunk_hashes = [hashlib.sha256(c).digest() for c in chunks]
+        meta = json.dumps([h.hex() for h in chunk_hashes]).encode()
+        snap = abci.Snapshot(
+            height=self.height,
+            format=SNAPSHOT_FORMAT,
+            chunks=len(chunks),
+            hash=hashlib.sha256(b"".join(chunk_hashes)).digest(),
+            metadata=meta,
+        )
+        self.snapshots[(self.height, SNAPSHOT_FORMAT)] = (snap, chunks)
+        # bound retained snapshots (each holds a full state copy)
+        while len(self.snapshots) > SNAPSHOTS_KEPT:
+            del self.snapshots[min(self.snapshots)]
+
+    def list_snapshots(self) -> list[abci.Snapshot]:
+        return [s for s, _ in self.snapshots.values()]
+
+    def load_snapshot_chunk(self, height: int, format: int, chunk: int) -> bytes | None:
+        entry = self.snapshots.get((height, format))
+        if entry is None or chunk >= len(entry[1]):
+            return None
+        return entry[1][chunk]
+
+    def offer_snapshot(self, snapshot: abci.Snapshot, app_hash: bytes) -> abci.ResponseOfferSnapshot:  # noqa: ARG002
+        r = abci.ResponseOfferSnapshot.Result
+        if snapshot.format != SNAPSHOT_FORMAT:
+            return abci.ResponseOfferSnapshot(result=r.REJECT_FORMAT)
+        try:
+            hashes = [bytes.fromhex(h) for h in json.loads(snapshot.metadata)]
+        except (ValueError, TypeError):
+            return abci.ResponseOfferSnapshot(result=r.REJECT)
+        if len(hashes) != snapshot.chunks or hashlib.sha256(
+            b"".join(hashes)
+        ).digest() != snapshot.hash:
+            return abci.ResponseOfferSnapshot(result=r.REJECT)
+        self._restore = (snapshot, [None] * snapshot.chunks)
+        return abci.ResponseOfferSnapshot(result=r.ACCEPT)
+
+    def apply_snapshot_chunk(self, index: int, chunk: bytes, sender: str) -> abci.ResponseApplySnapshotChunk:
+        r = abci.ResponseApplySnapshotChunk.Result
+        if self._restore is None:
+            return abci.ResponseApplySnapshotChunk(result=r.ABORT)
+        snapshot, received = self._restore
+        hashes = [bytes.fromhex(h) for h in json.loads(snapshot.metadata)]
+        if index >= snapshot.chunks or hashlib.sha256(chunk).digest() != hashes[index]:
+            # corrupt chunk: refetch it, drop the lying sender
+            return abci.ResponseApplySnapshotChunk(
+                result=r.RETRY,
+                refetch_chunks=[index],
+                reject_senders=[sender] if sender else [],
+            )
+        received[index] = chunk
+        if any(c is None for c in received):
+            return abci.ResponseApplySnapshotChunk(result=r.ACCEPT)
+        # All chunks in: rebuild state.  The app hash is RECOMPUTED from
+        # the restored keys — a snapshot carrying fabricated state can't
+        # smuggle in the trusted hash; the node's post-restore verifyApp
+        # (Info vs light-client hash) then catches the mismatch.  Any
+        # malformed-but-hash-consistent blob is a rejected snapshot, not
+        # a crash.
+        try:
+            doc = json.loads(b"".join(received))
+            state = {bytes.fromhex(k): bytes.fromhex(v) for k, v in doc["state"].items()}
+            validators = {bytes.fromhex(k): p for k, p in doc["validators"].items()}
+            height = int(doc["height"])
+        except Exception:
+            self._restore = None
+            return abci.ResponseApplySnapshotChunk(result=r.REJECT_SNAPSHOT)
+        self.state = state
+        self.validators = validators
+        self.height = height
+        self.size = len(self.state)
+        self.app_hash = self._compute_app_hash()
+        self._restore = None
+        return abci.ResponseApplySnapshotChunk(result=r.ACCEPT)
 
     # -- helpers -------------------------------------------------------
     @staticmethod

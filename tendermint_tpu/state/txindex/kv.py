@@ -75,8 +75,14 @@ class KVTxIndexer:
                     continue
                 key = attr.key.decode("utf-8", "replace") if isinstance(attr.key, bytes) else attr.key
                 val = attr.value.decode("utf-8", "replace") if isinstance(attr.value, bytes) else str(attr.value)
+                composite = f"{ev.type}.{key}"
+                # reserved keys are written by the indexer itself; an app
+                # event colliding with them would corrupt the padded
+                # height keyspace (reference kv.go skips these too)
+                if composite in (TxHeightKey, TxHashKey):
+                    continue
                 sets.append(
-                    (_event_key(f"{ev.type}.{key}", val, result.height, result.index), tx_hash)
+                    (_event_key(composite, val, result.height, result.index), tx_hash)
                 )
         # reserved height key, always indexed (kv.go:92-98); value padded
         # so integer ranges scan ordered key space
@@ -122,12 +128,16 @@ class KVTxIndexer:
 
     def _match_condition(self, c) -> set[bytes]:
         prefix = f"{c.composite_key}/".encode()
-        if (
-            c.composite_key == TxHeightKey
-            and isinstance(c.operand, int)
-            and c.op in (Op.EQ, Op.LT, Op.LE, Op.GT, Op.GE)
-        ):
-            return self._height_range(c)
+        if c.composite_key == TxHeightKey and c.op in (Op.EQ, Op.LT, Op.LE, Op.GT, Op.GE):
+            # accept both tx.height=5 and tx.height='5' — the stored
+            # value segment is padded, so normalize to int first
+            operand = c.operand
+            if not isinstance(operand, int):
+                try:
+                    operand = int(str(operand))
+                except ValueError:
+                    return set()
+            return self._height_range(c.op, operand)
         if c.op is Op.EQ and not isinstance(c.operand, (int, float)):
             lo = f"{c.composite_key}/{c.operand}/".encode()
             # the prefix scan alone would also match values that merely
@@ -149,25 +159,24 @@ class KVTxIndexer:
                 out.add(v)
         return out
 
-    def _height_range(self, c) -> set[bytes]:
+    def _height_range(self, op: Op, x: int) -> set[bytes]:
         """Ordered range scan over the padded tx.height value segment —
         O(matches), not O(total indexed txs)."""
         prefix = f"{TxHeightKey}/".encode()
-        x = int(c.operand)
 
         def bound(n: int) -> bytes:
             return prefix + f"{max(n, 0):0{_PAD}d}/".encode()
 
         lo, hi = prefix, prefix + b"\xff"
-        if c.op is Op.EQ:
+        if op is Op.EQ:
             lo, hi = bound(x), bound(x) + b"\xff"
-        elif c.op is Op.GE:
+        elif op is Op.GE:
             lo = bound(x)
-        elif c.op is Op.GT:
+        elif op is Op.GT:
             lo = bound(x + 1)
-        elif c.op is Op.LE:
+        elif op is Op.LE:
             hi = bound(x + 1)
-        elif c.op is Op.LT:
+        elif op is Op.LT:
             hi = bound(x)
         return {v for _, v in self.db.iterate(lo, hi)}
 

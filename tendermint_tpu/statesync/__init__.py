@@ -1,0 +1,25 @@
+from .messages import (
+    ChunkRequest,
+    ChunkResponse,
+    SnapshotsRequest,
+    SnapshotsResponse,
+)
+from .reactor import CHUNK_CHANNEL, SNAPSHOT_CHANNEL, StateSyncReactor
+from .snapshots import SnapshotKey, SnapshotPool
+from .stateprovider import LightClientStateProvider
+from .syncer import SyncAbortedError, Syncer
+
+__all__ = [
+    "CHUNK_CHANNEL",
+    "ChunkRequest",
+    "ChunkResponse",
+    "LightClientStateProvider",
+    "SNAPSHOT_CHANNEL",
+    "SnapshotKey",
+    "SnapshotPool",
+    "SnapshotsRequest",
+    "SnapshotsResponse",
+    "StateSyncReactor",
+    "SyncAbortedError",
+    "Syncer",
+]

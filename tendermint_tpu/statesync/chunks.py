@@ -1,0 +1,95 @@
+"""Chunk queue for one snapshot restore.
+
+Parity: reference statesync/chunks.go (chunkQueue :31: Allocate, Add,
+Next, Retry, RetryAll, Discard, GetSender).  The reference spools chunks
+to temp files to bound memory; chunks here are bounded by the channel's
+max message size and held in memory — the restoring app consumes them
+immediately in sequential order, so at most a fetch-window of chunks is
+resident at once.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.abci.types import Snapshot
+
+
+class ChunkQueue:
+    def __init__(self, snapshot: Snapshot):
+        self.snapshot = snapshot
+        self._chunks: dict[int, bytes] = {}
+        self._senders: dict[int, str] = {}
+        self._allocated: set[int] = set()
+        self._returned: set[int] = set()  # consumed by Next
+        self._next = 0
+        self._event = asyncio.Event()  # pulsed when a chunk arrives
+        self._closed = False
+
+    def allocate(self) -> int | None:
+        """Hand out the lowest unallocated chunk index to a fetcher."""
+        for i in range(self.snapshot.chunks):
+            if i not in self._allocated and i not in self._chunks:
+                self._allocated.add(i)
+                return i
+        return None
+
+    def add(self, index: int, chunk: bytes, sender: str) -> bool:
+        if self._closed or index >= self.snapshot.chunks or index in self._chunks:
+            return False
+        self._chunks[index] = chunk
+        self._senders[index] = sender
+        self._allocated.discard(index)
+        self._event.set()
+        return True
+
+    def has(self, index: int) -> bool:
+        return index in self._chunks
+
+    def get_sender(self, index: int) -> str:
+        return self._senders.get(index, "")
+
+    async def next(self, timeout: float | None = None) -> tuple[int, bytes] | None:
+        """Await the next sequential chunk; None on close/timeout."""
+        while not self._closed:
+            if self._next in self._chunks:
+                i = self._next
+                self._next += 1
+                self._returned.add(i)
+                return i, self._chunks[i]
+            self._event.clear()
+            try:
+                if timeout is None:
+                    await self._event.wait()
+                else:
+                    await asyncio.wait_for(self._event.wait(), timeout)
+            except asyncio.TimeoutError:
+                return None
+        return None
+
+    def retry(self, index: int) -> None:
+        """Make a chunk re-fetchable and rewind the apply point to it."""
+        for i in range(index, self.snapshot.chunks):
+            self._chunks.pop(i, None)
+            self._senders.pop(i, None)
+            self._allocated.discard(i)
+            self._returned.discard(i)
+        self._next = min(self._next, index)
+
+    def retry_all(self) -> None:
+        self.retry(0)
+
+    def discard_sender(self, peer_id: str) -> None:
+        """Drop unapplied chunks from a banned sender (chunks.go:238)."""
+        for i, s in list(self._senders.items()):
+            if s == peer_id and i not in self._returned:
+                self._chunks.pop(i, None)
+                self._senders.pop(i, None)
+                self._allocated.discard(i)
+
+    def done(self) -> bool:
+        return self._next >= self.snapshot.chunks
+
+    def close(self) -> None:
+        self._closed = True
+        self._event.set()

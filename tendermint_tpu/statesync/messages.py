@@ -1,0 +1,141 @@
+"""Statesync wire messages (reference proto/tendermint/statesync/types.proto,
+statesync/messages.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict, to_int64
+
+
+@dataclass
+class SnapshotsRequest:
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SnapshotsRequest":  # noqa: ARG003
+        return cls()
+
+
+@dataclass
+class SnapshotsResponse:
+    """One advertised snapshot (height=1, format=2, chunks=3, hash=4,
+    metadata=5)."""
+
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.format)
+            .varint(3, self.chunks)
+            .bytes_(4, self.hash)
+            .bytes_(5, self.metadata)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SnapshotsResponse":
+        f = fields_to_dict(data)
+        return cls(
+            height=to_int64(f.get(1, [0])[0]),
+            format=f.get(2, [0])[0],
+            chunks=f.get(3, [0])[0],
+            hash=f.get(4, [b""])[0],
+            metadata=f.get(5, [b""])[0],
+        )
+
+
+@dataclass
+class ChunkRequest:
+    """height=1, format=2, index=3."""
+
+    height: int
+    format: int
+    index: int
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.format)
+            .varint(3, self.index)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ChunkRequest":
+        f = fields_to_dict(data)
+        return cls(to_int64(f.get(1, [0])[0]), f.get(2, [0])[0], f.get(3, [0])[0])
+
+
+@dataclass
+class ChunkResponse:
+    """height=1, format=2, index=3, chunk=4, missing=5."""
+
+    height: int
+    format: int
+    index: int
+    chunk: bytes = b""
+    missing: bool = False
+
+    def encode(self) -> bytes:
+        return (
+            ProtoWriter()
+            .varint(1, self.height)
+            .varint(2, self.format)
+            .varint(3, self.index)
+            .bytes_(4, self.chunk)
+            .bool_(5, self.missing)
+            .bytes_out()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ChunkResponse":
+        f = fields_to_dict(data)
+        return cls(
+            height=to_int64(f.get(1, [0])[0]),
+            format=f.get(2, [0])[0],
+            index=f.get(3, [0])[0],
+            chunk=f.get(4, [b""])[0],
+            missing=bool(f.get(5, [0])[0]),
+        )
+
+
+_SNAPSHOT_TYPES: list[type] = [SnapshotsRequest, SnapshotsResponse]
+_CHUNK_TYPES: list[type] = [ChunkRequest, ChunkResponse]
+
+
+def _encode(msg, types: list[type]) -> bytes:
+    fld = types.index(type(msg)) + 1
+    return ProtoWriter().message(fld, msg.encode(), always=True).bytes_out()
+
+
+def _decode(data: bytes, types: list[type]):
+    f = fields_to_dict(data)
+    for i, t in enumerate(types):
+        if i + 1 in f:
+            return t.decode(f[i + 1][0])
+    raise ValueError("unknown statesync message")
+
+
+def encode_snapshot_message(msg) -> bytes:
+    return _encode(msg, _SNAPSHOT_TYPES)
+
+
+def decode_snapshot_message(data: bytes):
+    return _decode(data, _SNAPSHOT_TYPES)
+
+
+def encode_chunk_message(msg) -> bytes:
+    return _encode(msg, _CHUNK_TYPES)
+
+
+def decode_chunk_message(data: bytes):
+    return _decode(data, _CHUNK_TYPES)

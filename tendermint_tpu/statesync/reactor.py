@@ -1,0 +1,173 @@
+"""Statesync reactor: snapshot/chunk channels + the bootstrap entry.
+
+Parity: reference statesync/reactor.go (channels Snapshot 0x60 / Chunk
+0x61 :33-59, Receive, recentSnapshots :184, Sync :472).  Serves local
+app snapshots to restoring peers and runs the Syncer for a node
+bootstrapping from state sync.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.abci.types import Snapshot
+from tendermint_tpu.p2p.types import ChannelDescriptor, Envelope, PeerStatus
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .messages import (
+    ChunkRequest,
+    ChunkResponse,
+    SnapshotsRequest,
+    SnapshotsResponse,
+    decode_chunk_message,
+    decode_snapshot_message,
+    encode_chunk_message,
+    encode_snapshot_message,
+)
+from .syncer import Syncer
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+RECENT_SNAPSHOTS = 10  # reactor.go:48
+MAX_CHUNK_BYTES = 16 * 1024 * 1024
+
+
+class StateSyncReactor:
+    def __init__(
+        self,
+        app_snapshot_conn,
+        router,
+        state_provider=None,
+        logger: Logger | None = None,
+    ):
+        self.app = app_snapshot_conn
+        self.router = router
+        self.logger = logger or nop_logger()
+        self.snapshot_ch = router.open_channel(
+            ChannelDescriptor(
+                channel_id=SNAPSHOT_CHANNEL,
+                priority=5,
+                encode=encode_snapshot_message,
+                decode=decode_snapshot_message,
+                max_msg_bytes=4 * 1024 * 1024,
+            )
+        )
+        self.chunk_ch = router.open_channel(
+            ChannelDescriptor(
+                channel_id=CHUNK_CHANNEL,
+                priority=1,
+                encode=encode_chunk_message,
+                decode=decode_chunk_message,
+                max_msg_bytes=MAX_CHUNK_BYTES,
+            )
+        )
+        self.peer_updates = router.subscribe_peer_updates()
+        self.syncer = Syncer(
+            app_snapshot_conn,
+            state_provider,
+            self._request_snapshots,
+            self._request_chunk,
+            logger=self.logger,
+        )
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._snapshot_recv_loop()),
+            loop.create_task(self._chunk_recv_loop()),
+            loop.create_task(self._peer_update_loop()),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+
+    async def sync(self, discovery_time: float = 2.0, retries: int | None = 20):
+        """Run a full state sync; returns (state, commit) to bootstrap
+        the node (reference reactor.go:472 + node.go startStateSync)."""
+        return await self.syncer.sync_any(discovery_time, retries=retries)
+
+    # -- outbound (syncer hooks) -----------------------------------------
+    async def _request_snapshots(self) -> None:
+        await self.snapshot_ch.send(
+            Envelope(message=SnapshotsRequest(), broadcast=True)
+        )
+
+    async def _request_chunk(self, peer_id: str, snapshot: Snapshot, index: int) -> None:
+        await self.chunk_ch.send(
+            Envelope(
+                message=ChunkRequest(snapshot.height, snapshot.format, index),
+                to=peer_id,
+            )
+        )
+
+    # -- inbound ---------------------------------------------------------
+    async def _snapshot_recv_loop(self) -> None:
+        while True:
+            env = await self.snapshot_ch.receive()
+            msg, frm = env.message, env.from_
+            if isinstance(msg, SnapshotsRequest):
+                for s in self._recent_snapshots():
+                    await self.snapshot_ch.send(
+                        Envelope(
+                            message=SnapshotsResponse(
+                                s.height, s.format, s.chunks, s.hash, s.metadata
+                            ),
+                            to=frm,
+                        )
+                    )
+            elif isinstance(msg, SnapshotsResponse):
+                self.syncer.add_snapshot(
+                    frm,
+                    Snapshot(msg.height, msg.format, msg.chunks, msg.hash, msg.metadata),
+                )
+
+    def _recent_snapshots(self) -> list[Snapshot]:
+        try:
+            snapshots = list(self.app.list_snapshots_sync())
+        except Exception as e:
+            self.logger.error("failed to list snapshots", err=str(e))
+            return []
+        snapshots.sort(key=lambda s: (s.height, s.format), reverse=True)
+        return snapshots[:RECENT_SNAPSHOTS]
+
+    async def _chunk_recv_loop(self) -> None:
+        while True:
+            env = await self.chunk_ch.receive()
+            msg, frm = env.message, env.from_
+            if isinstance(msg, ChunkRequest):
+                try:
+                    chunk = self.app.load_snapshot_chunk_sync(msg.height, msg.format, msg.index)
+                except Exception as e:
+                    self.logger.error("failed to load chunk", err=str(e))
+                    chunk = None
+                await self.chunk_ch.send(
+                    Envelope(
+                        message=ChunkResponse(
+                            msg.height,
+                            msg.format,
+                            msg.index,
+                            chunk or b"",
+                            missing=chunk is None,
+                        ),
+                        to=frm,
+                    )
+                )
+            elif isinstance(msg, ChunkResponse):
+                if not msg.missing:
+                    self.syncer.add_chunk(
+                        frm, msg.height, msg.format, msg.index, msg.chunk
+                    )
+
+    async def _peer_update_loop(self) -> None:
+        while True:
+            update = await self.peer_updates.get()
+            if update.status == PeerStatus.DOWN:
+                self.syncer.remove_peer(update.node_id)

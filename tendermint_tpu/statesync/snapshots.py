@@ -1,0 +1,92 @@
+"""Snapshot pool: advertised snapshots ranked for restore attempts.
+
+Parity: reference statesync/snapshots.go (snapshotPool :45, Add :136,
+Best :176, Reject/RejectFormat/RejectPeer, GetPeers).  Ranking: height
+desc, format desc, number of advertising peers desc.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.abci.types import Snapshot
+
+
+@dataclass(frozen=True)
+class SnapshotKey:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+
+
+def _key(s: Snapshot) -> SnapshotKey:
+    return SnapshotKey(s.height, s.format, s.chunks, s.hash)
+
+
+@dataclass
+class _Entry:
+    snapshot: Snapshot
+    peers: set = field(default_factory=set)
+
+
+class SnapshotPool:
+    def __init__(self):
+        self._entries: dict[SnapshotKey, _Entry] = {}
+        self._rejected_keys: set[SnapshotKey] = set()
+        self._rejected_formats: set[int] = set()
+        self._rejected_peers: set[str] = set()
+
+    def add(self, peer_id: str, snapshot: Snapshot) -> bool:
+        """Returns True if this (snapshot, peer) pair is new."""
+        key = _key(snapshot)
+        if (
+            key in self._rejected_keys
+            or snapshot.format in self._rejected_formats
+            or peer_id in self._rejected_peers
+        ):
+            return False
+        e = self._entries.get(key)
+        if e is None:
+            e = self._entries[key] = _Entry(snapshot)
+        if peer_id in e.peers:
+            return False
+        e.peers.add(peer_id)
+        return True
+
+    def best(self) -> Snapshot | None:
+        ranked = self.ranked()
+        return ranked[0] if ranked else None
+
+    def ranked(self) -> list[Snapshot]:
+        entries = [e for e in self._entries.values() if e.peers]
+        entries.sort(
+            key=lambda e: (e.snapshot.height, e.snapshot.format, len(e.peers)),
+            reverse=True,
+        )
+        return [e.snapshot for e in entries]
+
+    def get_peers(self, snapshot: Snapshot) -> list[str]:
+        e = self._entries.get(_key(snapshot))
+        return sorted(e.peers) if e else []
+
+    def reject(self, snapshot: Snapshot) -> None:
+        key = _key(snapshot)
+        self._rejected_keys.add(key)
+        self._entries.pop(key, None)
+
+    def reject_format(self, format: int) -> None:
+        self._rejected_formats.add(format)
+        for key in [k for k in self._entries if k.format == format]:
+            del self._entries[key]
+
+    def reject_peer(self, peer_id: str) -> None:
+        self._rejected_peers.add(peer_id)
+        self.remove_peer(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        for key in list(self._entries):
+            e = self._entries[key]
+            e.peers.discard(peer_id)
+            if not e.peers:
+                del self._entries[key]

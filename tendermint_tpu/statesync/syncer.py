@@ -1,0 +1,235 @@
+"""Syncer: drives a snapshot restore — discovery → offer → fetch →
+apply → verify.
+
+Parity: reference statesync/syncer.go (SyncAny :141, syncer.Sync :228,
+offerSnapshot :294, fetchChunks :384 with 4 workers, applyChunks :330
+with RETRY/RETRY_SNAPSHOT/REJECT_SNAPSHOT/refetch_chunks/reject_senders
+verbs, verifyApp :448).
+
+The reactor owns the wire; the syncer talks to it through two callables
+(request_snapshots, request_chunk) and receives inbound snapshots/chunks
+via add_snapshot/add_chunk.  This keeps the restore logic a pure async
+state machine, testable without a network.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.abci.types import (
+    ResponseApplySnapshotChunk,
+    ResponseOfferSnapshot,
+    Snapshot,
+)
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .chunks import ChunkQueue
+from .snapshots import SnapshotPool
+
+CHUNK_FETCHERS = 4  # syncer.go:38
+CHUNK_REQUEST_TIMEOUT = 10.0  # syncer.go:41
+
+
+class SyncAbortedError(Exception):
+    """App returned ABORT — the node must halt."""
+
+
+class _SnapshotRejectedError(Exception):
+    """Current snapshot failed; try the next-best one."""
+
+
+class Syncer:
+    def __init__(
+        self,
+        app_snapshot_conn,
+        state_provider,
+        request_snapshots,
+        request_chunk,
+        logger: Logger | None = None,
+        chunk_timeout: float = CHUNK_REQUEST_TIMEOUT,
+    ):
+        self.app = app_snapshot_conn
+        self.state_provider = state_provider
+        self.request_snapshots = request_snapshots  # async () -> None (broadcast)
+        self.request_chunk = request_chunk  # async (peer_id, snapshot, index) -> None
+        self.logger = logger or nop_logger()
+        self.chunk_timeout = chunk_timeout
+        self.pool = SnapshotPool()
+        self._chunk_queue: ChunkQueue | None = None
+        self._new_snapshot = asyncio.Event()
+
+    # -- reactor intake --------------------------------------------------
+    def add_snapshot(self, peer_id: str, snapshot: Snapshot) -> bool:
+        added = self.pool.add(peer_id, snapshot)
+        if added:
+            self._new_snapshot.set()
+        return added
+
+    def add_chunk(self, peer_id: str, height: int, format: int, index: int, chunk: bytes) -> bool:
+        q = self._chunk_queue
+        if q is None or q.snapshot.height != height or q.snapshot.format != format:
+            return False
+        return q.add(index, chunk, peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.pool.remove_peer(peer_id)
+
+    # -- main entry ------------------------------------------------------
+    async def sync_any(self, discovery_time: float = 2.0, retries: int | None = None):
+        """Try snapshots best-first until one restores; returns
+        (state, commit) for node bootstrap (syncer.go SyncAny)."""
+        await self.request_snapshots()
+        await asyncio.sleep(discovery_time)
+        attempts = 0
+        while True:
+            snapshot = self.pool.best()
+            if snapshot is None:
+                attempts += 1
+                if retries is not None and attempts >= retries:
+                    raise TimeoutError("no viable snapshots discovered")
+                await self.request_snapshots()
+                self._new_snapshot.clear()
+                try:
+                    await asyncio.wait_for(self._new_snapshot.wait(), discovery_time)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            try:
+                return await self._sync_snapshot(snapshot)
+            except _SnapshotRejectedError:
+                continue  # pool already updated; try next-best
+
+    async def _sync_snapshot(self, snapshot: Snapshot):
+        """syncer.go Sync: one snapshot attempt end-to-end."""
+        self.logger.info(
+            "offering snapshot", height=snapshot.height, format=snapshot.format
+        )
+        # trusted app hash BEFORE offering (syncer.go:255-266): the header
+        # at height+1 commits the app hash the restored state must match;
+        # this also probes that height+2 exists (a snapshot at the chain
+        # tip can't produce a State yet) — reject such snapshots and try
+        # the next-best one
+        try:
+            app_hash = self.state_provider.app_hash(snapshot.height)
+        except Exception as e:
+            self.logger.info(
+                "snapshot unusable (no verifiable app hash)",
+                height=snapshot.height,
+                err=str(e),
+            )
+            self.pool.reject(snapshot)
+            raise _SnapshotRejectedError from e
+
+        resp = self.app.offer_snapshot_sync(snapshot, app_hash)
+        r = ResponseOfferSnapshot.Result
+        if resp.result == r.ACCEPT:
+            pass
+        elif resp.result == r.ABORT:
+            raise SyncAbortedError("app aborted snapshot restore")
+        elif resp.result == r.REJECT:
+            self.pool.reject(snapshot)
+            raise _SnapshotRejectedError
+        elif resp.result == r.REJECT_FORMAT:
+            self.pool.reject_format(snapshot.format)
+            raise _SnapshotRejectedError
+        elif resp.result == r.REJECT_SENDER:
+            for p in self.pool.get_peers(snapshot):
+                self.pool.reject_peer(p)
+            raise _SnapshotRejectedError
+        else:
+            raise SyncAbortedError(f"unknown OfferSnapshot result {resp.result}")
+
+        self._chunk_queue = ChunkQueue(snapshot)
+        fetchers = [
+            asyncio.get_running_loop().create_task(self._fetch_loop(snapshot))
+            for _ in range(CHUNK_FETCHERS)
+        ]
+        try:
+            await self._apply_chunks(snapshot)
+            state = self.state_provider.state(snapshot.height)
+            commit = self.state_provider.commit(snapshot.height)
+            self._verify_app(state)
+            return state, commit
+        finally:
+            self._chunk_queue.close()
+            self._chunk_queue = None
+            for t in fetchers:
+                t.cancel()
+            for t in fetchers:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+    # -- chunk fetching --------------------------------------------------
+    async def _fetch_loop(self, snapshot: Snapshot) -> None:
+        q = self._chunk_queue
+        while not q.done():
+            index = q.allocate()
+            if index is None:
+                await asyncio.sleep(0.05)
+                continue
+            peers = self.pool.get_peers(snapshot)
+            if not peers:
+                self.pool.reject(snapshot)
+                q.close()
+                return
+            peer = peers[index % len(peers)]
+            await self.request_chunk(peer, snapshot, index)
+            deadline = asyncio.get_running_loop().time() + self.chunk_timeout
+            while not q.has(index) and index >= q._next:
+                if asyncio.get_running_loop().time() > deadline:
+                    # timed out: release the allocation so the next fetch
+                    # attempt (likely another peer) can pick it up
+                    q._allocated.discard(index)
+                    break
+                await asyncio.sleep(0.05)
+
+    # -- chunk application ----------------------------------------------
+    async def _apply_chunks(self, snapshot: Snapshot) -> None:
+        q = self._chunk_queue
+        r = ResponseApplySnapshotChunk.Result
+        while not q.done():
+            nxt = await q.next(timeout=self.chunk_timeout * (snapshot.chunks + 1))
+            if nxt is None:
+                self.pool.reject(snapshot)
+                raise _SnapshotRejectedError
+            index, chunk = nxt
+            resp = self.app.apply_snapshot_chunk_sync(index, chunk, q.get_sender(index))
+            # punitive verbs first (syncer.go:336-360)
+            for peer in resp.reject_senders:
+                self.pool.reject_peer(peer)
+                q.discard_sender(peer)
+            for i in resp.refetch_chunks:
+                q.retry(i)
+            if resp.result == r.ACCEPT:
+                continue
+            if resp.result == r.ABORT:
+                raise SyncAbortedError("app aborted during chunk apply")
+            if resp.result == r.RETRY:
+                q.retry(index)
+            elif resp.result == r.RETRY_SNAPSHOT:
+                q.retry_all()
+            elif resp.result == r.REJECT_SNAPSHOT:
+                self.pool.reject(snapshot)
+                raise _SnapshotRejectedError
+            else:
+                raise SyncAbortedError(f"unknown ApplySnapshotChunk result {resp.result}")
+
+    # -- post-restore verification ---------------------------------------
+    def _verify_app(self, state) -> None:
+        """syncer.go:448 verifyApp: the restored app must report the
+        trusted app hash and height."""
+        from tendermint_tpu.abci.types import RequestInfo
+
+        info = self.app.info_sync(RequestInfo())
+        if info.last_block_app_hash != state.app_hash:
+            raise SyncAbortedError(
+                f"restored app hash {info.last_block_app_hash.hex()} != trusted "
+                f"{state.app_hash.hex()}"
+            )
+        if info.last_block_height != state.last_block_height:
+            raise SyncAbortedError(
+                f"restored app height {info.last_block_height} != "
+                f"{state.last_block_height}"
+            )

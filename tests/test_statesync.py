@@ -1,0 +1,395 @@
+"""State sync: snapshot pool ranking, chunk queue, syncer verbs, kvstore
+snapshot round-trip, and a full two-node restore over the memory network.
+
+Scenario parity: reference statesync/{snapshots,chunks,syncer,reactor}_test.go.
+"""
+
+import asyncio
+import hashlib
+import json
+
+import pytest
+
+from tendermint_tpu.abci import AppConns
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.kvstore import KVStoreApplication, SNAPSHOT_FORMAT
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.light.client import TrustOptions
+from tendermint_tpu.light.provider import NodeBackedProvider
+from tendermint_tpu.p2p import MemoryNetwork, Router
+from tendermint_tpu.statesync import (
+    LightClientStateProvider,
+    SnapshotPool,
+    StateSyncReactor,
+    Syncer,
+)
+from tendermint_tpu.statesync.chunks import ChunkQueue
+from tendermint_tpu.statesync.syncer import SyncAbortedError
+
+from helpers import ChainBuilder
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def _snap(height=10, format=SNAPSHOT_FORMAT, chunks=3, hash_=b"h1"):
+    return abci.Snapshot(height=height, format=format, chunks=chunks, hash=hash_)
+
+
+# ---------------------------------------------------------------------------
+# snapshot pool
+# ---------------------------------------------------------------------------
+
+def test_pool_ranking():
+    p = SnapshotPool()
+    s_low = _snap(height=5, hash_=b"a")
+    s_high = _snap(height=10, hash_=b"b")
+    s_pop = _snap(height=10, format=0, hash_=b"c")
+    assert p.add("p1", s_low)
+    assert p.add("p1", s_high)
+    assert not p.add("p1", s_high)  # duplicate pair
+    p.add("p1", s_pop)
+    p.add("p2", s_pop)
+    ranked = p.ranked()
+    # height desc first, then format desc
+    assert [s.hash for s in ranked] == [b"b", b"c", b"a"]
+    assert p.best().hash == b"b"
+    assert set(p.get_peers(s_pop)) == {"p1", "p2"}
+
+
+def test_pool_rejections():
+    p = SnapshotPool()
+    s1, s2 = _snap(hash_=b"a"), _snap(height=8, hash_=b"b")
+    p.add("p1", s1)
+    p.add("p1", s2)
+    p.reject(s1)
+    assert p.best().hash == b"b"
+    assert not p.add("p2", s1)  # rejected snapshots stay rejected
+    p.reject_format(SNAPSHOT_FORMAT)
+    assert p.best() is None
+    p2 = SnapshotPool()
+    p2.add("bad-peer", s1)
+    p2.reject_peer("bad-peer")
+    assert p2.best() is None
+    assert not p2.add("bad-peer", s2)
+
+
+# ---------------------------------------------------------------------------
+# chunk queue
+# ---------------------------------------------------------------------------
+
+def test_chunk_queue_sequential_and_retry():
+    async def main():
+        q = ChunkQueue(_snap(chunks=3))
+        assert q.allocate() == 0
+        assert q.allocate() == 1
+        q.add(1, b"one", "pB")  # out of order
+        q.add(0, b"zero", "pA")
+        assert await q.next() == (0, b"zero")
+        assert await q.next() == (1, b"one")
+        assert q.get_sender(1) == "pB"
+        # retry rewinds the apply point and clears downstream chunks
+        q.retry(1)
+        assert not q.has(1)
+        q.add(1, b"one'", "pC")
+        assert await q.next() == (1, b"one'")
+        q.add(2, b"two", "pA")
+        assert await q.next() == (2, b"two")
+        assert q.done()
+
+    asyncio.run(main())
+
+
+def test_chunk_queue_discard_sender():
+    async def main():
+        q = ChunkQueue(_snap(chunks=3))
+        q.add(0, b"zero", "evil")
+        q.add(1, b"one", "good")
+        await q.next()  # chunk 0 consumed
+        q.discard_sender("evil")  # consumed chunks stay
+        assert q.has(1)
+        q.add(2, b"two", "evil")
+        q.discard_sender("evil")
+        assert not q.has(2)
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# kvstore snapshot round-trip
+# ---------------------------------------------------------------------------
+
+def test_kvstore_snapshot_restore():
+    src = KVStoreApplication(snapshot_interval=2, snapshot_chunk_bytes=64)
+    for h in range(1, 5):
+        src.begin_block(abci.RequestBeginBlock())
+        for i in range(4):
+            src.deliver_tx(abci.RequestDeliverTx(tx=b"key%d-%d=value%d" % (h, i, i)))
+        src.end_block(abci.RequestEndBlock(height=h))
+        src.commit()
+    snaps = src.list_snapshots()
+    assert [s.height for s in snaps] == [2, 4]
+    snap = snaps[-1]
+    assert snap.chunks > 1  # tiny chunk size forces multiple chunks
+
+    dst = KVStoreApplication()
+    resp = dst.offer_snapshot(snap, src.app_hash)
+    assert resp.result == abci.ResponseOfferSnapshot.Result.ACCEPT
+    for i in range(snap.chunks):
+        chunk = src.load_snapshot_chunk(snap.height, snap.format, i)
+        r = dst.apply_snapshot_chunk(i, chunk, "peer")
+        assert r.result == abci.ResponseApplySnapshotChunk.Result.ACCEPT
+    assert dst.state == src.state
+    assert dst.app_hash == src.app_hash
+    assert dst.height == snap.height
+
+
+def test_kvstore_rejects_corrupt_chunk():
+    src = KVStoreApplication(snapshot_interval=1, snapshot_chunk_bytes=32)
+    src.deliver_tx(abci.RequestDeliverTx(tx=b"a=b"))
+    src.commit()
+    snap = src.list_snapshots()[0]
+    dst = KVStoreApplication()
+    assert dst.offer_snapshot(snap, src.app_hash).result == abci.ResponseOfferSnapshot.Result.ACCEPT
+    r = dst.apply_snapshot_chunk(0, b"garbage", "evil-peer")
+    assert r.result == abci.ResponseApplySnapshotChunk.Result.RETRY
+    assert r.refetch_chunks == [0]
+    assert r.reject_senders == ["evil-peer"]
+
+
+def test_kvstore_rejects_unknown_format():
+    src = KVStoreApplication(snapshot_interval=1)
+    src.deliver_tx(abci.RequestDeliverTx(tx=b"a=b"))
+    src.commit()
+    snap = src.list_snapshots()[0]
+    bad = abci.Snapshot(snap.height, 99, snap.chunks, snap.hash, snap.metadata)
+    dst = KVStoreApplication()
+    assert (
+        dst.offer_snapshot(bad, b"").result
+        == abci.ResponseOfferSnapshot.Result.REJECT_FORMAT
+    )
+
+
+# ---------------------------------------------------------------------------
+# syncer unit: offer verbs via a scripted app
+# ---------------------------------------------------------------------------
+
+class _ScriptedApp:
+    """Snapshot conn returning scripted OfferSnapshot results."""
+
+    def __init__(self, offers):
+        self.offers = list(offers)
+        self.offered = []
+
+    def offer_snapshot_sync(self, snapshot, app_hash):
+        self.offered.append(snapshot)
+        return abci.ResponseOfferSnapshot(result=self.offers.pop(0))
+
+
+class _HashProvider:
+    def app_hash(self, height):
+        return b"\x01" * 32
+
+
+def test_syncer_tries_next_snapshot_on_reject():
+    r = abci.ResponseOfferSnapshot.Result
+
+    async def main():
+        app = _ScriptedApp([r.REJECT, r.REJECT_FORMAT, r.ABORT])
+
+        async def req_snapshots():
+            pass
+
+        async def req_chunk(peer, snapshot, index):
+            pass
+
+        s = Syncer(app, _HashProvider(), req_snapshots, req_chunk)
+        s.add_snapshot("p1", _snap(height=10, hash_=b"a"))
+        s.add_snapshot("p1", _snap(height=9, format=2, hash_=b"b"))
+        s.add_snapshot("p1", _snap(height=8, format=2, hash_=b"c"))
+        s.add_snapshot("p1", _snap(height=7, hash_=b"d"))
+        with pytest.raises(SyncAbortedError):
+            await s.sync_any(discovery_time=0.01, retries=3)
+        # REJECT dropped 'a'; REJECT_FORMAT on 'b' (format 2) also killed
+        # 'c'; ABORT on 'd' ended the sync
+        assert [snap.hash for snap in app.offered] == [b"a", b"b", b"d"]
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: fresh node restores an 8-height snapshot from a served peer
+# ---------------------------------------------------------------------------
+
+def test_statesync_two_nodes_end_to_end():
+    async def run():
+        server_app = KVStoreApplication(snapshot_interval=4, snapshot_chunk_bytes=128)
+        chain = ChainBuilder(n_vals=4, app=server_app).build(10)
+        network = MemoryNetwork()
+
+        server_router = Router("aa" * 20, network.create_transport("aa" * 20))
+        server_reactor = StateSyncReactor(chain.conns.snapshot(), server_router)
+
+        client_app = KVStoreApplication()
+        client_conns = AppConns(client_app)
+        client_router = Router("bb" * 20, network.create_transport("bb" * 20))
+        tip_time = chain.block_store.load_block_meta(10).header.time_ns
+        provider = lambda: NodeBackedProvider(  # noqa: E731
+            chain.genesis.chain_id, chain.block_store, chain.state_store
+        )
+        state_provider = LightClientStateProvider(
+            chain.genesis.chain_id,
+            chain.genesis,
+            [provider(), provider()],
+            TrustOptions(
+                period_ns=10**15,
+                height=1,
+                hash=chain.block_store.load_block_meta(1).header.hash(),
+            ),
+            now_fn=lambda: tip_time + 10**9,
+        )
+        client_reactor = StateSyncReactor(
+            client_conns.snapshot(), client_router, state_provider
+        )
+
+        await server_router.start()
+        await client_router.start()
+        await server_reactor.start()
+        await client_reactor.start()
+        await client_router.dial("aa" * 20)
+
+        state, commit = await asyncio.wait_for(
+            client_reactor.sync(discovery_time=0.2), timeout=30
+        )
+        # snapshot at height 8 is the best one served
+        assert state.last_block_height == 8
+        assert commit.height == 8
+        # restored app must hold the server's state AT HEIGHT 8,
+        # which contains keys k1..k8 but not k9/k10
+        assert client_app.height == 8
+        assert b"k8" in client_app.state and b"k9" not in client_app.state
+        assert state.app_hash == client_app.app_hash
+        # trusted state is usable for bootstrap: validators present
+        assert state.validators.total_voting_power() > 0
+
+        await client_reactor.stop()
+        await server_reactor.stop()
+        await client_router.stop()
+        await server_router.stop()
+
+    asyncio.run(run())
+
+
+def test_statesync_rejects_tip_snapshot_falls_back():
+    """Regression: a snapshot at the chain tip has no height+2 header yet,
+    so its app hash can't be trusted — the syncer must reject it and
+    restore the next-best snapshot (reference stateprovider.go:94-113
+    piggybacks the availability probe on AppHash)."""
+
+    async def run():
+        server_app = KVStoreApplication(snapshot_interval=5, snapshot_chunk_bytes=128)
+        chain = ChainBuilder(n_vals=4, app=server_app).build(10)  # snaps at 5, 10(=tip)
+        network = MemoryNetwork()
+        sr = Router("aa" * 20, network.create_transport("aa" * 20))
+        s_reactor = StateSyncReactor(chain.conns.snapshot(), sr)
+        client_app = KVStoreApplication()
+        cc = AppConns(client_app)
+        cr = Router("bb" * 20, network.create_transport("bb" * 20))
+        tip = chain.block_store.load_block_meta(10).header.time_ns
+        mk = lambda: NodeBackedProvider(  # noqa: E731
+            chain.genesis.chain_id, chain.block_store, chain.state_store
+        )
+        sp = LightClientStateProvider(
+            chain.genesis.chain_id,
+            chain.genesis,
+            [mk(), mk()],
+            TrustOptions(
+                period_ns=10**15,
+                height=1,
+                hash=chain.block_store.load_block_meta(1).header.hash(),
+            ),
+            now_fn=lambda: tip + 10**9,
+        )
+        c_reactor = StateSyncReactor(cc.snapshot(), cr, sp)
+        await sr.start()
+        await cr.start()
+        await s_reactor.start()
+        await c_reactor.start()
+        await cr.dial("aa" * 20)
+        state, _ = await asyncio.wait_for(c_reactor.sync(discovery_time=0.2), 30)
+        assert state.last_block_height == 5  # tip snapshot (10) rejected
+        assert client_app.height == 5
+        await c_reactor.stop()
+        await s_reactor.stop()
+        await cr.stop()
+        await sr.stop()
+
+    asyncio.run(run())
+
+
+def test_kvstore_restore_recomputes_app_hash():
+    """Regression: a fabricated snapshot cannot smuggle in a trusted app
+    hash — the restored hash is recomputed from the restored state."""
+    snap_meta_chunks = []
+
+    def make_snapshot_from_blob(blob, chunk=64):
+        chunks = [blob[i : i + chunk] for i in range(0, len(blob), chunk)] or [b""]
+        hashes = [hashlib.sha256(c).digest() for c in chunks]
+        meta = json.dumps([h.hex() for h in hashes]).encode()
+        snap = abci.Snapshot(
+            height=3,
+            format=SNAPSHOT_FORMAT,
+            chunks=len(chunks),
+            hash=hashlib.sha256(b"".join(hashes)).digest(),
+            metadata=meta,
+        )
+        return snap, chunks
+
+    forged_blob = json.dumps(
+        {
+            "height": 3,
+            "state": {b"stolen".hex(): b"funds".hex()},
+            "validators": {},
+        },
+        sort_keys=True,
+    ).encode()
+    snap, chunks = make_snapshot_from_blob(forged_blob)
+    dst = KVStoreApplication()
+    assert (
+        dst.offer_snapshot(snap, b"\xaa" * 32).result
+        == abci.ResponseOfferSnapshot.Result.ACCEPT
+    )
+    for i, c in enumerate(chunks):
+        r = dst.apply_snapshot_chunk(i, c, "p")
+        assert r.result == abci.ResponseApplySnapshotChunk.Result.ACCEPT
+    # restored hash reflects the forged state, NOT any smuggled value —
+    # the syncer's verifyApp comparison against the trusted hash fails
+    assert dst.app_hash == dst._compute_app_hash()
+
+    # malformed-but-hash-consistent blob → REJECT_SNAPSHOT, not a crash
+    snap2, chunks2 = make_snapshot_from_blob(b"[1, 2, 3]")
+    dst2 = KVStoreApplication()
+    assert (
+        dst2.offer_snapshot(snap2, b"").result
+        == abci.ResponseOfferSnapshot.Result.ACCEPT
+    )
+    last = None
+    for i, c in enumerate(chunks2):
+        last = dst2.apply_snapshot_chunk(i, c, "p")
+    assert last.result == abci.ResponseApplySnapshotChunk.Result.REJECT_SNAPSHOT
+
+
+def test_kvstore_prunes_old_snapshots():
+    from tendermint_tpu.abci.kvstore import SNAPSHOTS_KEPT
+
+    app = KVStoreApplication(snapshot_interval=1)
+    for h in range(1, SNAPSHOTS_KEPT + 4):
+        app.deliver_tx(abci.RequestDeliverTx(tx=b"k%d=v" % h))
+        app.commit()
+    snaps = app.list_snapshots()
+    assert len(snaps) == SNAPSHOTS_KEPT
+    assert min(s.height for s in snaps) == 4  # oldest pruned

@@ -166,3 +166,19 @@ def test_indexer_service_resubscribes_after_eviction():
         await svc.stop()
 
     asyncio.get_event_loop_policy().new_event_loop().run_until_complete(main())
+
+
+def test_reserved_keys_protected_and_string_height_query():
+    """Regressions: app events must not corrupt the reserved padded
+    tx.height keyspace, and tx.height='5' (string operand) must match."""
+    idx = KVTxIndexer()
+    evil_ev = abci.Event(
+        type="tx",
+        attributes=[abci.EventAttribute(key=b"height", value=b"5", index=True)],
+    )
+    idx.index(_result(1, 0, b"evil", [evil_ev]))
+    idx.index(_result(5, 0, b"good"))
+    # the unpadded app value must not appear in huge-height ranges
+    assert idx.search(parse("tx.height>1000000")) == []
+    assert [r.tx for r in idx.search(parse("tx.height='5'"))] == [b"good"]
+    assert [r.tx for r in idx.search(parse("tx.height=5"))] == [b"good"]
