@@ -223,9 +223,9 @@ class BlockPool:
 
     # -- caught-up test --------------------------------------------------
     def is_caught_up(self) -> bool:
-        """True once the startup grace has passed and no known peer is
-        ahead of us (reference pool.go:176, slightly more conservative:
-        we sync all the way to max_peer_height-1 applied)."""
+        """True once the startup grace has passed and we are within one
+        block of the highest advertised peer height (reference
+        pool.go:176-184 semantics)."""
         now = time.monotonic()
         if now - self._started_at <= self._grace:
             return False
@@ -240,4 +240,8 @@ class BlockPool:
         # Monotonic target: banning/losing the peer that advertised the
         # chain tip must NOT flip us to "caught up" while its heights are
         # still unapplied (reference keeps maxPeerHeight monotonic too).
-        return self.height >= self._max_seen_height
+        # One block of slack (reference pool.go:184 `height >=
+        # maxPeerHeight-1`): the tip block can't be applied until its
+        # successor's commit exists, so requiring exact equality would
+        # chase a moving tip forever.
+        return self.height >= self._max_seen_height - 1

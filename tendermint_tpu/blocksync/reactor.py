@@ -75,15 +75,33 @@ class BlocksyncReactor:
         self.synced = asyncio.Event()
 
     # -- lifecycle -------------------------------------------------------
-    async def start(self) -> None:
+    async def start(self, sync: bool = True) -> None:
+        """sync=False: serve blocks + answer statuses only — the mode of
+        a node already in consensus (reference v0 reactor with
+        fastSync=false skips poolRoutine but still serves requests)."""
         loop = asyncio.get_running_loop()
+        self._serve_only = not sync
+        if self._serve_only:
+            # in-flight requesters will never fill (responses are ignored
+            # in serve-only mode) — drop them so the timeout sweep can't
+            # ban honest peers after the consensus handoff
+            self.pool.requesters.clear()
         self._tasks = [
-            loop.create_task(self._recv_loop()),
+            loop.create_task(self._recv_loop(serve_only=self._serve_only)),
             loop.create_task(self._peer_update_loop()),
-            loop.create_task(self._request_sender()),
             loop.create_task(self._status_ticker()),
-            loop.create_task(self._sync_loop()),
         ]
+        if sync:
+            self._tasks.append(loop.create_task(self._request_sender()))
+            self._tasks.append(loop.create_task(self._sync_loop()))
+
+    def reset_pool(self, state) -> None:
+        """Re-anchor the download pipeline on `state` (used after state
+        sync bootstraps the stores past the construction-time height —
+        reference node.go startStateSync → bcR.SwitchToBlockSync)."""
+        self.state = state
+        grace = self.pool._grace
+        self.pool = BlockPool(state.last_block_height + 1, grace)
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -96,19 +114,21 @@ class BlocksyncReactor:
         self._tasks = []
 
     # -- serving + intake ------------------------------------------------
-    async def _recv_loop(self) -> None:
+    async def _recv_loop(self, serve_only: bool = False) -> None:
         while True:
             env = await self.channel.receive()
             msg, frm = env.message, env.from_
             if isinstance(msg, BlockRequest):
                 await self._respond_block(frm, msg.height)
+            elif isinstance(msg, StatusRequest):
+                await self._send_status(frm)
+            elif serve_only:
+                continue  # not pulling blocks; ignore sync responses
             elif isinstance(msg, BlockResponse):
                 if not self.pool.add_block(frm, msg.block):
                     self.logger.debug("unsolicited block", peer=frm[:8])
             elif isinstance(msg, NoBlockResponse):
                 self.pool.no_block(frm, msg.height)
-            elif isinstance(msg, StatusRequest):
-                await self._send_status(frm)
             elif isinstance(msg, StatusResponse):
                 self.pool.set_peer_range(frm, msg.base, msg.height)
 
@@ -165,8 +185,9 @@ class BlocksyncReactor:
                     channel_id=BLOCKSYNC_CHANNEL,
                 )
             )
-            self.pool.retry_timeouts()
-            await self._disconnect_banned()
+            if not getattr(self, "_serve_only", False):
+                self.pool.retry_timeouts()
+                await self._disconnect_banned()
 
     # -- the batched verify+apply pipeline -------------------------------
     def _window_jobs(self, window: list) -> tuple[list, list[CommitVerifyJob]]:

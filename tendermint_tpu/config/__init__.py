@@ -1,0 +1,27 @@
+from .config import (
+    BaseConfig,
+    Config,
+    InstrumentationConfig,
+    P2PConfig,
+    RPCConfig,
+    StateSyncConfig,
+    TxIndexConfig,
+    default_config,
+    load_config,
+    test_config,
+    write_config,
+)
+
+__all__ = [
+    "BaseConfig",
+    "Config",
+    "InstrumentationConfig",
+    "P2PConfig",
+    "RPCConfig",
+    "StateSyncConfig",
+    "TxIndexConfig",
+    "default_config",
+    "load_config",
+    "test_config",
+    "write_config",
+]

@@ -1,0 +1,249 @@
+"""Config aggregate + TOML persistence + home-dir layout.
+
+Parity: reference config/config.go:55-1070 (Config{Base, RPC, P2P,
+Mempool, StateSync, FastSync, Consensus, TxIndex, Instrumentation} with
+Default*/Test* constructors and ValidateBasic) and config/toml.go
+(config.toml rendering; reads use stdlib tomllib instead of viper).
+
+Home-dir layout (reference: cmd/tendermint/commands/init.go):
+    <home>/config/config.toml
+    <home>/config/genesis.json
+    <home>/config/node_key.json
+    <home>/config/priv_validator_key.json
+    <home>/data/priv_validator_state.json
+    <home>/data/*.db, <home>/data/cs.wal
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+
+from tendermint_tpu.consensus.config import ConsensusConfig
+from tendermint_tpu.mempool.mempool import MempoolConfig
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""  # loaded from genesis
+    moniker: str = "tpu-node"
+    fast_sync: bool = True
+    db_backend: str = "sqlite"  # sqlite | memdb | native (C++ backend when built)
+    log_level: str = "info"
+    log_format: str = "plain"  # plain | json
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""  # remote signer listen address
+    node_key_file: str = "config/node_key.json"
+    abci: str = "builtin"  # builtin | socket
+    proxy_app: str = "kvstore"  # app name (builtin) or address (socket)
+    filter_peers: bool = False
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    cors_allowed_origins: list[str] = field(default_factory=list)
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_ms: int = 10_000
+    max_body_bytes: int = 1_000_000
+    pprof_laddr: str = ""
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""  # comma-separated NodeID@host:port
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    handshake_timeout_s: int = 20
+    dial_timeout_s: int = 3
+    send_rate: int = 5_120_000
+    recv_rate: int = 5_120_000
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: list[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_s: int = 168 * 3600  # 1 week
+    discovery_time_s: float = 15.0
+    chunk_request_timeout_s: float = 10.0
+
+
+@dataclass
+class BlockSyncConfig:
+    version: str = "v0"
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # kv | null
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "tendermint"
+
+
+@dataclass
+class Config:
+    home: str = "."
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    # -- paths -----------------------------------------------------------
+    def path(self, rel: str) -> str:
+        return rel if os.path.isabs(rel) else os.path.join(self.home, rel)
+
+    @property
+    def genesis_file(self) -> str:
+        return self.path(self.base.genesis_file)
+
+    @property
+    def node_key_file(self) -> str:
+        return self.path(self.base.node_key_file)
+
+    @property
+    def priv_validator_key_file(self) -> str:
+        return self.path(self.base.priv_validator_key_file)
+
+    @property
+    def priv_validator_state_file(self) -> str:
+        return self.path(self.base.priv_validator_state_file)
+
+    @property
+    def db_dir(self) -> str:
+        return self.path("data")
+
+    @property
+    def wal_file(self) -> str:
+        return self.path("data/cs.wal")
+
+    @property
+    def config_file(self) -> str:
+        return self.path("config/config.toml")
+
+    @property
+    def addr_book_file(self) -> str:
+        return self.path(self.p2p.addr_book_file)
+
+    def ensure_dirs(self) -> None:
+        for d in ("config", "data"):
+            os.makedirs(self.path(d), exist_ok=True)
+
+    # -- validation ------------------------------------------------------
+    def validate_basic(self) -> None:
+        if self.base.db_backend not in ("sqlite", "memdb", "native"):
+            raise ValueError(f"unknown db_backend {self.base.db_backend!r}")
+        if self.tx_index.indexer not in ("kv", "null"):
+            raise ValueError(f"unknown indexer {self.tx_index.indexer!r}")
+        if self.blocksync.version not in ("v0",):
+            raise ValueError(f"unknown blocksync version {self.blocksync.version!r}")
+        if self.consensus.timeout_commit_ms < 0:
+            raise ValueError("timeout_commit_ms must be >= 0")
+        if self.mempool.size <= 0:
+            raise ValueError("mempool size must be positive")
+        if self.statesync.enable:
+            if len(self.statesync.rpc_servers) < 2:
+                raise ValueError("statesync requires >= 2 rpc_servers")
+            if self.statesync.trust_height <= 0 or not self.statesync.trust_hash:
+                raise ValueError("statesync requires trust_height and trust_hash")
+
+
+_SECTIONS = [
+    ("base", BaseConfig),
+    ("rpc", RPCConfig),
+    ("p2p", P2PConfig),
+    ("mempool", MempoolConfig),
+    ("statesync", StateSyncConfig),
+    ("blocksync", BlockSyncConfig),
+    ("consensus", ConsensusConfig),
+    ("tx_index", TxIndexConfig),
+    ("instrumentation", InstrumentationConfig),
+]
+
+
+def default_config(home: str = ".") -> Config:
+    return Config(home=home)
+
+
+def test_config(home: str = ".") -> Config:
+    cfg = Config(home=home, consensus=ConsensusConfig.test_config())
+    cfg.base.db_backend = "memdb"
+    cfg.p2p.addr_book_strict = False
+    return cfg
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    raise TypeError(f"unsupported TOML value {v!r}")
+
+
+def write_config(cfg: Config, path: str | None = None) -> str:
+    """Render and write config.toml; returns the rendered text."""
+    lines = ["# tendermint_tpu configuration\n"]
+    for name, _ in _SECTIONS:
+        section = getattr(cfg, name)
+        lines.append(f"[{name}]")
+        for f in dataclasses.fields(section):
+            lines.append(f"{f.name} = {_toml_value(getattr(section, f.name))}")
+        lines.append("")
+    text = "\n".join(lines)
+    if path is None:
+        path = cfg.config_file
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(text)
+    return text
+
+
+def load_config(home: str) -> Config:
+    """Load <home>/config/config.toml over defaults; unknown keys are
+    ignored (forward compatibility, like viper)."""
+    cfg = Config(home=home)
+    path = cfg.config_file
+    if not os.path.exists(path):
+        return cfg
+    with open(path, "rb") as fh:
+        doc = tomllib.load(fh)
+    for name, cls in _SECTIONS:
+        data = doc.get(name)
+        if not isinstance(data, dict):
+            continue
+        section = getattr(cfg, name)
+        valid = {f.name for f in dataclasses.fields(cls)}
+        for k, v in data.items():
+            if k in valid:
+                setattr(section, k, v)
+    return cfg

@@ -1,0 +1,268 @@
+"""ABCI handshake: reconcile node / app / store heights after any crash.
+
+Parity: reference consensus/replay.go:242-520 (Handshaker.Handshake,
+ReplayBlocks with the full store/state/app height case matrix,
+replayBlocks fast-forward via ExecCommitBlock, replayBlock through the
+real executor, mock-app replay from saved ABCIResponses).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class AppHashMismatchError(HandshakeError):
+    pass
+
+
+class Handshaker:
+    def __init__(
+        self,
+        state_store,
+        initial_state,
+        block_store,
+        genesis_doc,
+        event_bus=None,
+        logger: Logger | None = None,
+    ):
+        self.state_store = state_store
+        self.initial_state = initial_state
+        self.block_store = block_store
+        self.genesis = genesis_doc
+        self.event_bus = event_bus
+        self.logger = logger or nop_logger()
+        self.n_blocks = 0
+
+    def handshake(self, app_conns):
+        """Info on the query conn, then replay to sync app with store
+        (replay.go:242-280).  Returns the possibly-updated state."""
+        info = app_conns.query().info_sync(abci.RequestInfo())
+        app_height = info.last_block_height
+        if app_height < 0:
+            raise HandshakeError(f"app reported negative height {app_height}")
+        self.logger.info(
+            "ABCI handshake", app_height=app_height, app_hash=info.last_block_app_hash.hex()
+        )
+        state = self.replay_blocks(
+            self.initial_state, info.last_block_app_hash, app_height, app_conns
+        )
+        self.logger.info("handshake complete", blocks_replayed=self.n_blocks)
+        return state
+
+    # ------------------------------------------------------------------
+    def replay_blocks(self, state, app_hash: bytes, app_height: int, app_conns):
+        store_base = self.block_store.base()
+        store_height = self.block_store.height()
+        state_height = state.last_block_height
+
+        # genesis: InitChain (replay.go:304-357)
+        if app_height == 0:
+            state = self._init_chain(state, app_conns)
+
+        # edge cases on store bounds (replay.go:360-385)
+        if store_height == 0:
+            self._assert_state_hash(app_hash if app_height > 0 else state.app_hash, state)
+            return state
+        if app_height == 0 and state.initial_height < store_base:
+            raise HandshakeError(
+                f"app has no state; block store is pruned above initial height "
+                f"(base {store_base})"
+            )
+        if app_height > 0 and app_height < store_base - 1:
+            raise HandshakeError(
+                f"app height {app_height} too far below store base {store_base}"
+            )
+        if store_height < app_height:
+            raise HandshakeError(
+                f"app height {app_height} ahead of store height {store_height}"
+            )
+        if store_height < state_height:
+            raise HandshakeError(
+                f"state height {state_height} ahead of store height {store_height}"
+            )
+        if store_height > state_height + 1:
+            raise HandshakeError(
+                f"store height {store_height} more than one ahead of state "
+                f"height {state_height}"
+            )
+
+        if store_height == state_height:
+            # commit ran and state saved — app may still be behind
+            if app_height < store_height:
+                return self._replay_range(
+                    state, app_conns, app_height, store_height, mutate_state=False
+                )
+            self._assert_state_hash(app_hash, state)
+            return state
+
+        # store_height == state_height + 1: crash between SaveBlock and
+        # state save (replay.go:404-431)
+        if app_height < state_height:
+            return self._replay_range(
+                state, app_conns, app_height, store_height, mutate_state=True
+            )
+        if app_height == state_height:
+            # neither app nor state saw the last block: replay through
+            # the real executor
+            return self._replay_block(state, store_height, app_conns.consensus())
+        if app_height == store_height:
+            # app committed the block but our state didn't: replay
+            # against a mock app answering from saved ABCIResponses
+            responses = self.state_store.load_abci_responses(store_height)
+            if responses is None:
+                raise HandshakeError(
+                    f"no saved ABCI responses for height {store_height}"
+                )
+            mock = _MockAppConn(app_hash, responses)
+            return self._replay_block(state, store_height, mock)
+        raise HandshakeError(
+            f"uncovered replay case: app {app_height} store {store_height} "
+            f"state {state_height}"
+        )
+
+    # ------------------------------------------------------------------
+    def _init_chain(self, state, app_conns):
+        g = self.genesis
+        res = app_conns.consensus().init_chain_sync(
+            abci.RequestInitChain(
+                time_ns=g.genesis_time_ns,
+                chain_id=g.chain_id,
+                initial_height=getattr(g, "initial_height", 1) or 1,
+                validators=[
+                    abci.ValidatorUpdate(pub_key=v.pub_key, power=v.power)
+                    for v in g.validators
+                ],
+                app_state_bytes=getattr(g, "app_state", b"") or b"",
+            )
+        )
+        if state.last_block_height == 0:
+            if res.app_hash:
+                state.app_hash = res.app_hash
+            if res.validators:
+                from tendermint_tpu.types.validator import Validator, ValidatorSet
+
+                vs = ValidatorSet(
+                    [Validator(pub_key=v.pub_key, voting_power=v.power) for v in res.validators]
+                )
+                state.validators = vs
+                state.next_validators = vs.copy_increment_proposer_priority(1)
+            elif not g.validators:
+                raise HandshakeError(
+                    "validator set empty in genesis and still empty after InitChain"
+                )
+            state.last_results_hash = merkle.hash_from_byte_slices([])
+            self.state_store.save(state)
+        return state
+
+    def _replay_range(self, state, app_conns, app_height, store_height, mutate_state):
+        """replay.go:438-492 replayBlocks: fast-forward the app with
+        ExecCommitBlock; if mutate_state, run the final block through the
+        real executor to also advance state."""
+        final = store_height - 1 if mutate_state else store_height
+        first = app_height + 1
+        if first == 1:
+            first = state.initial_height
+        app_hash = b""
+        for h in range(first, final + 1):
+            block = self.block_store.load_block(h)
+            if app_hash and block.header.app_hash != app_hash:
+                raise AppHashMismatchError(
+                    f"block {h} app hash {block.header.app_hash.hex()} != replayed "
+                    f"{app_hash.hex()}"
+                )
+            self.logger.info("replaying block to app", height=h)
+            app_hash = exec_commit_block(
+                app_conns.consensus(), block, self.state_store, state
+            )
+            self.n_blocks += 1
+        if mutate_state:
+            state = self._replay_block(state, store_height, app_conns.consensus())
+            app_hash = state.app_hash
+        self._assert_state_hash(app_hash, state)
+        return state
+
+    def _replay_block(self, state, height, consensus_conn):
+        """Apply the stored block through a real BlockExecutor
+        (replay.go:495-516)."""
+        block = self.block_store.load_block(height)
+        meta = self.block_store.load_block_meta(height)
+        block_id = meta.block_id
+        executor = BlockExecutor(self.state_store, consensus_conn, event_bus=self.event_bus)
+        state, _ = executor.apply_block(state, block_id, block, commit_sigs_verified=True)
+        self.n_blocks += 1
+        return state
+
+    @staticmethod
+    def _assert_state_hash(app_hash: bytes, state) -> None:
+        if app_hash != state.app_hash:
+            raise AppHashMismatchError(
+                f"app hash {app_hash.hex()} != state app hash {state.app_hash.hex()} "
+                "after replay"
+            )
+
+
+def exec_commit_block(consensus_conn, block, state_store, state) -> bytes:
+    """BeginBlock→DeliverTx×N→EndBlock→Commit without state mutation
+    (reference state/execution.go:532 ExecCommitBlock) — used to
+    fast-forward a lagging app over already-committed blocks."""
+    votes = []
+    if block.last_commit is not None and block.last_commit.signatures:
+        vals = state_store.load_validators(block.header.height - 1)
+        for i, cs in enumerate(block.last_commit.signatures):
+            if vals is not None and i < len(vals.validators):
+                v = vals.validators[i]
+                votes.append(
+                    abci.VoteInfo(
+                        validator=abci.Validator(address=v.address, power=v.voting_power),
+                        signed_last_block=not cs.absent(),
+                    )
+                )
+    commit_info = abci.LastCommitInfo(
+        round=block.last_commit.round if block.last_commit else 0, votes=votes
+    )
+    consensus_conn.begin_block_sync(
+        abci.RequestBeginBlock(
+            hash=block.hash() or b"",
+            header=block.header,
+            last_commit_info=commit_info,
+        )
+    )
+    for tx in block.data.txs:
+        consensus_conn.deliver_tx_sync(abci.RequestDeliverTx(tx=tx))
+    consensus_conn.end_block_sync(abci.RequestEndBlock(height=block.header.height))
+    res = consensus_conn.commit_sync()
+    return res.data
+
+
+class _MockAppConn:
+    """Answers the last block's ABCI calls from saved responses
+    (reference newMockProxyApp, replay.go:100-140)."""
+
+    def __init__(self, app_hash: bytes, abci_responses):
+        self.app_hash = app_hash
+        self.responses = abci_responses
+        self._tx_i = 0
+
+    def begin_block_sync(self, req):  # noqa: ARG002
+        return abci.ResponseBeginBlock(events=list(self.responses.begin_block_events))
+
+    def deliver_tx_sync(self, req):  # noqa: ARG002
+        r = self.responses.deliver_txs[self._tx_i]
+        self._tx_i += 1
+        return r
+
+    def end_block_sync(self, req):  # noqa: ARG002
+        return self.responses.end_block or abci.ResponseEndBlock()
+
+    def commit_sync(self):
+        return abci.ResponseCommit(data=self.app_hash)
+
+    def flush_sync(self):
+        return None

@@ -1,0 +1,4 @@
+from .node import Node, load_state_from_db_or_genesis
+from .node_key import NodeKey, load_or_gen_node_key
+
+__all__ = ["Node", "NodeKey", "load_or_gen_node_key", "load_state_from_db_or_genesis"]

@@ -1,0 +1,316 @@
+"""Node assembly: wire every subsystem and run the lifecycle.
+
+Parity: reference node/node.go (NewNode :650, OnStart :904, OnStop,
+LoadStateFromDBOrGenesisDocProvider with genesis-hash pinning,
+createMempoolAndMempoolReactor / createEvidenceReactor /
+createConsensusReactor / createBlockchainReactor wiring order,
+fast-sync → consensus switch via SwitchToConsensus).
+
+TPU-rebuild shape: one asyncio event loop hosts every reactor; the
+crypto data plane (batched commit verification) rides the configured
+BatchVerifier backend (device when available).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from tendermint_tpu.abci import AppConns
+from tendermint_tpu.abci.kvstore import CounterApplication, KVStoreApplication
+from tendermint_tpu.blocksync.reactor import BlocksyncReactor
+from tendermint_tpu.config import Config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.evidence import EvidencePool
+from tendermint_tpu.evidence.reactor import EvidenceReactor
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p import MemoryNetwork, Router
+from tendermint_tpu.privval import load_or_gen_file_pv
+from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from tendermint_tpu.state.txindex import IndexerService, KVTxIndexer, NullTxIndexer
+from tendermint_tpu.statesync.reactor import StateSyncReactor
+from tendermint_tpu.store import BlockStore, open_db
+from tendermint_tpu.types import GenesisDoc
+from tendermint_tpu.types.events import EventBus
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .node_key import load_or_gen_node_key
+
+
+def load_state_from_db_or_genesis(state_store: StateStore, genesis: GenesisDoc):
+    """Genesis-hash pinning (reference node.go
+    LoadStateFromDBOrGenesisDocProvider): a node must never silently
+    switch chains because someone swapped genesis.json."""
+    stored_hash = state_store.genesis_doc_hash()
+    doc_hash = genesis.doc_hash()
+    if stored_hash is not None and stored_hash != doc_hash:
+        raise RuntimeError(
+            "genesis doc hash does not match the one this node was initialized "
+            f"with (stored {stored_hash.hex()}, file {doc_hash.hex()})"
+        )
+    state = state_store.load()
+    if state is None:
+        genesis.validate_and_complete()
+        state = make_genesis_state(genesis)
+        state_store.save(state)
+    if stored_hash is None:
+        state_store.save_genesis_doc_hash(doc_hash)
+    return state
+
+
+def _builtin_app(name: str):
+    """reference proxy/client.go DefaultClientCreator local apps."""
+    if name in ("kvstore", "persistent_kvstore"):
+        return KVStoreApplication(snapshot_interval=0)
+    if name == "counter":
+        return CounterApplication()
+    if name == "counter_serial":
+        return CounterApplication(serial=True)
+    if name == "noop":
+        from tendermint_tpu.abci.types import BaseApplication
+
+        return BaseApplication()
+    raise ValueError(f"unknown builtin app {name!r}")
+
+
+class Node:
+    """A full node: stores, app conns, event bus, indexer, reactors,
+    consensus — started/stopped as one unit."""
+
+    def __init__(
+        self,
+        config: Config,
+        genesis: GenesisDoc | None = None,
+        app=None,
+        transport=None,
+        state_provider=None,
+        logger: Logger | None = None,
+    ):
+        self.config = config
+        self.logger = logger or nop_logger()
+        config.ensure_dirs()
+
+        # -- genesis + stores ------------------------------------------
+        if genesis is None:
+            with open(config.genesis_file) as fh:
+                genesis = GenesisDoc.from_json(fh.read())
+        self.genesis = genesis
+
+        backend = config.base.db_backend
+        self.block_db = self._open_db(backend, "blockstore")
+        self.state_db = self._open_db(backend, "state")
+        self.evidence_db = self._open_db(backend, "evidence")
+        self.tx_index_db = self._open_db(backend, "tx_index")
+        self.block_store = BlockStore(self.block_db)
+        self.state_store = StateStore(self.state_db)
+        state = load_state_from_db_or_genesis(self.state_store, genesis)
+
+        # -- app + handshake -------------------------------------------
+        if app is None:
+            if config.base.abci != "builtin":
+                raise NotImplementedError(
+                    "external ABCI transports arrive with the socket server; "
+                    "pass an app instance or use abci=builtin"
+                )
+            app = _builtin_app(config.base.proxy_app)
+        self.app = app
+        self.app_conns = AppConns(app)
+
+        # -- event bus + indexer ---------------------------------------
+        self.event_bus = EventBus()
+        if config.tx_index.indexer == "kv":
+            self.tx_indexer = KVTxIndexer(self.tx_index_db)
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus, self.logger)
+
+        # -- handshake (replays blocks into the app) -------------------
+        self.handshaker = Handshaker(
+            self.state_store, state, self.block_store, genesis,
+            event_bus=None, logger=self.logger,
+        )
+        state = self.handshaker.handshake(self.app_conns)
+        self.initial_state = state
+
+        # -- validator key ---------------------------------------------
+        self.priv_validator = None
+        if not config.base.priv_validator_laddr:
+            self.priv_validator = load_or_gen_file_pv(
+                config.priv_validator_key_file, config.priv_validator_state_file
+            )
+        else:
+            raise NotImplementedError("remote signer wiring lands with privval/socket")
+
+        # -- p2p ---------------------------------------------------------
+        self.node_key = load_or_gen_node_key(config.node_key_file)
+        if transport is None:
+            # no external transport: private in-memory net (single-node);
+            # TCP transport is selected by the CLI when p2p.laddr is set
+            transport = MemoryNetwork().create_transport(self.node_key.node_id)
+        self.router = Router(self.node_key.node_id, transport, logger=self.logger)
+
+        # -- mempool / evidence / executor ------------------------------
+        self.mempool = Mempool(config.mempool, self.app_conns.mempool())
+        self.evidence_pool = EvidencePool(
+            self.evidence_db, self.state_store, self.block_store, logger=self.logger
+        )
+        self.executor = BlockExecutor(
+            self.state_store,
+            self.app_conns.consensus(),
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+        )
+
+        # -- consensus --------------------------------------------------
+        self.wal = WAL(config.wal_file)
+        self.consensus = ConsensusState(
+            config.consensus,
+            state,
+            self.executor,
+            self.block_store,
+            wal=self.wal,
+            priv_validator=self.priv_validator,
+            evidence_pool=self.evidence_pool,
+            logger=self.logger,
+        )
+        self.consensus.event_bus = self.event_bus
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus, self.router, self.block_store, logger=self.logger
+        )
+        self.mempool_reactor = MempoolReactor(self.mempool, self.router, logger=self.logger)
+        self.evidence_reactor = EvidenceReactor(
+            self.evidence_pool, self.router, logger=self.logger
+        )
+
+        # -- sync reactors ---------------------------------------------
+        self._caught_up = asyncio.Event()
+        self.blocksync_reactor = BlocksyncReactor(
+            state,
+            self.executor,
+            self.block_store,
+            self.router,
+            on_caught_up=self._on_caught_up,
+            logger=self.logger,
+        )
+        self.statesync_reactor = StateSyncReactor(
+            self.app_conns.snapshot(), self.router, state_provider, logger=self.logger
+        )
+
+        self._consensus_running = False
+        self._started = False
+        self._switch_task: asyncio.Task | None = None
+
+    def _open_db(self, backend: str, name: str):
+        if backend == "memdb":
+            return open_db("memdb")
+        path = os.path.join(self.config.db_dir, f"{name}.db")
+        return open_db(backend, path)
+
+    # -- lifecycle -------------------------------------------------------
+    async def start(self) -> None:
+        """reference node.go OnStart :904-992 ordering."""
+        if self._started:
+            raise RuntimeError("node already started")
+        self._started = True
+        await self.indexer_service.start()
+        await self.router.start()
+        await self.statesync_reactor.start()
+
+        if self.config.statesync.enable and self.statesync_reactor.syncer.state_provider:
+            state, commit = await self.statesync_reactor.sync(
+                discovery_time=self.config.statesync.discovery_time_s
+            )
+            self.state_store.bootstrap(state)
+            self.block_store.save_seen_commit(commit.height, commit)
+            # re-anchor everything downstream on the restored state: the
+            # blocksync pool must start at snapshot+1 (not the stale
+            # construction-time height) and a fast_sync=False node must
+            # hand consensus the restored state, not the genesis one
+            self.blocksync_reactor.reset_pool(state)
+            self.initial_state = state
+            self.logger.info("state sync complete", height=state.last_block_height)
+
+        await self.mempool_reactor.start()
+        await self.evidence_reactor.start()
+        await self.consensus_reactor.start()
+
+        if self.config.base.fast_sync:
+            await self.blocksync_reactor.start(sync=True)
+        else:
+            # serve blocks to syncing peers while running consensus
+            await self.blocksync_reactor.start(sync=False)
+            await self._start_consensus(self.initial_state)
+
+    def _on_caught_up(self, state) -> None:
+        """Blocksync finished — switch to consensus
+        (reference consensus/reactor.go:106 SwitchToConsensus)."""
+        if self._consensus_running or not self._started:
+            return
+        self._caught_up.set()
+        self._switch_task = asyncio.get_running_loop().create_task(
+            self._switch_to_consensus(state)
+        )
+
+    async def _switch_to_consensus(self, state) -> None:
+        if not self._started:
+            return
+        # drop the sync pipeline but keep serving blocks to other peers
+        await self.blocksync_reactor.stop()
+        await self.blocksync_reactor.start(sync=False)
+        await self._start_consensus(state)
+
+    async def _start_consensus(self, state) -> None:
+        if self._consensus_running:
+            return
+        self._consensus_running = True
+        cs = self.consensus
+        if state.last_block_height > (cs.state.last_block_height if cs.state else 0):
+            # blocksync/statesync advanced past the handshake state
+            cs.reconstruct_last_commit(state)
+            cs.rs.height = 0  # allow re-prime
+            cs.rs.commit_round = -1
+            cs.update_to_state(state)
+        await cs.start()
+        self.logger.info("consensus started", height=cs.rs.height)
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        if self._switch_task is not None:
+            self._switch_task.cancel()
+            try:
+                await self._switch_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._switch_task = None
+        if self._consensus_running:
+            await self.consensus.stop()
+            self._consensus_running = False
+        await self.blocksync_reactor.stop()
+        await self.consensus_reactor.stop()
+        await self.evidence_reactor.stop()
+        await self.mempool_reactor.stop()
+        await self.statesync_reactor.stop()
+        await self.router.stop()
+        await self.indexer_service.stop()
+        self.event_bus.shutdown()
+        self.wal.close()
+        for db in (self.block_db, self.state_db, self.evidence_db, self.tx_index_db):
+            try:
+                db.close()
+            except Exception:
+                pass
+
+    # -- convenience -----------------------------------------------------
+    async def wait_for_height(self, h: int, timeout: float = 60.0) -> None:
+        async def poll():
+            while self.block_store.height() < h:
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(poll(), timeout)

@@ -1,0 +1,45 @@
+"""P2P node identity key (reference p2p/key.go:143 LoadOrGenNodeKey).
+
+NodeID = hex address of the node's ed25519 pubkey (p2p/key.go:33)."""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto.keys import PrivKey, priv_key_from_seed
+from tendermint_tpu.p2p.types import node_id_from_pubkey
+
+
+@dataclass
+class NodeKey:
+    priv_key: PrivKey
+
+    @property
+    def node_id(self) -> str:
+        return node_id_from_pubkey(self.priv_key.pub_key())
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # private key material: owner-only, like the reference's 0600
+        # (p2p/key.go LoadOrGenNodeKey)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as fh:
+            json.dump({"priv_key": {"type": "tendermint/PrivKeyEd25519",
+                                    "value": self.priv_key.bytes_().hex()}}, fh)
+
+    @classmethod
+    def load(cls, path: str) -> "NodeKey":
+        with open(path) as fh:
+            doc = json.load(fh)
+        return cls(priv_key=priv_key_from_seed(bytes.fromhex(doc["priv_key"]["value"])))
+
+
+def load_or_gen_node_key(path: str) -> NodeKey:
+    if os.path.exists(path):
+        return NodeKey.load(path)
+    nk = NodeKey(priv_key=priv_key_from_seed(secrets.token_bytes(32)))
+    nk.save(path)
+    return nk
