@@ -195,6 +195,7 @@ def test_config(home: str = ".") -> Config:
     cfg = Config(home=home, consensus=ConsensusConfig.test_config())
     cfg.base.db_backend = "memdb"
     cfg.p2p.addr_book_strict = False
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"  # ephemeral port; no collisions
     return cfg
 
 

@@ -62,6 +62,20 @@ def load_state_from_db_or_genesis(state_store: StateStore, genesis: GenesisDoc):
     return state
 
 
+def _parse_laddr(laddr: str) -> tuple[str, int]:
+    """tcp://host:port → (host, port); port 0 picks an ephemeral port.
+    Handles bracketed IPv6 ([::1]:26657) and a missing port (→ 26657)."""
+    body = laddr.split("://", 1)[-1]
+    if body.startswith("["):  # [v6]:port
+        host, _, rest = body[1:].partition("]")
+        port = rest.lstrip(":")
+    else:
+        host, _, port = body.rpartition(":")
+        if not _:  # no colon at all: bare host
+            host, port = body, ""
+    return host or "127.0.0.1", int(port) if port else 26657
+
+
 def _builtin_app(name: str):
     """reference proxy/client.go DefaultClientCreator local apps."""
     if name in ("kvstore", "persistent_kvstore"):
@@ -201,6 +215,34 @@ class Node:
             self.app_conns.snapshot(), self.router, state_provider, logger=self.logger
         )
 
+        # -- RPC --------------------------------------------------------
+        from tendermint_tpu.rpc.core import Environment
+        from tendermint_tpu.rpc.server import RPCServer
+
+        self.rpc_env = Environment(
+            config=config,
+            genesis=genesis,
+            block_store=self.block_store,
+            state_store=self.state_store,
+            consensus=self.consensus,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            tx_indexer=self.tx_indexer,
+            event_bus=self.event_bus,
+            app_query_conn=self.app_conns.query(),
+            router=self.router,
+            node_id=self.node_key.node_id,
+            moniker=config.base.moniker,
+        )
+        self.rpc_server = RPCServer(
+            self.rpc_env,
+            logger=self.logger,
+            max_body_bytes=config.rpc.max_body_bytes,
+            max_open_connections=config.rpc.max_open_connections,
+            cors_allowed_origins=config.rpc.cors_allowed_origins,
+        )
+        self.rpc_addr: tuple[str, int] | None = None
+
         self._consensus_running = False
         self._started = False
         self._switch_task: asyncio.Task | None = None
@@ -218,6 +260,9 @@ class Node:
             raise RuntimeError("node already started")
         self._started = True
         await self.indexer_service.start()
+        if self.config.rpc.laddr:
+            host, port = _parse_laddr(self.config.rpc.laddr)
+            self.rpc_addr = await self.rpc_server.start(host, port)
         await self.router.start()
         await self.statesync_reactor.start()
 
@@ -298,6 +343,7 @@ class Node:
         await self.mempool_reactor.stop()
         await self.statesync_reactor.stop()
         await self.router.stop()
+        await self.rpc_server.stop()
         await self.indexer_service.stop()
         self.event_bus.shutdown()
         self.wal.close()

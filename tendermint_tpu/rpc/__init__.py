@@ -1,0 +1,4 @@
+from .client import HTTPClient, WSClient
+from .server import RPCServer
+
+__all__ = ["HTTPClient", "RPCServer", "WSClient"]

@@ -1,0 +1,211 @@
+"""RPC clients: HTTP JSON-RPC and WebSocket subscription client.
+
+Parity: reference rpc/client/http (http.go) + rpc/jsonrpc/client —
+the Go client surface (Status, Block, BroadcastTx*, Subscribe, …)
+mapped onto asyncio.  The HTTP client pipelines requests on one
+keep-alive connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import itertools
+import json
+import os
+
+from .jsonrpc import RPCError
+from .websocket import OP_TEXT, WSConnection, accept_key
+
+
+class HTTPClient:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._ids = itertools.count(1)
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+            self._writer = None
+
+    async def call(self, method: str, **params):
+        """JSON-RPC call; raises RPCError on error responses."""
+        req_id = next(self._ids)
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": req_id, "method": method, "params": params}
+        ).encode()
+        async with self._lock:
+            # lazy connect under the lock: two concurrent first calls must
+            # not each open a connection and cross responses
+            if self._writer is None:
+                await self.connect()
+            head = (
+                f"POST / HTTP/1.1\r\nHost: {self.host}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            )
+            self._writer.write(head.encode() + body)
+            await self._writer.drain()
+            doc = await self._read_response()
+        if "error" in doc:
+            e = doc["error"]
+            raise RPCError(e.get("code", -1), e.get("message", ""), e.get("data", ""))
+        return doc["result"]
+
+    async def _read_response(self) -> dict:
+        status = await self._reader.readline()
+        if not status:
+            raise ConnectionError("server closed connection")
+        headers = {}
+        while True:
+            line = await self._reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if b":" in line:
+                k, v = line.decode("latin-1").split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length", 0))
+        body = await self._reader.readexactly(n) if n else b""
+        return json.loads(body)
+
+    # -- convenience wrappers (reference rpc/client interface) ----------
+    async def status(self):
+        return await self.call("status")
+
+    async def health(self):
+        return await self.call("health")
+
+    async def block(self, height: int | None = None):
+        return await self.call("block", **({"height": height} if height else {}))
+
+    async def commit(self, height: int | None = None):
+        return await self.call("commit", **({"height": height} if height else {}))
+
+    async def validators(self, height: int | None = None, page=None, per_page=None):
+        params = {k: v for k, v in
+                  (("height", height), ("page", page), ("per_page", per_page)) if v}
+        return await self.call("validators", **params)
+
+    async def broadcast_tx_sync(self, tx: bytes):
+        return await self.call("broadcast_tx_sync", tx=base64.b64encode(tx).decode())
+
+    async def broadcast_tx_async(self, tx: bytes):
+        return await self.call("broadcast_tx_async", tx=base64.b64encode(tx).decode())
+
+    async def broadcast_tx_commit(self, tx: bytes):
+        return await self.call("broadcast_tx_commit", tx=base64.b64encode(tx).decode())
+
+    async def abci_query(self, path: str, data: bytes, height=None, prove=False):
+        return await self.call(
+            "abci_query", path=path, data="0x" + data.hex(), prove=prove,
+            **({"height": height} if height else {}),
+        )
+
+    async def abci_info(self):
+        return await self.call("abci_info")
+
+    async def tx(self, tx_hash: bytes, prove: bool = False):
+        return await self.call("tx", hash="0x" + tx_hash.hex(), prove=prove)
+
+    async def tx_search(self, query: str, page=None, per_page=None, order_by=None):
+        params = {"query": query}
+        for k, v in (("page", page), ("per_page", per_page), ("order_by", order_by)):
+            if v:
+                params[k] = v
+        return await self.call("tx_search", **params)
+
+    async def blockchain(self, min_height=None, max_height=None):
+        params = {}
+        if min_height:
+            params["minHeight"] = min_height
+        if max_height:
+            params["maxHeight"] = max_height
+        return await self.call("blockchain", **params)
+
+    async def genesis(self):
+        return await self.call("genesis")
+
+    async def net_info(self):
+        return await self.call("net_info")
+
+    async def consensus_state(self):
+        return await self.call("consensus_state")
+
+
+class WSClient:
+    """WebSocket subscription client (reference rpc/jsonrpc/client/ws_client.go)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._ws: WSConnection | None = None
+        self._ids = itertools.count(1)
+
+    async def connect(self) -> None:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (
+            f"GET /websocket HTTP/1.1\r\nHost: {self.host}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+        )
+        writer.write(req.encode())
+        await writer.drain()
+        status = await reader.readline()
+        if b"101" not in status:
+            raise ConnectionError(f"websocket upgrade refused: {status!r}")
+        want = accept_key(key)
+        ok = False
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if line.lower().startswith(b"sec-websocket-accept:"):
+                ok = line.decode().split(":", 1)[1].strip() == want
+        if not ok:
+            raise ConnectionError("bad Sec-WebSocket-Accept")
+        self._ws = WSConnection(reader, writer, mask_outgoing=True)
+
+    async def close(self) -> None:
+        if self._ws is not None:
+            await self._ws.send_close()
+            self._ws = None
+
+    async def call(self, method: str, **params) -> None:
+        req_id = next(self._ids)
+        await self._ws.send_text(json.dumps(
+            {"jsonrpc": "2.0", "id": req_id, "method": method, "params": params}
+        ))
+
+    async def subscribe(self, query: str) -> None:
+        await self.call("subscribe", query=query)
+
+    async def unsubscribe(self, query: str) -> None:
+        await self.call("unsubscribe", query=query)
+
+    async def next_message(self, timeout: float | None = None) -> dict | None:
+        """Next JSON message from the server (responses and events
+        interleaved)."""
+        async def recv():
+            while True:
+                msg = await self._ws.receive()
+                if msg is None:
+                    return None
+                opcode, payload = msg
+                if opcode == OP_TEXT:
+                    return json.loads(payload)
+
+        if timeout is None:
+            return await recv()
+        return await asyncio.wait_for(recv(), timeout)

@@ -1,0 +1,206 @@
+"""RPC JSON encoding of core types.
+
+Follows the reference RPC JSON conventions (tmjson): int64 fields as
+decimal strings, hashes/addresses as upper-hex strings, raw blobs (txs,
+signatures, app data) as base64, timestamps as RFC3339 with nanosecond
+precision (types/time + libs/json)."""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+
+
+def b64(data: bytes | None) -> str:
+    return base64.b64encode(data or b"").decode()
+
+
+def hexu(data: bytes | None) -> str:
+    return (data or b"").hex().upper()
+
+
+def i64(n: int) -> str:
+    return str(int(n))
+
+
+def rfc3339(time_ns: int) -> str:
+    secs, nanos = divmod(int(time_ns), 10**9)
+    dt = _dt.datetime.fromtimestamp(secs, _dt.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{nanos:09d}Z"
+
+
+def parse_rfc3339(s: str) -> int:
+    body = s.rstrip("Z")
+    if "." in body:
+        main, frac = body.split(".", 1)
+        frac = (frac + "0" * 9)[:9]
+    else:
+        main, frac = body, "0" * 9
+    dt = _dt.datetime.fromisoformat(main).replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp()) * 10**9 + int(frac)
+
+
+def block_id_json(bid) -> dict:
+    psh = getattr(bid, "part_set_header", None)
+    return {
+        "hash": hexu(getattr(bid, "hash", b"")),
+        "parts": {
+            "total": getattr(psh, "total", 0) if psh else 0,
+            "hash": hexu(getattr(psh, "hash", b"") if psh else b""),
+        },
+    }
+
+
+def header_json(h) -> dict:
+    return {
+        "version": {"block": i64(h.version_block), "app": i64(h.version_app)},
+        "chain_id": h.chain_id,
+        "height": i64(h.height),
+        "time": rfc3339(h.time_ns),
+        "last_block_id": block_id_json(h.last_block_id),
+        "last_commit_hash": hexu(h.last_commit_hash),
+        "data_hash": hexu(h.data_hash),
+        "validators_hash": hexu(h.validators_hash),
+        "next_validators_hash": hexu(h.next_validators_hash),
+        "consensus_hash": hexu(h.consensus_hash),
+        "app_hash": hexu(h.app_hash),
+        "last_results_hash": hexu(h.last_results_hash),
+        "evidence_hash": hexu(h.evidence_hash),
+        "proposer_address": hexu(h.proposer_address),
+    }
+
+
+def commit_sig_json(cs) -> dict:
+    return {
+        "block_id_flag": int(cs.block_id_flag),
+        "validator_address": hexu(cs.validator_address),
+        "timestamp": rfc3339(cs.timestamp_ns),
+        "signature": b64(cs.signature) if cs.signature else None,
+    }
+
+
+def commit_json(c) -> dict:
+    return {
+        "height": i64(c.height),
+        "round": c.round,
+        "block_id": block_id_json(c.block_id),
+        "signatures": [commit_sig_json(cs) for cs in c.signatures],
+    }
+
+
+def block_json(b) -> dict:
+    return {
+        "header": header_json(b.header),
+        "data": {"txs": [b64(tx) for tx in b.data.txs]},
+        "evidence": {"evidence": [evidence_json(e) for e in b.evidence]},
+        "last_commit": commit_json(b.last_commit) if b.last_commit else None,
+    }
+
+
+def block_meta_json(meta) -> dict:
+    return {
+        "block_id": block_id_json(meta.block_id),
+        "block_size": i64(getattr(meta, "block_size", 0)),
+        "header": header_json(meta.header),
+        "num_txs": i64(getattr(meta, "num_txs", 0)),
+    }
+
+
+def evidence_json(ev) -> dict:
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        return {
+            "type": "tendermint/DuplicateVoteEvidence",
+            "value": {
+                "vote_a": vote_json(ev.vote_a),
+                "vote_b": vote_json(ev.vote_b),
+                "TotalVotingPower": i64(ev.total_voting_power),
+                "ValidatorPower": i64(ev.validator_power),
+                "Timestamp": rfc3339(ev.timestamp_ns),
+            },
+        }
+    return {
+        "type": "tendermint/LightClientAttackEvidence",
+        "value": {
+            "common_height": i64(ev.common_height),
+            "total_voting_power": i64(ev.total_voting_power),
+            "timestamp": rfc3339(ev.timestamp_ns),
+        },
+    }
+
+
+def vote_json(v) -> dict:
+    return {
+        "type": int(v.type),
+        "height": i64(v.height),
+        "round": v.round,
+        "block_id": block_id_json(v.block_id),
+        "timestamp": rfc3339(v.timestamp_ns),
+        "validator_address": hexu(v.validator_address),
+        "validator_index": v.validator_index,
+        "signature": b64(v.signature),
+    }
+
+
+def validator_json(v) -> dict:
+    return {
+        "address": hexu(v.address),
+        "pub_key": {"type": "tendermint/PubKeyEd25519", "value": b64(v.pub_key.bytes_())},
+        "voting_power": i64(v.voting_power),
+        "proposer_priority": i64(v.proposer_priority),
+    }
+
+
+def consensus_params_json(p) -> dict:
+    return {
+        "block": {
+            "max_bytes": i64(p.block.max_bytes),
+            "max_gas": i64(p.block.max_gas),
+        },
+        "evidence": {
+            "max_age_num_blocks": i64(p.evidence.max_age_num_blocks),
+            "max_age_duration": i64(p.evidence.max_age_duration_ns),
+            "max_bytes": i64(p.evidence.max_bytes),
+        },
+        "validator": {"pub_key_types": list(p.validator.pub_key_types)},
+    }
+
+
+def event_json(ev) -> dict:
+    return {
+        "type": ev.type,
+        "attributes": [
+            {
+                "key": b64(a.key if isinstance(a.key, bytes) else str(a.key).encode()),
+                "value": b64(a.value if isinstance(a.value, bytes) else str(a.value).encode()),
+                "index": bool(getattr(a, "index", False)),
+            }
+            for a in ev.attributes
+        ],
+    }
+
+
+def deliver_tx_json(r) -> dict:
+    return {
+        "code": r.code,
+        "data": b64(r.data),
+        "log": r.log,
+        "info": getattr(r, "info", ""),
+        "gas_wanted": i64(r.gas_wanted),
+        "gas_used": i64(r.gas_used),
+        "events": [event_json(e) for e in r.events],
+        "codespace": getattr(r, "codespace", ""),
+    }
+
+
+def tx_result_json(tr) -> dict:
+    from tendermint_tpu.crypto import tmhash
+
+    return {
+        "hash": hexu(tmhash.sum_sha256(tr.tx)),
+        "height": i64(tr.height),
+        "index": tr.index,
+        "tx_result": deliver_tx_json(tr.result),
+        "tx": b64(tr.tx),
+    }

@@ -1,0 +1,59 @@
+"""JSON-RPC 2.0 protocol types and error codes.
+
+Parity: reference rpc/jsonrpc/types (RPCRequest/RPCResponse/RPCError,
+error codes rpc/jsonrpc/types/types.go).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+    def to_json(self) -> dict:
+        out = {"code": self.code, "message": self.message}
+        if self.data:
+            out["data"] = self.data
+        return out
+
+
+@dataclass
+class Request:
+    id: object
+    method: str
+    params: dict | list | None
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "Request":
+        if not isinstance(doc, dict) or doc.get("jsonrpc") != "2.0":
+            raise RPCError(INVALID_REQUEST, "invalid JSON-RPC 2.0 request")
+        method = doc.get("method")
+        if not isinstance(method, str):
+            raise RPCError(INVALID_REQUEST, "missing method")
+        return cls(id=doc.get("id"), method=method, params=doc.get("params"))
+
+
+def response_json(req_id, result=None, error: RPCError | None = None) -> dict:
+    out = {"jsonrpc": "2.0", "id": req_id}
+    if error is not None:
+        out["error"] = error.to_json()
+    else:
+        out["result"] = result
+    return out
+
+
+def encode_response(req_id, result=None, error: RPCError | None = None) -> bytes:
+    return json.dumps(response_json(req_id, result, error)).encode()

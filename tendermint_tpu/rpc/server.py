@@ -1,0 +1,504 @@
+"""RPC server: JSON-RPC 2.0 over HTTP POST, URI GET routes, and
+WebSocket subscriptions — on raw asyncio streams.
+
+Parity: reference rpc/jsonrpc/server (http_json_handler.go,
+http_uri_handler.go, ws_handler.go) + rpc/core/events.go
+(subscribe/unsubscribe with per-client limits, slow clients
+disconnected).  The image ships no HTTP framework; the protocol surface
+here is deliberately small: HTTP/1.1 keep-alive, no TLS (the reference
+delegates TLS to config; same), 1MB default body cap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import urllib.parse
+
+from tendermint_tpu.pubsub import SubscriptionCancelledError
+from tendermint_tpu.pubsub.query import parse as parse_query
+from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from . import core
+from .jsonrpc import (
+    INTERNAL_ERROR,
+    INVALID_PARAMS,
+    METHOD_NOT_FOUND,
+    PARSE_ERROR,
+    Request,
+    RPCError,
+    response_json,
+)
+from .websocket import OP_TEXT, WSConnection, accept_key
+
+
+# URI params whose handlers expect raw byte-string encodings (base64/hex).
+# These must never be numerically coerced: an all-digit hex hash is still a
+# hash (reference decodes by the handler's declared arg type,
+# http_uri_handler.go jsonStringToArg; we key off the param name instead).
+_RAW_STRING_PARAMS = frozenset({"tx", "hash", "data", "evidence", "path", "query"})
+
+
+def _coerce_uri_value(name: str, v: str):
+    """URI params arrive as strings: quoted → string, bytes-typed params
+    kept verbatim, digits → int, true/false → bool."""
+    if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+        return v[1:-1]
+    if name in _RAW_STRING_PARAMS:
+        return v
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v)  # rejects '--5', '1_0', etc. that isdigit() heuristics miss
+    except ValueError:
+        return v
+
+
+def _parse_uri_query(raw: str) -> dict:
+    """Like parse_qsl but '+' stays '+' (base64 values travel in URI
+    params; only percent-escapes are decoded)."""
+    params: dict[str, object] = {}
+    for pair in raw.split("&"):
+        if not pair:
+            continue
+        k, _, v = pair.partition("=")
+        name = urllib.parse.unquote(k)
+        params[name] = _coerce_uri_value(name, urllib.parse.unquote(v))
+    return params
+
+
+class _BodyTooLarge(Exception):
+    pass
+
+
+_sig_cache: dict[object, inspect.Signature] = {}
+
+
+def _route_signature(fn) -> inspect.Signature:
+    sig = _sig_cache.get(fn)
+    if sig is None:
+        sig = _sig_cache[fn] = inspect.signature(fn)
+    return sig
+
+
+class RPCServer:
+    def __init__(self, env: core.Environment, logger: Logger | None = None,
+                 max_body_bytes: int = 1_000_000,
+                 max_open_connections: int = 900,
+                 cors_allowed_origins: list[str] | None = None):
+        self.env = env
+        self.logger = logger or nop_logger()
+        self.max_body_bytes = max_body_bytes
+        self.max_open_connections = max_open_connections
+        self.cors_allowed_origins = cors_allowed_origins or []
+        self._server: asyncio.AbstractServer | None = None
+        # Every live connection-handler task (HTTP keep-alive and WS alike):
+        # stop() must cancel these BEFORE wait_closed() — on 3.12+
+        # Server.wait_closed() waits for handlers, and an idle keep-alive
+        # client would otherwise hold shutdown forever.
+        self._conn_tasks: set[asyncio.Task] = set()
+        self._ws_client_seq = 0
+        self._ws_subscribers: set[str] = set()  # client ids with ≥1 live subscription
+
+    # -- lifecycle -------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle_conn, host, port)
+        addr = self._server.sockets[0].getsockname()
+        self.logger.info("RPC server listening", addr=f"{addr[0]}:{addr[1]}")
+        return addr[0], addr[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for t in list(self._conn_tasks):
+            t.cancel()
+        for t in list(self._conn_tasks):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._conn_tasks.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- connection handling ---------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+        try:
+            if len(self._conn_tasks) > self.max_open_connections:
+                await self._write_http_response(
+                    writer, "503 Service Unavailable", b"too many connections\n",
+                    keep_alive=False, content_type="text/plain",
+                )
+                return
+            while True:
+                req = await self._read_http_request(reader)
+                if req is None:
+                    break
+                method, target, headers, body = req
+                if (
+                    method == "GET"
+                    and headers.get("upgrade", "").lower() == "websocket"
+                ):
+                    await self._handle_websocket(reader, writer, headers)
+                    return
+                keep_alive = headers.get("connection", "").lower() != "close"
+                await self._handle_http(writer, method, target, body, keep_alive,
+                                        origin=headers.get("origin"))
+                if not keep_alive:
+                    break
+        except _BodyTooLarge:
+            try:
+                await self._write_http_response(
+                    writer, "413 Content Too Large",
+                    b"request body exceeds max_body_bytes\n",
+                    keep_alive=False, content_type="text/plain",
+                )
+            except Exception:
+                pass
+        except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
+            pass
+        except Exception as e:
+            self.logger.error("RPC connection handler error", err=str(e))
+            try:
+                await self._write_http_response(
+                    writer, "500 Internal Server Error", b"internal error\n",
+                    keep_alive=False, content_type="text/plain",
+                )
+            except Exception:
+                pass
+        finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_http_request(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        parts = line.decode("latin-1").strip().split()
+        if len(parts) < 2:
+            return None
+        method, target = parts[0].upper(), parts[1]
+        headers: dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if b":" in h:
+                k, v = h.decode("latin-1").split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        body = b""
+        try:
+            n = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            return None
+        if n > self.max_body_bytes:
+            raise _BodyTooLarge
+        if n > 0:
+            body = await reader.readexactly(n)
+        return method, target, headers, body
+
+    def _cors_headers(self, origin: str | None) -> str:
+        if not origin or not self.cors_allowed_origins:
+            return ""
+        if "*" in self.cors_allowed_origins or origin in self.cors_allowed_origins:
+            return (
+                f"Access-Control-Allow-Origin: {origin}\r\n"
+                "Access-Control-Allow-Methods: GET, POST, OPTIONS\r\n"
+                "Access-Control-Allow-Headers: Content-Type\r\n"
+            )
+        return ""
+
+    async def _write_http_response(
+        self, writer, status: str, body: bytes, keep_alive: bool = True,
+        content_type: str = "application/json", extra_headers: str = "",
+    ):
+        head = (
+            f"HTTP/1.1 {status}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"{extra_headers}"
+            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
+        )
+        writer.write(head.encode() + body)
+        await writer.drain()
+
+    # -- HTTP dispatch ----------------------------------------------------
+    async def _handle_http(self, writer, method, target, body, keep_alive,
+                           origin: str | None = None):
+        parsed = urllib.parse.urlsplit(target)
+        path = parsed.path
+        cors = self._cors_headers(origin)
+        if method == "OPTIONS":
+            await self._write_http_response(
+                writer, "204 No Content", b"", keep_alive, "text/plain", cors
+            )
+        elif method == "POST":
+            await self._handle_jsonrpc_post(writer, body, keep_alive, cors)
+        elif method == "GET":
+            if path in ("", "/"):
+                routes = "\n".join(sorted(core.ROUTES))
+                await self._write_http_response(
+                    writer, "200 OK", f"Available endpoints:\n{routes}\n".encode(),
+                    keep_alive, "text/plain", cors,
+                )
+                return
+            name = path.lstrip("/")
+            params = _parse_uri_query(parsed.query)
+            doc = await self._call(name, params, req_id=-1)
+            status = "200 OK" if "error" not in doc else "500 Internal Server Error"
+            await self._write_http_response(
+                writer, status, json.dumps(doc).encode(), keep_alive,
+                extra_headers=cors,
+            )
+        else:
+            await self._write_http_response(
+                writer, "405 Method Not Allowed", b"", keep_alive, "text/plain"
+            )
+
+    async def _handle_jsonrpc_post(self, writer, body, keep_alive, cors: str = ""):
+        try:
+            doc = json.loads(body or b"null")
+        except json.JSONDecodeError:
+            out = response_json(None, error=RPCError(PARSE_ERROR, "invalid JSON"))
+            await self._write_http_response(writer, "500 Internal Server Error",
+                                            json.dumps(out).encode(), keep_alive,
+                                            extra_headers=cors)
+            return
+        if isinstance(doc, list):  # batch (reference http_json_handler.go:32)
+            results = [await self._dispatch_jsonrpc(item) for item in doc]
+            results = [r for r in results if r is not None]
+            await self._write_http_response(writer, "200 OK", json.dumps(results).encode(),
+                                            keep_alive, extra_headers=cors)
+        else:
+            out = await self._dispatch_jsonrpc(doc)
+            await self._write_http_response(writer, "200 OK", json.dumps(out).encode(),
+                                            keep_alive, extra_headers=cors)
+
+    async def _dispatch_jsonrpc(self, doc) -> dict | None:
+        try:
+            req = Request.from_json(doc)
+        except RPCError as e:
+            return response_json(None, error=e)
+        if req.id is None:
+            # notification: execute but do not reply
+            await self._call(req.method, req.params, req_id=None)
+            return None
+        return await self._call(req.method, req.params, req_id=req.id)
+
+    async def _call(self, name: str, params, req_id) -> dict:
+        fn = core.ROUTES.get(name)
+        if fn is None:
+            return response_json(req_id, error=RPCError(METHOD_NOT_FOUND, f"unknown method {name}"))
+        kwargs = {}
+        if isinstance(params, dict):
+            kwargs = params
+        elif isinstance(params, list) and params:
+            return response_json(
+                req_id,
+                error=RPCError(INVALID_PARAMS, "positional params are not supported; use named params"),
+            )
+        # Unknown/duplicate param names are the CALLER's fault → INVALID_PARAMS.
+        # A TypeError thrown inside the handler is OURS → INTERNAL_ERROR below.
+        try:
+            _route_signature(fn).bind(self.env, **kwargs)
+        except TypeError as e:
+            return response_json(req_id, error=RPCError(INVALID_PARAMS, str(e)))
+        try:
+            if asyncio.iscoroutinefunction(fn):
+                result = await fn(self.env, **kwargs)
+            else:
+                result = fn(self.env, **kwargs)
+            return response_json(req_id, result=result)
+        except RPCError as e:
+            return response_json(req_id, error=e)
+        except Exception as e:
+            self.logger.error("RPC handler error", method=name, err=str(e))
+            return response_json(req_id, error=RPCError(INTERNAL_ERROR, str(e)))
+
+    # -- WebSocket subscriptions -----------------------------------------
+    async def _handle_websocket(self, reader, writer, headers):
+        key = headers.get("sec-websocket-key", "")
+        resp = (
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {accept_key(key)}\r\n\r\n"
+        )
+        writer.write(resp.encode())
+        await writer.drain()
+        ws = WSConnection(reader, writer, mask_outgoing=False)
+        self._ws_client_seq += 1
+        client_id = f"ws-{self._ws_client_seq}"
+        pumps: dict[str, asyncio.Task] = {}  # query string -> pump task
+        try:
+            while True:
+                msg = await ws.receive()
+                if msg is None:
+                    break
+                opcode, payload = msg
+                if opcode != OP_TEXT:
+                    continue
+                try:
+                    doc = json.loads(payload)
+                    req = Request.from_json(doc)
+                except (json.JSONDecodeError, RPCError):
+                    await ws.send_text(json.dumps(
+                        response_json(None, error=RPCError(PARSE_ERROR, "invalid request"))
+                    ))
+                    continue
+                out = await self._ws_dispatch(ws, client_id, pumps, req)
+                if out is not None:
+                    await ws.send_text(json.dumps(out))
+        finally:
+            for t in pumps.values():
+                t.cancel()
+            self._ws_subscribers.discard(client_id)
+            if self.env.event_bus is not None:
+                try:
+                    self.env.event_bus.unsubscribe_all(client_id)
+                except KeyError:
+                    pass
+
+    async def _ws_dispatch(self, ws, client_id, pumps, req) -> dict | None:
+        """subscribe/unsubscribe are WS-only (reference routes.go:12-14);
+        every other method dispatches like HTTP."""
+        params = req.params if isinstance(req.params, dict) else {}
+        if req.method == "subscribe":
+            return await self._ws_subscribe(ws, client_id, pumps, req.id, params)
+        if req.method == "unsubscribe":
+            qs = str(params.get("query", ""))
+            try:
+                self.env.event_bus.unsubscribe(client_id, qs)
+                t = pumps.pop(qs, None)
+                if t:
+                    t.cancel()
+                if not pumps:
+                    self._ws_subscribers.discard(client_id)
+                return response_json(req.id, result={})
+            except KeyError:
+                return response_json(req.id, error=RPCError(INTERNAL_ERROR, "subscription not found"))
+        if req.method == "unsubscribe_all":
+            try:
+                self.env.event_bus.unsubscribe_all(client_id)
+            except KeyError:
+                pass
+            for t in pumps.values():
+                t.cancel()
+            pumps.clear()
+            self._ws_subscribers.discard(client_id)
+            return response_json(req.id, result={})
+        return await self._call(req.method, req.params, req_id=req.id)
+
+    async def _ws_subscribe(self, ws, client_id, pumps, req_id, params) -> dict:
+        rpc_cfg = getattr(self.env.config, "rpc", None)
+        max_subs = getattr(rpc_cfg, "max_subscriptions_per_client", 5)
+        max_clients = getattr(rpc_cfg, "max_subscription_clients", 100)
+        if len(pumps) >= max_subs:
+            return response_json(req_id, error=RPCError(INTERNAL_ERROR, "too many subscriptions"))
+        if client_id not in self._ws_subscribers and len(self._ws_subscribers) >= max_clients:
+            return response_json(
+                req_id, error=RPCError(INTERNAL_ERROR, "too many subscription clients")
+            )
+        qs = str(params.get("query", ""))
+        try:
+            query = parse_query(qs)
+        except Exception as e:
+            return response_json(req_id, error=RPCError(INVALID_PARAMS, f"bad query: {e}"))
+        if self.env.event_bus is None:
+            return response_json(req_id, error=RPCError(INTERNAL_ERROR, "event bus unavailable"))
+        try:
+            sub = self.env.event_bus.subscribe(client_id, query, capacity=100)
+        except ValueError as e:
+            return response_json(req_id, error=RPCError(INTERNAL_ERROR, str(e)))
+
+        async def pump():
+            try:
+                while True:
+                    msg = await sub.next()
+                    payload = {
+                        "query": qs,
+                        "data": _event_data_json(msg.data),
+                        "events": msg.events,
+                    }
+                    await ws.send_text(json.dumps(response_json(req_id, result=payload)))
+            except SubscriptionCancelledError as e:
+                # slow-client eviction or shutdown: tell the client, close
+                try:
+                    await ws.send_text(json.dumps(response_json(
+                        req_id, error=RPCError(INTERNAL_ERROR, f"subscription cancelled: {e}")
+                    )))
+                    await ws.send_close()
+                except Exception:
+                    pass
+            except (asyncio.CancelledError, ConnectionResetError):
+                pass
+
+        pumps[qs] = asyncio.get_running_loop().create_task(pump())
+        self._ws_subscribers.add(client_id)
+        return response_json(req_id, result={})
+
+
+def _event_data_json(data) -> dict:
+    """Typed event payloads → RPC JSON (reference types/events.go
+    TMEventData registry)."""
+    from . import encoding as enc
+
+    if isinstance(data, tmevents.EventDataNewBlock):
+        return {
+            "type": "tendermint/event/NewBlock",
+            "value": {
+                "block": enc.block_json(data.block),
+                "block_id": enc.block_id_json(data.block_id),
+            },
+        }
+    if isinstance(data, tmevents.EventDataNewBlockHeader):
+        return {
+            "type": "tendermint/event/NewBlockHeader",
+            "value": {"header": enc.header_json(data.header), "num_txs": enc.i64(data.num_txs)},
+        }
+    if isinstance(data, tmevents.EventDataTx):
+        return {"type": "tendermint/event/Tx", "value": {"TxResult": enc.tx_result_json(data.tx_result)}}
+    if isinstance(data, tmevents.EventDataVote):
+        return {"type": "tendermint/event/Vote", "value": {"Vote": enc.vote_json(data.vote)}}
+    if isinstance(data, tmevents.EventDataRoundState):
+        return {
+            "type": "tendermint/event/RoundState",
+            "value": {"height": enc.i64(data.height), "round": data.round, "step": data.step},
+        }
+    if isinstance(data, tmevents.EventDataNewRound):
+        return {
+            "type": "tendermint/event/NewRound",
+            "value": {
+                "height": enc.i64(data.height),
+                "round": data.round,
+                "proposer": {"address": enc.hexu(data.proposer_address), "index": data.proposer_index},
+            },
+        }
+    if isinstance(data, tmevents.EventDataValidatorSetUpdates):
+        return {
+            "type": "tendermint/event/ValidatorSetUpdates",
+            "value": {
+                "validator_updates": [
+                    {
+                        "pub_key": {
+                            "type": "tendermint/PubKeyEd25519",
+                            "value": enc.b64(v.pub_key.bytes_()),
+                        },
+                        "power": enc.i64(v.power),
+                    }
+                    for v in data.validator_updates
+                ]
+            },
+        }
+    return {"type": type(data).__name__, "value": {}}

@@ -1,0 +1,110 @@
+"""Minimal RFC 6455 WebSocket codec over asyncio streams.
+
+The image ships no websocket library; the subscription surface
+(reference rpc/jsonrpc/server/ws_handler.go) needs only text frames,
+ping/pong, and close — implemented here for both server and client
+sides.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import os
+import struct
+
+GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def accept_key(client_key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1((client_key + GUID).encode()).digest()
+    ).decode()
+
+
+class WSConnection:
+    """Frame reader/writer shared by server (mask=False on send) and
+    client (mask=True on send) endpoints."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 mask_outgoing: bool):
+        self.reader = reader
+        self.writer = writer
+        self.mask_outgoing = mask_outgoing
+        self.closed = False
+
+    async def send_text(self, data: str) -> None:
+        await self._send_frame(OP_TEXT, data.encode())
+
+    async def send_close(self, code: int = 1000) -> None:
+        if not self.closed:
+            await self._send_frame(OP_CLOSE, struct.pack("!H", code))
+            self.closed = True
+
+    async def _send_frame(self, opcode: int, payload: bytes) -> None:
+        header = bytearray([0x80 | opcode])
+        mask_bit = 0x80 if self.mask_outgoing else 0
+        n = len(payload)
+        if n < 126:
+            header.append(mask_bit | n)
+        elif n < (1 << 16):
+            header.append(mask_bit | 126)
+            header += struct.pack("!H", n)
+        else:
+            header.append(mask_bit | 127)
+            header += struct.pack("!Q", n)
+        if self.mask_outgoing:
+            mask = os.urandom(4)
+            header += mask
+            payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.writer.write(bytes(header) + payload)
+        await self.writer.drain()
+
+    async def receive(self) -> tuple[int, bytes] | None:
+        """Next complete message (opcode, payload); answers pings
+        transparently; None on close/EOF."""
+        buffer = b""
+        msg_opcode = None
+        while True:
+            try:
+                head = await self.reader.readexactly(2)
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                return None
+            fin = bool(head[0] & 0x80)
+            opcode = head[0] & 0x0F
+            masked = bool(head[1] & 0x80)
+            n = head[1] & 0x7F
+            if n == 126:
+                n = struct.unpack("!H", await self.reader.readexactly(2))[0]
+            elif n == 127:
+                n = struct.unpack("!Q", await self.reader.readexactly(8))[0]
+            if n > 64 * 1024 * 1024:
+                await self.send_close(1009)
+                return None
+            mask = await self.reader.readexactly(4) if masked else None
+            payload = await self.reader.readexactly(n) if n else b""
+            if mask:
+                payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+            if opcode == OP_PING:
+                await self._send_frame(OP_PONG, payload)
+                continue
+            if opcode == OP_PONG:
+                continue
+            if opcode == OP_CLOSE:
+                await self.send_close()
+                return None
+            if opcode in (OP_TEXT, OP_BINARY):
+                msg_opcode = opcode
+                buffer = payload
+            elif opcode == OP_CONT:
+                buffer += payload
+            if fin and msg_opcode is not None:
+                return msg_opcode, buffer
