@@ -57,6 +57,7 @@ class RPCConfig:
 
 @dataclass
 class P2PConfig:
+    transport: str = "tcp"  # "tcp" (SecretConnection over sockets) | "memory"
     laddr: str = "tcp://0.0.0.0:26656"
     external_address: str = ""
     seeds: str = ""  # comma-separated NodeID@host:port
@@ -195,6 +196,7 @@ def test_config(home: str = ".") -> Config:
     cfg = Config(home=home, consensus=ConsensusConfig.test_config())
     cfg.base.db_backend = "memdb"
     cfg.p2p.addr_book_strict = False
+    cfg.p2p.transport = "memory"  # in-proc tests default to the fake net
     cfg.rpc.laddr = "tcp://127.0.0.1:0"  # ephemeral port; no collisions
     return cfg
 

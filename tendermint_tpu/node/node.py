@@ -29,6 +29,7 @@ from tendermint_tpu.evidence.reactor import EvidenceReactor
 from tendermint_tpu.mempool import Mempool
 from tendermint_tpu.mempool.reactor import MempoolReactor
 from tendermint_tpu.p2p import MemoryNetwork, Router
+from tendermint_tpu.p2p.tcp import TCPTransport
 from tendermint_tpu.privval import load_or_gen_file_pv
 from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
 from tendermint_tpu.state.txindex import IndexerService, KVTxIndexer, NullTxIndexer
@@ -62,9 +63,10 @@ def load_state_from_db_or_genesis(state_store: StateStore, genesis: GenesisDoc):
     return state
 
 
-def _parse_laddr(laddr: str) -> tuple[str, int]:
+def _parse_laddr(laddr: str, default_port: int = 26657) -> tuple[str, int]:
     """tcp://host:port → (host, port); port 0 picks an ephemeral port.
-    Handles bracketed IPv6 ([::1]:26657) and a missing port (→ 26657)."""
+    Handles bracketed IPv6 ([::1]:26657) and a missing port (→ default:
+    26657 for RPC, 26656 for p2p)."""
     body = laddr.split("://", 1)[-1]
     if body.startswith("["):  # [v6]:port
         host, _, rest = body[1:].partition("]")
@@ -73,7 +75,7 @@ def _parse_laddr(laddr: str) -> tuple[str, int]:
         host, _, port = body.rpartition(":")
         if not _:  # no colon at all: bare host
             host, port = body, ""
-    return host or "127.0.0.1", int(port) if port else 26657
+    return host or "127.0.0.1", int(port) if port else default_port
 
 
 def _builtin_app(name: str):
@@ -162,10 +164,21 @@ class Node:
         # -- p2p ---------------------------------------------------------
         self.node_key = load_or_gen_node_key(config.node_key_file)
         if transport is None:
-            # no external transport: private in-memory net (single-node);
-            # TCP transport is selected by the CLI when p2p.laddr is set
-            transport = MemoryNetwork().create_transport(self.node_key.node_id)
+            if config.p2p.transport == "tcp" and config.p2p.laddr:
+                host, port = _parse_laddr(config.p2p.laddr, default_port=26656)
+                transport = TCPTransport(
+                    self.node_key, network=genesis.chain_id,
+                    host=host, port=port, moniker=config.base.moniker,
+                    logger=self.logger,
+                    max_incoming_connections=config.p2p.max_num_inbound_peers,
+                )
+            else:
+                # private in-memory net (single-node / in-proc tests)
+                transport = MemoryNetwork().create_transport(self.node_key.node_id)
+        self.transport = transport
         self.router = Router(self.node_key.node_id, transport, logger=self.logger)
+        self.p2p_addr: tuple[str, int] | None = None
+        self._dialer_task: asyncio.Task | None = None
 
         # -- mempool / evidence / executor ------------------------------
         self.mempool = Mempool(config.mempool, self.app_conns.mempool())
@@ -263,7 +276,15 @@ class Node:
         if self.config.rpc.laddr:
             host, port = _parse_laddr(self.config.rpc.laddr)
             self.rpc_addr = await self.rpc_server.start(host, port)
+        if isinstance(self.transport, TCPTransport):
+            # advertise the channels the reactors registered (compat check)
+            self.transport.channels = bytes(self.router.channels.keys())
+            self.p2p_addr = await self.transport.listen()
         await self.router.start()
+        if isinstance(self.transport, TCPTransport) and self.config.p2p.persistent_peers:
+            self._dialer_task = asyncio.get_running_loop().create_task(
+                self._dial_persistent_peers()
+            )
         await self.statesync_reactor.start()
 
         if self.config.statesync.enable and self.statesync_reactor.syncer.state_provider:
@@ -290,6 +311,39 @@ class Node:
             # serve blocks to syncing peers while running consensus
             await self.blocksync_reactor.start(sync=False)
             await self._start_consensus(self.initial_state)
+
+    async def _dial_persistent_peers(self) -> None:
+        """Keep persistent peers connected, with per-peer exponential
+        backoff (reference p2p/switch.go reconnectToPeer)."""
+        targets: dict[str, str] = {}
+        for addr in self.config.p2p.persistent_peers.split(","):
+            addr = addr.strip()
+            if not addr:
+                continue
+            try:
+                targets[self.transport.add_peer_address(addr)] = addr
+            except ValueError as e:
+                self.logger.error("bad persistent peer address", addr=addr, err=str(e))
+        backoff = dict.fromkeys(targets, 0.5)
+        next_try = dict.fromkeys(targets, 0.0)
+
+        async def try_dial(pid: str) -> None:
+            try:
+                await self.router.dial(pid)
+                backoff[pid] = 0.5
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                self.logger.debug("dial failed", peer=pid[:8], err=str(e))
+                backoff[pid] = min(backoff[pid] * 2, 30.0)
+                next_try[pid] = asyncio.get_running_loop().time() + backoff[pid]
+
+        while True:
+            now = asyncio.get_running_loop().time()
+            due = [pid for pid in targets
+                   if pid not in self.router.peers and now >= next_try[pid]]
+            if due:
+                # concurrently: one unreachable peer must not stall the rest
+                await asyncio.gather(*(try_dial(pid) for pid in due))
+            await asyncio.sleep(0.5)
 
     def _on_caught_up(self, state) -> None:
         """Blocksync finished — switch to consensus
@@ -327,6 +381,13 @@ class Node:
         if not self._started:
             return
         self._started = False
+        if self._dialer_task is not None:
+            self._dialer_task.cancel()
+            try:
+                await self._dialer_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._dialer_task = None
         if self._switch_task is not None:
             self._switch_task.cancel()
             try:

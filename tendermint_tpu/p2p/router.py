@@ -90,6 +90,11 @@ class Router:
         if remote_id in self.peers or remote_id == self.node_id:
             return
         conn = await self.transport.dial(remote_id)
+        # simultaneous dial+accept of the same peer: the check above ran
+        # before the await — if the inbound side won, keep it
+        if remote_id in self.peers:
+            await conn.close()
+            return
         self._add_peer(remote_id, conn)
 
     async def _accept_loop(self) -> None:
@@ -105,6 +110,7 @@ class Router:
             self._add_peer(remote_id, conn)
 
     def _add_peer(self, node_id: NodeID, conn) -> None:
+        assert node_id not in self.peers, f"duplicate peer {node_id[:8]}"
         peer = _Peer(node_id, conn)
         loop = asyncio.get_running_loop()
         peer.tasks.append(loop.create_task(self._peer_recv(peer)))

@@ -1,0 +1,237 @@
+"""TCP transport: SecretConnection + NodeInfo handshake + channel framing.
+
+Parity: reference p2p/transport_mconn.go (Transport iface {Listen,
+Accept, Dial}) layered over p2p/conn/secret_connection.go, plus the
+NodeInfo compatibility handshake (p2p/node_info.go:51-74: protocol
+versions, network/chain-id, supported channels, moniker) and the
+dialed-peer identity check (dialed NodeID must match the authenticated
+key's address, p2p/transport.go).
+
+Framing inside the encrypted stream: 1-byte channel id + payload per
+sealed message — the prioritized multiplexing the reference does in
+MConnection lives in the Router's per-peer priority queue instead
+(SURVEY §2.6), so this layer stays a plain ordered pipe.
+
+Addresses use the reference's `NodeID@host:port` format
+(p2p/netaddress.go:419).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .secret_connection import HandshakeError, SecretConnection
+from .types import NodeID, node_id_from_pubkey
+
+P2P_PROTOCOL_VERSION = 8  # reference version/version.go:11-24
+BLOCK_PROTOCOL_VERSION = 11
+
+
+def parse_net_address(addr: str) -> tuple[NodeID, str, int]:
+    """`nodeid@host:port` → (node_id, host, port)."""
+    node_id, _, hostport = addr.partition("@")
+    if not hostport:
+        raise ValueError(f"address {addr!r} missing @host:port")
+    if hostport.startswith("["):
+        host, _, rest = hostport[1:].partition("]")
+        port = rest.lstrip(":")
+    else:
+        host, _, port = hostport.rpartition(":")
+    if not host or not port:
+        raise ValueError(f"address {addr!r} missing host or port")
+    return node_id.lower(), host, int(port)
+
+
+class TCPConnection:
+    """One authenticated peer connection (channel frames over a
+    SecretConnection)."""
+
+    def __init__(self, sconn: SecretConnection, writer, remote_id: NodeID,
+                 remote_node_info: dict, on_close=None):
+        self._sconn = sconn
+        self._writer = writer
+        self.remote_id = remote_id
+        self.remote_node_info = remote_node_info
+        self._closed = False
+        self._send_lock = asyncio.Lock()
+        self._on_close = on_close
+
+    async def send(self, channel_id: int, data: bytes) -> None:
+        if self._closed:
+            raise ConnectionError("connection closed")
+        try:
+            async with self._send_lock:
+                await self._sconn.send(bytes([channel_id]) + data)
+        except (OSError, asyncio.IncompleteReadError) as e:
+            raise ConnectionError(str(e)) from None
+
+    async def receive(self) -> tuple[int, bytes]:
+        if self._closed:
+            raise ConnectionError("connection closed")
+        try:
+            msg = await self._sconn.receive()
+        except (OSError, asyncio.IncompleteReadError) as e:
+            raise ConnectionError(str(e)) from None
+        if not msg:
+            raise ConnectionError("empty frame")
+        return msg[0], msg[1:]
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._on_close is not None:
+            self._on_close()
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except Exception:
+            pass
+
+
+class TCPTransport:
+    """Listening endpoint + dialer. Use `await listen()` before handing
+    to the Router; register peer addresses with `add_peer_address` so
+    `dial(node_id)` can resolve them."""
+
+    def __init__(self, node_key, network: str, host: str = "0.0.0.0",
+                 port: int = 26656, moniker: str = "", channels: bytes = b"",
+                 logger: Logger | None = None,
+                 max_incoming_connections: int = 64):
+        self.node_key = node_key
+        self.network = network
+        self.host = host
+        self.port = port
+        self.moniker = moniker
+        self.channels = channels
+        self.logger = logger or nop_logger()
+        self.max_incoming_connections = max_incoming_connections
+        self.node_id: NodeID = node_key.node_id
+        self.listen_addr: tuple[str, int] | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._accept_q: asyncio.Queue = asyncio.Queue(maxsize=64)
+        self._addrs: dict[NodeID, tuple[str, int]] = {}
+        self._incoming = 0
+        self._closed = False
+
+    # -- address book ----------------------------------------------------
+    def add_peer_address(self, addr: str) -> NodeID:
+        node_id, host, port = parse_net_address(addr)
+        self._addrs[node_id] = (host, port)
+        return node_id
+
+    # -- node info handshake ---------------------------------------------
+    def _node_info(self) -> dict:
+        return {
+            "protocol_version": {
+                "p2p": P2P_PROTOCOL_VERSION,
+                "block": BLOCK_PROTOCOL_VERSION,
+            },
+            "node_id": self.node_id,
+            "network": self.network,
+            "moniker": self.moniker,
+            "channels": self.channels.hex(),
+            "listen_port": self.listen_addr[1] if self.listen_addr else 0,
+        }
+
+    def _check_compat(self, info: dict) -> None:
+        """Reference node_info.go CompatibleWith: same network, same p2p
+        major, ≥1 common channel."""
+        if info.get("network") != self.network:
+            raise HandshakeError(
+                f"peer network {info.get('network')!r} != ours {self.network!r}"
+            )
+        if info.get("protocol_version", {}).get("p2p") != P2P_PROTOCOL_VERSION:
+            raise HandshakeError("incompatible p2p protocol version")
+        ours, theirs = set(self.channels), set(bytes.fromhex(info.get("channels", "")))
+        if ours and theirs and not (ours & theirs):
+            raise HandshakeError("no common channels")
+
+    async def _upgrade(self, reader, writer, expect_id: NodeID | None,
+                       on_close=None) -> TCPConnection:
+        return await asyncio.wait_for(
+            self._upgrade_inner(reader, writer, expect_id, on_close), 15.0
+        )
+
+    async def _upgrade_inner(self, reader, writer, expect_id: NodeID | None,
+                             on_close) -> TCPConnection:
+        sconn = await SecretConnection.handshake(reader, writer, self.node_key.priv_key)
+        remote_id = node_id_from_pubkey(sconn.remote_pub)
+        if remote_id == self.node_id:
+            raise HandshakeError("self-connection")
+        if expect_id is not None and remote_id != expect_id:
+            # dialed-peer auth: the key that signed must be the ID we dialed
+            raise HandshakeError(f"dialed {expect_id[:8]} but peer is {remote_id[:8]}")
+        await sconn.send(json.dumps(self._node_info()).encode())
+        try:
+            info = json.loads(await sconn.receive())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise HandshakeError("bad node info") from None
+        self._check_compat(info)
+        if info.get("node_id") != remote_id:
+            raise HandshakeError("node info id does not match authenticated key")
+        return TCPConnection(sconn, writer, remote_id, info, on_close=on_close)
+
+    # -- transport interface ---------------------------------------------
+    async def listen(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._on_accept, self.host, self.port)
+        self.listen_addr = self._server.sockets[0].getsockname()[:2]
+        self.logger.info("p2p listening",
+                         addr=f"{self.listen_addr[0]}:{self.listen_addr[1]}")
+        return self.listen_addr
+
+    async def _on_accept(self, reader, writer) -> None:
+        if self._closed or self._incoming >= self.max_incoming_connections:
+            writer.close()
+            return
+        self._incoming += 1
+
+        def _dec():
+            self._incoming -= 1
+
+        try:
+            conn = await self._upgrade(reader, writer, expect_id=None, on_close=_dec)
+        except (HandshakeError, ConnectionError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, OSError) as e:
+            self.logger.info("inbound handshake failed", err=str(e))
+            _dec()
+            writer.close()
+            return
+        await self._accept_q.put(conn)
+
+    async def accept(self) -> TCPConnection:
+        conn = await self._accept_q.get()
+        if conn is None:
+            raise ConnectionError("transport closed")
+        return conn
+
+    async def dial(self, remote: NodeID | str, connect_timeout: float = 5.0) -> TCPConnection:
+        if "@" in remote:
+            remote = self.add_peer_address(remote)
+        addr = self._addrs.get(remote)
+        if addr is None:
+            raise ConnectionError(f"no known address for peer {remote[:8]}")
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(*addr), connect_timeout
+            )
+        except asyncio.TimeoutError:
+            raise ConnectionError(f"connect to {addr[0]}:{addr[1]} timed out") from None
+        try:
+            return await self._upgrade(reader, writer, expect_id=remote)
+        except BaseException:
+            writer.close()
+            raise
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        try:
+            self._accept_q.put_nowait(None)
+        except asyncio.QueueFull:
+            pass
