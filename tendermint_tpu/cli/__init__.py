@@ -1,0 +1,8 @@
+"""Operator CLI (reference cmd/tendermint/main.go:15-33).
+
+Commands: init, start, testnet, gen-validator, gen-node-key,
+show-node-id, show-validator, unsafe-reset-all, version.
+Run as `python -m tendermint_tpu.cli <command>`.
+"""
+
+from .main import main  # noqa: F401

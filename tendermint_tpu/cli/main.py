@@ -1,0 +1,309 @@
+"""CLI entry points.
+
+Parity: reference cmd/tendermint/commands/ — init.go, run_node.go,
+testnet.go, gen_validator.go, gen_node_key.go, show_node_id.go,
+show_validator.go, reset_priv_validator.go, version.go.  cobra/viper
+become argparse + the TOML config loader; flags override file values
+the same way (flag > config.toml > default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+VERSION = "0.1.0"
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _home(args) -> str:
+    return os.path.expanduser(args.home)
+
+
+def _load_config(args):
+    from tendermint_tpu.config import load_config
+
+    cfg = load_config(_home(args))
+    # flag overrides (reference run_node.go flag binding)
+    for flag, (section, key) in _FLAG_MAP.items():
+        v = getattr(args, flag, None)
+        if v is not None:
+            setattr(getattr(cfg, section), key, v)
+    return cfg
+
+
+_FLAG_MAP = {
+    "moniker": ("base", "moniker"),
+    "proxy_app": ("base", "proxy_app"),
+    "abci": ("base", "abci"),
+    "fast_sync": ("base", "fast_sync"),
+    "db_backend": ("base", "db_backend"),
+    "log_level": ("base", "log_level"),
+    "rpc_laddr": ("rpc", "laddr"),
+    "p2p_laddr": ("p2p", "laddr"),
+    "p2p_persistent_peers": ("p2p", "persistent_peers"),
+    "p2p_seeds": ("p2p", "seeds"),
+    "consensus_create_empty_blocks": ("consensus", "create_empty_blocks"),
+}
+
+
+def _add_node_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--moniker", help="node name")
+    p.add_argument("--proxy-app", dest="proxy_app",
+                   help="ABCI app (builtin name or socket address)")
+    p.add_argument("--abci", choices=["builtin", "socket"], help="ABCI transport")
+    p.add_argument("--fast-sync", dest="fast_sync", action="store_true", default=None)
+    p.add_argument("--no-fast-sync", dest="fast_sync", action="store_false")
+    p.add_argument("--db-backend", dest="db_backend")
+    p.add_argument("--log-level", dest="log_level")
+    p.add_argument("--rpc.laddr", dest="rpc_laddr", help="RPC listen address")
+    p.add_argument("--p2p.laddr", dest="p2p_laddr", help="p2p listen address")
+    p.add_argument("--p2p.persistent-peers", dest="p2p_persistent_peers",
+                   help="comma-separated id@host:port")
+    p.add_argument("--p2p.seeds", dest="p2p_seeds")
+    p.add_argument("--consensus.create-empty-blocks",
+                   dest="consensus_create_empty_blocks",
+                   type=lambda s: s.lower() == "true", default=None)
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+def cmd_init(args) -> int:
+    """reference cmd/tendermint/commands/init.go"""
+    from tendermint_tpu.config import default_config, write_config
+    from tendermint_tpu.node.node_key import load_or_gen_node_key
+    from tendermint_tpu.privval.file_pv import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    home = _home(args)
+    cfg = default_config(home)
+    cfg.ensure_dirs()
+
+    if os.path.exists(cfg.config_file):
+        print(f"found config file at {cfg.config_file}; not overwriting")
+    else:
+        write_config(cfg)
+        print(f"wrote config to {cfg.config_file}")
+
+    pv = load_or_gen_file_pv(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+    nk = load_or_gen_node_key(cfg.node_key_file)
+
+    if os.path.exists(cfg.genesis_file):
+        print(f"found genesis file at {cfg.genesis_file}; not overwriting")
+    else:
+        chain_id = args.chain_id or f"test-chain-{os.urandom(3).hex()}"
+        gen = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pub_key=pv.get_pub_key(), power=10)],
+        )
+        with open(cfg.genesis_file, "w") as fh:
+            fh.write(gen.to_json())
+        print(f"wrote genesis (chain {chain_id}) to {cfg.genesis_file}")
+    print(f"node id: {nk.node_id}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """reference cmd/tendermint/commands/run_node.go"""
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.utils.log import new_logger
+
+    cfg = _load_config(args)
+    cfg.validate_basic()
+    logger = new_logger(level=cfg.base.log_level)
+    node = Node(cfg, logger=logger)
+
+    async def run():
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop_ev.set)
+        await node.start()
+        logger.info("node started", node_id=node.node_key.node_id,
+                    chain=node.genesis.chain_id)
+        await stop_ev.wait()
+        logger.info("shutting down")
+        await node.stop()
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    """reference gen_validator.go: print a fresh priv validator key."""
+    from tendermint_tpu.crypto.keys import gen_priv_key
+
+    key = gen_priv_key()
+    print(json.dumps({
+        "address": key.pub_key().address().hex().upper(),
+        "pub_key": {"type": "tendermint/PubKeyEd25519",
+                    "value": key.pub_key().bytes_().hex()},
+        "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                     "value": key.bytes_().hex()},
+    }, indent=2))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from tendermint_tpu.node.node_key import load_or_gen_node_key
+
+    home = _home(args)
+    path = os.path.join(home, "config", "node_key.json")
+    if os.path.exists(path):
+        print(f"node key already exists at {path}", file=sys.stderr)
+        return 1
+    nk = load_or_gen_node_key(path)
+    print(nk.node_id)
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node.node_key import NodeKey
+
+    cfg = load_config(_home(args))
+    nk = NodeKey.load(cfg.node_key_file)
+    print(nk.node_id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    cfg = load_config(_home(args))
+    pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": "tendermint/PubKeyEd25519",
+                      "value": pub.bytes_().hex()}))
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """reference reset_priv_validator.go ResetAll: wipe data, keep keys,
+    reset the privval sign-state."""
+    from tendermint_tpu.config import load_config
+
+    cfg = load_config(_home(args))
+    if os.path.isdir(cfg.db_dir):
+        shutil.rmtree(cfg.db_dir)
+        print(f"removed {cfg.db_dir}")
+    os.makedirs(cfg.db_dir, exist_ok=True)
+    if os.path.exists(cfg.priv_validator_key_file):
+        # fresh zeroed sign-state (the old one went with the data dir)
+        from tendermint_tpu.privval.file_pv import _LastSignState
+
+        _LastSignState(cfg.priv_validator_state_file).save()
+        print("reset priv validator state")
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """reference testnet.go: generate N validator homes with a shared
+    genesis and fully-wired persistent peers (localhost port layout)."""
+    from tendermint_tpu.config import default_config, write_config
+    from tendermint_tpu.node.node_key import load_or_gen_node_key
+    from tendermint_tpu.privval.file_pv import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    n = args.v
+    out = args.o
+    chain_id = args.chain_id or f"chain-{os.urandom(3).hex()}"
+    homes, pvs, nks = [], [], []
+    for i in range(n):
+        home = os.path.join(out, f"{args.node_dir_prefix}{i}")
+        cfg = default_config(home)
+        cfg.ensure_dirs()
+        pvs.append(load_or_gen_file_pv(cfg.priv_validator_key_file,
+                                       cfg.priv_validator_state_file))
+        nks.append(load_or_gen_node_key(cfg.node_key_file))
+        homes.append(home)
+
+    gen = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=time.time_ns(),
+        validators=[GenesisValidator(pub_key=pv.get_pub_key(), power=1)
+                    for pv in pvs],
+    )
+    peers = ",".join(
+        f"{nks[i].node_id}@{args.hostname}:{args.starting_port + 2 * i}"
+        for i in range(n)
+    )
+    for i, home in enumerate(homes):
+        cfg = default_config(home)
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://0.0.0.0:{args.starting_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
+        cfg.p2p.persistent_peers = ",".join(
+            p for j, p in enumerate(peers.split(",")) if j != i
+        )
+        write_config(cfg)
+        with open(cfg.genesis_file, "w") as fh:
+            fh.write(gen.to_json())
+    print(f"wrote {n} node homes under {out} (chain {chain_id})")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(VERSION)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tendermint-tpu",
+        description="TPU-native BFT state-machine-replication node",
+    )
+    p.add_argument("--home", default=os.environ.get("TMHOME", "~/.tendermint_tpu"),
+                   help="node home directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize home dir (config, genesis, keys)")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    _add_node_flags(sp)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("testnet", help="generate a localhost testnet")
+    sp.add_argument("--v", type=int, default=4, help="number of validators")
+    sp.add_argument("--o", default="./mytestnet", help="output directory")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--node-dir-prefix", default="node")
+    sp.add_argument("--hostname", default="127.0.0.1")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    for name, fn in (
+        ("gen-validator", cmd_gen_validator),
+        ("gen-node-key", cmd_gen_node_key),
+        ("show-node-id", cmd_show_node_id),
+        ("show-validator", cmd_show_validator),
+        ("unsafe-reset-all", cmd_unsafe_reset_all),
+        ("version", cmd_version),
+    ):
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

@@ -11,10 +11,12 @@ Backends:
   * "cpu"  — sequential pure-Python ZIP-215 (reference semantics; baseline)
   * "jax"  — vmapped TPU/XLA verifier (tendermint_tpu.ops.ed25519_jax)
   * "auto" — jax if importable, else cpu
+The initial default comes from env TM_TPU_CRYPTO_BACKEND (auto|jax|cpu).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Protocol, runtime_checkable
 
 from . import ed25519 as _ed
@@ -81,7 +83,9 @@ class JAXBatchVerifier(_BaseBatch):
         return bool(all(oks)), [bool(v) for v in oks]
 
 
-_DEFAULT_BACKEND = "auto"
+_DEFAULT_BACKEND = os.environ.get("TM_TPU_CRYPTO_BACKEND", "auto")
+if _DEFAULT_BACKEND not in ("auto", "jax", "cpu"):
+    _DEFAULT_BACKEND = "auto"
 
 
 def set_default_backend(name: str) -> None:

@@ -1,0 +1,141 @@
+"""CLI: init/testnet/show-node-id/show-validator/unsafe-reset-all in
+process; `start` as a real subprocess producing blocks served over RPC.
+
+Scenario parity: reference cmd/tendermint/commands/*_test.go +
+test/app/test.sh (spawn node, curl assertions).
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.cli.main import main
+
+
+def run_cli(*argv) -> int:
+    return main(list(argv))
+
+
+def test_init_creates_home(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    assert run_cli("--home", home, "init", "--chain-id", "cli-chain") == 0
+    for rel in ("config/config.toml", "config/genesis.json",
+                "config/node_key.json", "config/priv_validator_key.json",
+                "data/priv_validator_state.json"):
+        assert os.path.exists(os.path.join(home, rel)), rel
+    gen = json.load(open(os.path.join(home, "config/genesis.json")))
+    assert gen["chain_id"] == "cli-chain"
+    assert len(gen["validators"]) == 1
+
+    # idempotent: second init keeps existing files
+    mtime = os.path.getmtime(os.path.join(home, "config/genesis.json"))
+    assert run_cli("--home", home, "init") == 0
+    assert os.path.getmtime(os.path.join(home, "config/genesis.json")) == mtime
+
+
+def test_show_commands_and_reset(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    run_cli("--home", home, "init")
+    capsys.readouterr()
+
+    assert run_cli("--home", home, "show-node-id") == 0
+    node_id = capsys.readouterr().out.strip()
+    assert len(node_id) == 40 and bytes.fromhex(node_id)
+
+    assert run_cli("--home", home, "show-validator") == 0
+    pub = json.loads(capsys.readouterr().out)
+    assert pub["type"] == "tendermint/PubKeyEd25519"
+
+    assert run_cli("--home", home, "version") == 0
+    assert run_cli("--home", home, "gen-validator") == 0
+    capsys.readouterr()
+
+    # reset wipes data but keeps keys
+    dbfile = os.path.join(home, "data", "junk.db")
+    open(dbfile, "w").write("x")
+    assert run_cli("--home", home, "unsafe-reset-all") == 0
+    assert not os.path.exists(dbfile)
+    assert os.path.exists(os.path.join(home, "config/priv_validator_key.json"))
+    assert os.path.exists(os.path.join(home, "data/priv_validator_state.json"))
+
+
+def test_testnet_generation(tmp_path):
+    out = str(tmp_path / "net")
+    assert run_cli("testnet", "--v", "3", "--o", out, "--chain-id", "net-x") == 0
+    import tomllib
+
+    genesis_docs = []
+    for i in range(3):
+        home = os.path.join(out, f"node{i}")
+        cfg = tomllib.load(open(os.path.join(home, "config/config.toml"), "rb"))
+        # each node lists the other two as persistent peers
+        peers = cfg["p2p"]["persistent_peers"].split(",")
+        assert len(peers) == 2
+        assert all("@127.0.0.1:" in p for p in peers)
+        genesis_docs.append(open(os.path.join(home, "config/genesis.json")).read())
+    # one shared genesis with all three validators
+    assert genesis_docs[0] == genesis_docs[1] == genesis_docs[2]
+    assert len(json.loads(genesis_docs[0])["validators"]) == 3
+
+
+@pytest.mark.slow
+def test_start_subprocess_serves_rpc(tmp_path):
+    """`tendermint-tpu start` in a real subprocess: blocks are produced
+    and served over the RPC port; SIGTERM shuts down cleanly."""
+    home = str(tmp_path / "home")
+    run_cli("--home", home, "init", "--chain-id", "subproc-chain")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TM_TPU_CRYPTO_BACKEND="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "start",
+         "--rpc.laddr", "tcp://127.0.0.1:0", "--p2p.laddr", "tcp://127.0.0.1:0",
+         "--log-level", "info"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # scrape the ephemeral RPC port from the startup log
+        port, deadline = None, time.time() + 120
+        lines = []
+        while time.time() < deadline and port is None:
+            line = proc.stdout.readline()
+            if not line:
+                time.sleep(0.1)
+                continue
+            lines.append(line)
+            if "RPC server listening" in line:
+                port = int(line.rsplit(":", 1)[-1].strip())
+        assert port, "no RPC listen line in output:\n" + "".join(lines)
+
+        def status():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=5
+            ) as r:
+                return json.loads(r.read())["result"]
+
+        deadline = time.time() + 120
+        height = 0
+        while time.time() < deadline:
+            try:
+                height = int(status()["sync_info"]["latest_block_height"])
+                if height >= 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert height >= 2, "chain did not advance in subprocess"
+        assert status()["node_info"]["network"] == "subproc-chain"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode == 0, f"non-clean exit {proc.returncode}"
