@@ -1,0 +1,146 @@
+"""Node metrics assembly (reference node/node.go:112-126
+MetricsProvider + per-subsystem Metrics structs).
+
+Point-in-time values (height, peers, mempool size, validator power) are
+callback gauges read at scrape; flow values (block interval, tx counts,
+block sizes, processing time) are fed by an EventBus NewBlock
+subscription so the consensus hot path carries no metrics code.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.pubsub import SubscriptionCancelledError
+from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsServer,
+    Registry,
+)
+
+
+class StateMetrics:
+    """reference state/metrics.go"""
+
+    def __init__(self, reg: Registry, ns: str):
+        self.block_processing_time = reg.register(Histogram(
+            "block_processing_time",
+            "Time spent executing a block against the app (s)",
+            namespace=ns, subsystem="state",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+        ))
+
+
+class NodeMetrics:
+    def __init__(self, node, namespace: str = "tendermint"):
+        self.node = node
+        self.registry = Registry()
+        reg, ns = self.registry, namespace
+
+        # -- consensus (reference consensus/metrics.go:77-186) ----------
+        self.height = reg.register(Gauge(
+            "height", "Height of the chain", namespace=ns, subsystem="consensus",
+            fn=lambda: node.block_store.height(),
+        ))
+        self.rounds = reg.register(Gauge(
+            "rounds", "Round of the current height", namespace=ns,
+            subsystem="consensus", fn=lambda: node.consensus.rs.round,
+        ))
+        self.validators = reg.register(Gauge(
+            "validators", "Number of validators", namespace=ns,
+            subsystem="consensus",
+            fn=lambda: len(node.consensus.rs.validators.validators)
+            if node.consensus.rs.validators else 0,
+        ))
+        self.validators_power = reg.register(Gauge(
+            "validators_power", "Total voting power", namespace=ns,
+            subsystem="consensus",
+            fn=lambda: node.consensus.rs.validators.total_voting_power()
+            if node.consensus.rs.validators else 0,
+        ))
+        self.fast_syncing = reg.register(Gauge(
+            "fast_syncing", "Whether the node is fast-syncing", namespace=ns,
+            subsystem="consensus",
+            fn=lambda: 0 if node._consensus_running else 1,
+        ))
+        self.num_txs = reg.register(Gauge(
+            "num_txs", "Txs in the latest block", namespace=ns,
+            subsystem="consensus",
+        ))
+        self.block_size_bytes = reg.register(Gauge(
+            "block_size_bytes", "Size of the latest block", namespace=ns,
+            subsystem="consensus",
+        ))
+        self.total_txs = reg.register(Counter(
+            "total_txs", "Total committed txs since start", namespace=ns,
+            subsystem="consensus",
+        ))
+        self.block_interval_seconds = reg.register(Histogram(
+            "block_interval_seconds", "Time between this and the last block",
+            namespace=ns, subsystem="consensus",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0),
+        ))
+
+        # -- mempool (reference mempool/metrics.go) ---------------------
+        self.mempool_size = reg.register(Gauge(
+            "size", "Unconfirmed txs in the mempool", namespace=ns,
+            subsystem="mempool", fn=lambda: node.mempool.size(),
+        ))
+
+        # -- p2p (reference p2p/metrics.go) -----------------------------
+        self.peers = reg.register(Gauge(
+            "peers", "Connected peers", namespace=ns, subsystem="p2p",
+            fn=lambda: len(node.router.peers),
+        ))
+
+        # -- state ------------------------------------------------------
+        self.state = StateMetrics(reg, ns)
+
+        self._server = MetricsServer(self.registry)
+        self._pump_task: asyncio.Task | None = None
+        self._last_block_time_ns: int | None = None
+        self.addr: tuple[str, int] | None = None
+
+    # -- lifecycle -------------------------------------------------------
+    async def start(self, host: str, port: int) -> tuple[str, int]:
+        self.addr = await self._server.start(host, port)
+        sub = self.node.event_bus.subscribe(
+            "metrics", tmevents.query_for_event(tmevents.EventNewBlock),
+            capacity=64,
+        )
+
+        async def pump():
+            try:
+                while True:
+                    msg = await sub.next()
+                    block = msg.data.block
+                    self.num_txs.set(len(block.data.txs))
+                    self.total_txs.inc(len(block.data.txs))
+                    self.block_size_bytes.set(len(block.encode()))
+                    if self._last_block_time_ns is not None:
+                        dt = (block.header.time_ns - self._last_block_time_ns) / 1e9
+                        if dt >= 0:
+                            self.block_interval_seconds.observe(dt)
+                    self._last_block_time_ns = block.header.time_ns
+            except (SubscriptionCancelledError, asyncio.CancelledError):
+                return
+
+        self._pump_task = asyncio.get_running_loop().create_task(pump())
+        return self.addr
+
+    async def stop(self) -> None:
+        await self._server.stop()
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._pump_task = None
+        try:
+            self.node.event_bus.unsubscribe_all("metrics")
+        except KeyError:
+            pass

@@ -185,12 +185,20 @@ class Node:
         self.evidence_pool = EvidencePool(
             self.evidence_db, self.state_store, self.block_store, logger=self.logger
         )
+        # -- metrics (reference node/node.go:112-126,925-928) -----------
+        self.metrics = None
+        if config.instrumentation.prometheus:
+            from tendermint_tpu.node.metrics import NodeMetrics
+
+            self.metrics = NodeMetrics(self, namespace=config.instrumentation.namespace)
+
         self.executor = BlockExecutor(
             self.state_store,
             self.app_conns.consensus(),
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
+            metrics=self.metrics.state if self.metrics else None,
         )
 
         # -- consensus --------------------------------------------------
@@ -276,6 +284,11 @@ class Node:
         if self.config.rpc.laddr:
             host, port = _parse_laddr(self.config.rpc.laddr)
             self.rpc_addr = await self.rpc_server.start(host, port)
+        if self.metrics is not None:
+            host, port = _parse_laddr(self.config.instrumentation.prometheus_listen_addr,
+                                      default_port=26660)
+            addr = await self.metrics.start(host, port)
+            self.logger.info("prometheus metrics listening", addr=f"{addr[0]}:{addr[1]}")
         if isinstance(self.transport, TCPTransport):
             # advertise the channels the reactors registered (compat check)
             self.transport.channels = bytes(self.router.channels.keys())
@@ -405,6 +418,8 @@ class Node:
         await self.statesync_reactor.stop()
         await self.router.stop()
         await self.rpc_server.stop()
+        if self.metrics is not None:
+            await self.metrics.stop()
         await self.indexer_service.stop()
         self.event_bus.shutdown()
         self.wal.close()

@@ -8,6 +8,7 @@ Commit :210 under mempool lock → fireEvents :474), retain-height pruning.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 from tendermint_tpu import abci
@@ -72,6 +73,7 @@ class BlockExecutor:
         evidence_pool=None,
         event_bus=None,
         logger: Logger | None = None,
+        metrics=None,
     ):
         self.store = state_store
         self.app = app_conn
@@ -79,6 +81,9 @@ class BlockExecutor:
         self.evpool = evidence_pool if evidence_pool is not None else _NullEvidencePool()
         self.event_bus = event_bus
         self.logger = logger or nop_logger()
+        # optional state-subsystem metrics (reference state/metrics.go
+        # block_processing_time, observed at state/execution.go:140-144)
+        self.metrics = metrics
 
     # -- proposal -------------------------------------------------------
     def create_proposal_block(
@@ -129,7 +134,10 @@ class BlockExecutor:
         if not pre_validated:
             self.validate_block(state, block, commit_sigs_verified)
 
+        _t0 = time.perf_counter()
         abci_responses = self._exec_block_on_app(state, block)
+        if self.metrics is not None:
+            self.metrics.block_processing_time.observe(time.perf_counter() - _t0)
         self.store.save_abci_responses(block.header.height, abci_responses)
 
         # validate validator updates per consensus params

@@ -1,0 +1,199 @@
+"""Minimal Prometheus instrumentation: Counter/Gauge/Histogram with
+labels, a Registry, and text exposition over HTTP.
+
+Parity: reference uses prometheus/client_golang behind per-subsystem
+Metrics structs (consensus/metrics.go:77-186, p2p/metrics.go,
+mempool/metrics.go, state/metrics.go) served at
+InstrumentationConfig.PrometheusListenAddr (node/node.go:925-928).
+The image ships no Python prometheus client, so the text format
+(exposition 0.0.4) is rendered by hand.
+
+Gauges may be backed by a callback evaluated at scrape time, which keeps
+hot paths untouched for point-in-time values (height, mempool size,
+peer count).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable
+
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{str(v).replace(chr(92), chr(92)*2).replace(chr(34), chr(92)+chr(34))}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "", namespace: str = "",
+                 subsystem: str = ""):
+        parts = [p for p in (namespace, subsystem, name) if p]
+        self.name = "_".join(parts)
+        self.help = help_
+
+    def samples(self) -> list[tuple[str, dict, float]]:
+        raise NotImplementedError
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        for suffix, labels, value in self.samples():
+            lines.append(f"{self.name}{suffix}{_fmt_labels(labels)} {_fmt_value(value)}")
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, *args, label_names: tuple[str, ...] = (), **kw):
+        super().__init__(*args, **kw)
+        self.label_names = label_names
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def samples(self):
+        if not self._values:
+            return [("", {}, 0.0)] if not self.label_names else []
+        return [("", dict(zip(self.label_names, k)), v)
+                for k, v in sorted(self._values.items())]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, *args, fn: Callable[[], float] | None = None,
+                 label_names: tuple[str, ...] = (), **kw):
+        super().__init__(*args, **kw)
+        self.label_names = label_names
+        self._fn = fn
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        self._values[key] = float(value)
+
+    def add(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def samples(self):
+        if self._fn is not None:
+            try:
+                return [("", {}, float(self._fn()))]
+            except Exception:
+                return [("", {}, 0.0)]
+        if not self._values:
+            return [("", {}, 0.0)] if not self.label_names else []
+        return [("", dict(zip(self.label_names, k)), v)
+                for k, v in sorted(self._values.items())]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, *args, buckets: tuple[float, ...] = _DEFAULT_BUCKETS, **kw):
+        super().__init__(*args, **kw)
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, value: float) -> None:
+        self._sum += value
+        self._n += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self._counts[i] += 1
+                return
+        self._counts[-1] += 1
+
+    def samples(self):
+        out, cum = [], 0
+        for b, c in zip(self.buckets, self._counts):
+            cum += c
+            out.append(("_bucket", {"le": _fmt_value(float(b))}, float(cum)))
+        cum += self._counts[-1]
+        out.append(("_bucket", {"le": "+Inf"}, float(cum)))
+        out.append(("_sum", {}, self._sum))
+        out.append(("_count", {}, float(self._n)))
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list[_Metric] = []
+
+    def register(self, metric: _Metric) -> _Metric:
+        self._metrics.append(metric)
+        return metric
+
+    def expose(self) -> str:
+        return "\n".join(m.expose() for m in self._metrics) + "\n"
+
+
+class MetricsServer:
+    """GET /metrics on the instrumentation address."""
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self, host: str, port: int) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        return self._server.sockets[0].getsockname()[:2]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), 5.0)
+            while True:
+                h = await asyncio.wait_for(reader.readline(), 5.0)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            body = self.registry.expose().encode()
+            target = line.split()[1] if len(line.split()) > 1 else b"/"
+            if target.startswith(b"/metrics"):
+                head = (b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; "
+                        b"version=0.0.4\r\n")
+            else:
+                body = b"see /metrics\n"
+                head = b"HTTP/1.1 404 Not Found\r\nContent-Type: text/plain\r\n"
+            writer.write(head + b"Content-Length: %d\r\nConnection: close\r\n\r\n"
+                         % len(body) + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError, IndexError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+
+def timer() -> float:
+    return time.perf_counter()

@@ -1,0 +1,116 @@
+"""Metrics: exposition format units + a live node serving Prometheus
+text with consensus/mempool/p2p/state series.
+
+Scenario parity: reference consensus/metrics.go + node Prometheus server
+(node/node.go:925-928).
+"""
+
+import asyncio
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+from tendermint_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def test_exposition_format():
+    reg = Registry()
+    c = reg.register(Counter("txs_total", "Total txs", namespace="tm",
+                             subsystem="consensus"))
+    g = reg.register(Gauge("height", "Chain height", namespace="tm",
+                           subsystem="consensus"))
+    gl = reg.register(Gauge("bytes", "Bytes by channel", namespace="tm",
+                            subsystem="p2p", label_names=("chan",)))
+    h = reg.register(Histogram("lat", "Latency", namespace="tm",
+                               buckets=(0.1, 1.0)))
+    c.inc(3)
+    g.set(42)
+    gl.add(10, chan="0x20")
+    gl.add(5, chan="0x30")
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    assert "# TYPE tm_consensus_txs_total counter" in text
+    assert "tm_consensus_txs_total 3" in text
+    assert "tm_consensus_height 42" in text
+    assert 'tm_p2p_bytes{chan="0x20"} 10' in text
+    assert 'tm_p2p_bytes{chan="0x30"} 5' in text
+    assert 'tm_lat_bucket{le="0.1"} 1' in text
+    assert 'tm_lat_bucket{le="1"} 2' in text
+    assert 'tm_lat_bucket{le="+Inf"} 3' in text
+    assert "tm_lat_count 3" in text
+    # callback gauge evaluated at scrape time
+    src = {"v": 7}
+    reg2 = Registry()
+    reg2.register(Gauge("live", "cb", fn=lambda: src["v"]))
+    assert "live 7" in reg2.expose()
+    src["v"] = 9
+    assert "live 9" in reg2.expose()
+
+
+def test_node_serves_prometheus(tmp_path):
+    async def run():
+        key = priv_key_from_seed(b"\x55" * 32)
+        gen = GenesisDoc(
+            chain_id="metrics-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.base.fast_sync = False
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "tcp://127.0.0.1:0"
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = key
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        try:
+            node.mempool.check_tx(b"metric=1")
+            await node.wait_for_height(3, timeout=30)
+            host, port = node.metrics.addr
+
+            def scrape():
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=5
+                ) as r:
+                    assert "text/plain" in r.headers["Content-Type"]
+                    return r.read().decode()
+
+            text = await asyncio.to_thread(scrape)
+            lines = dict(
+                l.rsplit(" ", 1) for l in text.splitlines()
+                if l and not l.startswith("#")
+            )
+            assert float(lines["tendermint_consensus_height"]) >= 3
+            assert float(lines["tendermint_consensus_validators"]) == 1
+            assert float(lines["tendermint_consensus_validators_power"]) == 10
+            assert float(lines["tendermint_consensus_total_txs"]) >= 1
+            assert float(lines["tendermint_consensus_fast_syncing"]) == 0
+            assert float(lines["tendermint_p2p_peers"]) == 0
+            assert float(lines["tendermint_state_block_processing_time_count"]) >= 3
+            assert float(lines["tendermint_consensus_block_interval_seconds_count"]) >= 1
+            # non-metrics path 404s
+            def miss():
+                try:
+                    urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=5)
+                    return 200
+                except urllib.error.HTTPError as e:
+                    return e.code
+            assert await asyncio.to_thread(miss) == 404
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
