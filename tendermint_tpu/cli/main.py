@@ -256,6 +256,35 @@ def cmd_testnet(args) -> int:
     return 0
 
 
+def cmd_signer(args) -> int:
+    """Run a remote signer: serve this home's priv validator key to a
+    node listening on --addr (reference privval/signer_server.go; the
+    signer dials the node)."""
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.privval.file_pv import load_or_gen_file_pv
+    from tendermint_tpu.privval.socket_pv import SignerServer
+    from tendermint_tpu.utils.log import new_logger
+
+    cfg = load_config(_home(args))
+    pv = load_or_gen_file_pv(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+    host, _, port = args.addr.rpartition(":")
+    logger = new_logger(level="info")
+
+    async def run():
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop_ev.set)
+        server = SignerServer(pv, host or "127.0.0.1", int(port), logger=logger)
+        await server.start()
+        logger.info("signer serving", validator=pv.get_pub_key().address().hex())
+        await stop_ev.wait()
+        await server.stop()
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -290,6 +319,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hostname", default="127.0.0.1")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("signer", help="run a remote signer dialing a node")
+    sp.add_argument("--addr", required=True, help="node priv_validator_laddr host:port")
+    sp.set_defaults(fn=cmd_signer)
 
     for name, fn in (
         ("gen-validator", cmd_gen_validator),

@@ -159,7 +159,13 @@ class Node:
                 config.priv_validator_key_file, config.priv_validator_state_file
             )
         else:
-            raise NotImplementedError("remote signer wiring lands with privval/socket")
+            # remote signer: the node listens, the signer process dials in
+            # (reference node/node.go:695-710 + privval/signer_client.go)
+            from tendermint_tpu.privval.socket_pv import SignerClient
+
+            host, port = _parse_laddr(config.base.priv_validator_laddr)
+            self.priv_validator = SignerClient(host, port, logger=self.logger)
+            self.priv_validator.start()
 
         # -- p2p ---------------------------------------------------------
         self.node_key = load_or_gen_node_key(config.node_key_file)
@@ -280,6 +286,11 @@ class Node:
         if self._started:
             raise RuntimeError("node already started")
         self._started = True
+        from tendermint_tpu.privval.socket_pv import SignerClient
+
+        if isinstance(self.priv_validator, SignerClient):
+            # block until the remote signer dials in and the pubkey primes
+            await asyncio.to_thread(self.priv_validator.wait_for_signer, 30.0)
         await self.indexer_service.start()
         if self.config.rpc.laddr:
             host, port = _parse_laddr(self.config.rpc.laddr)
@@ -420,6 +431,10 @@ class Node:
         await self.rpc_server.stop()
         if self.metrics is not None:
             await self.metrics.stop()
+        from tendermint_tpu.privval.socket_pv import SignerClient
+
+        if isinstance(self.priv_validator, SignerClient):
+            await asyncio.to_thread(self.priv_validator.close)
         await self.indexer_service.stop()
         self.event_bus.shutdown()
         self.wal.close()

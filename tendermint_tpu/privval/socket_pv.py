@@ -1,0 +1,298 @@
+"""Remote signer over a socket: the node listens on
+priv_validator_laddr; the signer process (holding the key) dials in and
+serves SignVote/SignProposal/GetPubKey.
+
+Parity: reference privval/signer_client.go + signer_server.go +
+signer_listener_endpoint.go (connection direction: signer dials node),
+privval/msgs.go message set {PubKeyRequest/Response,
+SignVoteRequest/SignedVoteResponse, SignProposalRequest/
+SignedProposalResponse, PingRequest/Response} with proto framing
+(proto/tendermint/privval/types.proto).
+
+Wire format: length-delimited proto envelope
+  field 1: PubKeyRequest   {1: chain_id}
+  field 2: PubKeyResponse  {1: pub_key bytes, 2: error string}
+  field 3: SignVoteRequest {1: vote proto, 2: chain_id}
+  field 4: SignedVoteResponse {1: vote proto, 2: error string}
+  field 5: SignProposalRequest {1: proposal proto, 2: chain_id}
+  field 6: SignedProposalResponse {1: proposal proto, 2: error string}
+  field 7: PingRequest     {}
+  field 8: PingResponse    {}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.log import Logger, nop_logger
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .file_pv import DoubleSignError
+
+_MSG_PUBKEY_REQ = 1
+_MSG_PUBKEY_RESP = 2
+_MSG_SIGN_VOTE_REQ = 3
+_MSG_SIGNED_VOTE_RESP = 4
+_MSG_SIGN_PROP_REQ = 5
+_MSG_SIGNED_PROP_RESP = 6
+_MSG_PING_REQ = 7
+_MSG_PING_RESP = 8
+
+_MAX_MSG = 1 << 20
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+def _envelope(field: int, body: bytes) -> bytes:
+    return ProtoWriter().message(field, body, always=True).bytes_out()
+
+
+async def _read_msg(reader) -> tuple[int, dict]:
+    head = await reader.readexactly(4)
+    (n,) = struct.unpack(">I", head)
+    if n == 0 or n > _MAX_MSG:
+        raise ConnectionError(f"bad privval frame length {n}")
+    data = await reader.readexactly(n)
+    env = fields_to_dict(data)
+    for field, vals in env.items():
+        return field, fields_to_dict(vals[0]) if vals[0] else {}
+    raise ConnectionError("empty privval envelope")
+
+
+async def _write_msg(writer, field: int, body: bytes) -> None:
+    payload = _envelope(field, body)
+    writer.write(struct.pack(">I", len(payload)) + payload)
+    await writer.drain()
+
+
+def _get_bytes(d: dict, field: int) -> bytes:
+    v = d.get(field, [b""])[0]
+    return v if isinstance(v, bytes) else b""
+
+
+def _get_str(d: dict, field: int) -> str:
+    v = _get_bytes(d, field)
+    return v.decode("utf-8", "replace")
+
+
+class SignerServer:
+    """Runs NEXT TO THE KEY: wraps a local PrivValidator (FilePV) and
+    serves signing requests to a node (reference privval/signer_server.go).
+    Dials the node's priv_validator_laddr and keeps reconnecting."""
+
+    def __init__(self, pv, host: str, port: int, logger: Logger | None = None):
+        self.pv = pv
+        self.host = host
+        self.port = port
+        self.logger = logger or nop_logger()
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(self.host, self.port)
+                self.logger.info("signer connected", addr=f"{self.host}:{self.port}")
+                await self._serve(reader, writer)
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+                self.logger.debug("signer reconnect", err=str(e))
+                await asyncio.sleep(0.5)
+
+    async def _serve(self, reader, writer) -> None:
+        try:
+            while True:
+                field, body = await _read_msg(reader)
+                if field == _MSG_PING_REQ:
+                    await _write_msg(writer, _MSG_PING_RESP, b"")
+                elif field == _MSG_PUBKEY_REQ:
+                    pub = self.pv.get_pub_key()
+                    await _write_msg(writer, _MSG_PUBKEY_RESP,
+                                     ProtoWriter().bytes_(1, pub.bytes_()).bytes_out())
+                elif field == _MSG_SIGN_VOTE_REQ:
+                    vote = Vote.decode(_get_bytes(body, 1))
+                    chain_id = _get_str(body, 2)
+                    try:
+                        self.pv.sign_vote(chain_id, vote)
+                        resp = ProtoWriter().bytes_(1, vote.encode()).bytes_out()
+                    except (DoubleSignError, Exception) as e:
+                        resp = ProtoWriter().string(2, str(e)).bytes_out()
+                    await _write_msg(writer, _MSG_SIGNED_VOTE_RESP, resp)
+                elif field == _MSG_SIGN_PROP_REQ:
+                    prop = Proposal.decode(_get_bytes(body, 1))
+                    chain_id = _get_str(body, 2)
+                    try:
+                        self.pv.sign_proposal(chain_id, prop)
+                        resp = ProtoWriter().bytes_(1, prop.encode()).bytes_out()
+                    except (DoubleSignError, Exception) as e:
+                        resp = ProtoWriter().string(2, str(e)).bytes_out()
+                    await _write_msg(writer, _MSG_SIGNED_PROP_RESP, resp)
+                else:
+                    raise ConnectionError(f"unknown privval message {field}")
+        finally:
+            writer.close()
+
+
+class SignerClient:
+    """Runs IN THE NODE: a types.PrivValidator whose operations round-trip
+    to the connected signer (reference privval/signer_client.go over
+    signer_listener_endpoint.go — the node LISTENS, the signer DIALS).
+
+    Consensus calls the PrivValidator interface synchronously from inside
+    the node's event loop, so all socket I/O here runs on a dedicated
+    background thread with its own loop; the sync methods bridge via
+    run_coroutine_threadsafe and block only the calling thread (signing
+    sits on the consensus critical path in the reference too).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 5.0, logger: Logger | None = None):
+        import threading
+
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.logger = logger or nop_logger()
+        self.addr: tuple[str, int] | None = None
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="privval-signer-client", daemon=True
+        )
+        self._server: asyncio.AbstractServer | None = None
+        self._conn: tuple | None = None  # (reader, writer)
+        self._conn_ev: asyncio.Event | None = None
+        self._lock: asyncio.Lock | None = None
+        self._cached_pub = None
+
+    # -- lifecycle (called from any thread) ------------------------------
+    def start(self) -> tuple[str, int]:
+        """Start the I/O thread and listen; returns the bound address."""
+        self._thread.start()
+        self.addr = self._submit(self._listen())
+        return self.addr
+
+    def wait_for_signer(self, timeout: float = 30.0) -> None:
+        """Block until a signer dials in and the pubkey is primed."""
+        self._submit(self._wait_connected(timeout), timeout=timeout + 5)
+        self._cached_pub = self._submit(self._get_pub_key())
+
+    def close(self) -> None:
+        if not self._thread.is_alive():
+            return
+        try:
+            self._submit(self._close())
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+
+    def _submit(self, coro, timeout: float | None = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout if timeout is not None else self.timeout_s + 30)
+
+    # -- loop-side internals ---------------------------------------------
+    async def _listen(self) -> tuple[str, int]:
+        self._conn_ev = asyncio.Event()
+        self._lock = asyncio.Lock()
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        return self._server.sockets[0].getsockname()[:2]
+
+    async def _on_conn(self, reader, writer) -> None:
+        if self._conn is not None:
+            self._conn[1].close()
+        self._conn = (reader, writer)
+        self._conn_ev.set()
+        self.logger.info("remote signer connected")
+
+    async def _wait_connected(self, timeout: float) -> None:
+        await asyncio.wait_for(self._conn_ev.wait(), timeout)
+
+    async def _close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        if self._conn is not None:
+            self._conn[1].close()
+            self._conn = None
+
+    async def _call(self, field: int, body: bytes, want: int) -> dict:
+        async with self._lock:
+            if self._conn is None:
+                raise RemoteSignerError("no signer connected")
+            reader, writer = self._conn
+            try:
+                await _write_msg(writer, field, body)
+                got, resp = await asyncio.wait_for(_read_msg(reader), self.timeout_s)
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as e:
+                self._conn = None
+                self._conn_ev.clear()
+                raise RemoteSignerError(f"signer io: {e}") from None
+            if got != want:
+                raise RemoteSignerError(f"unexpected response {got} (want {want})")
+            return resp
+
+    async def _get_pub_key(self):
+        from tendermint_tpu.crypto.keys import PubKey
+
+        resp = await self._call(_MSG_PUBKEY_REQ, b"", _MSG_PUBKEY_RESP)
+        err = _get_str(resp, 2)
+        if err:
+            raise RemoteSignerError(err)
+        return PubKey(_get_bytes(resp, 1))
+
+    async def _sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        body = (ProtoWriter().bytes_(1, vote.encode()).string(2, chain_id)
+                .bytes_out())
+        resp = await self._call(_MSG_SIGN_VOTE_REQ, body, _MSG_SIGNED_VOTE_RESP)
+        err = _get_str(resp, 2)
+        if err:
+            raise RemoteSignerError(err)
+        return Vote.decode(_get_bytes(resp, 1))
+
+    async def _sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        body = (ProtoWriter().bytes_(1, proposal.encode()).string(2, chain_id)
+                .bytes_out())
+        resp = await self._call(_MSG_SIGN_PROP_REQ, body, _MSG_SIGNED_PROP_RESP)
+        err = _get_str(resp, 2)
+        if err:
+            raise RemoteSignerError(err)
+        return Proposal.decode(_get_bytes(resp, 1))
+
+    async def _ping(self) -> None:
+        await self._call(_MSG_PING_REQ, b"", _MSG_PING_RESP)
+
+    # -- sync PrivValidator interface ------------------------------------
+    def get_pub_key(self):
+        if self._cached_pub is None:
+            raise RemoteSignerError("signer not connected (pubkey not primed)")
+        return self._cached_pub
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        signed = self._submit(self._sign_vote(chain_id, vote))
+        vote.signature = signed.signature
+        vote.timestamp_ns = signed.timestamp_ns
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        signed = self._submit(self._sign_proposal(chain_id, proposal))
+        proposal.signature = signed.signature
+        proposal.timestamp_ns = signed.timestamp_ns
+
+    def ping(self) -> None:
+        self._submit(self._ping())

@@ -1,0 +1,166 @@
+"""Remote signer: protocol round-trip, double-sign protection across the
+socket, signer reconnect, and a full node producing blocks with its key
+held only by a remote SignerServer.
+
+Scenario parity: reference privval/signer_client_test.go +
+signer_server_test.go.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.node import Node
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.privval.socket_pv import (
+    RemoteSignerError,
+    SignerClient,
+    SignerServer,
+)
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.vote import Vote
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def _file_pv(tmp_path, seed: bytes) -> FilePV:
+    pv = FilePV(priv_key_from_seed(seed),
+                str(tmp_path / "pv_key.json"), str(tmp_path / "pv_state.json"))
+    pv.save_key()
+    pv.state.save()
+    return pv
+
+
+def _vote(height: int, round_: int = 0) -> Vote:
+    return Vote(
+        type=SignedMsgType.PREVOTE, height=height, round=round_,
+        block_id=BlockID(hash=b"\xaa" * 32,
+                         part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32)),
+        timestamp_ns=1_700_000_000 * 10**9,
+        validator_address=b"\x01" * 20, validator_index=0,
+    )
+
+
+def test_signer_roundtrip_and_double_sign_protection(tmp_path):
+    async def run():
+        pv = _file_pv(tmp_path, b"\x41" * 32)
+        client = SignerClient()
+        host, port = await asyncio.to_thread(client.start)
+        server = SignerServer(pv, host, port)
+        await server.start()
+        try:
+            await asyncio.to_thread(client.wait_for_signer, 10.0)
+            # pubkey crosses the wire
+            assert client.get_pub_key() == pv.get_pub_key()
+
+            # vote signing round-trips and verifies
+            v = _vote(5)
+            await asyncio.to_thread(client.sign_vote, "sock-chain", v)
+            assert pv.get_pub_key().verify_signature(
+                v.sign_bytes("sock-chain"), v.signature
+            )
+
+            # the signer's last-sign-state rejects an HRS regression
+            v2 = _vote(4)
+            with pytest.raises(RemoteSignerError, match="regression"):
+                await asyncio.to_thread(client.sign_vote, "sock-chain", v2)
+
+            # ping keeps the channel healthy after an error response
+            await asyncio.to_thread(client.ping)
+        finally:
+            await server.stop()
+            await asyncio.to_thread(client.close)
+
+    asyncio.run(run())
+
+
+def test_signer_reconnects_after_drop(tmp_path):
+    async def run():
+        pv = _file_pv(tmp_path, b"\x42" * 32)
+        client = SignerClient()
+        host, port = await asyncio.to_thread(client.start)
+        server = SignerServer(pv, host, port)
+        await server.start()
+        try:
+            await asyncio.to_thread(client.wait_for_signer, 10.0)
+            # kill the signer's connection; its dial loop reconnects
+            conn = client._conn
+            client._loop.call_soon_threadsafe(conn[1].close)
+            v = _vote(7)
+            deadline = asyncio.get_running_loop().time() + 10
+            while True:
+                try:
+                    await asyncio.to_thread(client.sign_vote, "sock-chain", v)
+                    break
+                except RemoteSignerError:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+            assert v.signature
+        finally:
+            await server.stop()
+            await asyncio.to_thread(client.close)
+
+    asyncio.run(run())
+
+
+class _SignerThread:
+    """SignerServer on its own thread+loop — the separate-process
+    topology of a real deployment, in-proc for the test.  (On a shared
+    loop the node's synchronous sign call would deadlock against the
+    server serving it.)"""
+
+    def __init__(self, pv, host, port):
+        import threading
+
+        self.loop = asyncio.new_event_loop()
+        self.server = SignerServer(pv, host, port)
+        self.thread = threading.Thread(target=self.loop.run_forever, daemon=True)
+        self.thread.start()
+        asyncio.run_coroutine_threadsafe(self.server.start(), self.loop).result(10)
+
+    def stop(self):
+        asyncio.run_coroutine_threadsafe(self.server.stop(), self.loop).result(10)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+def test_node_with_remote_signer_produces_blocks(tmp_path):
+    async def run():
+        key = priv_key_from_seed(b"\x43" * 32)
+        gen = GenesisDoc(
+            chain_id="remote-pv-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg = make_test_config(str(tmp_path / "node"))
+        cfg.base.fast_sync = False
+        cfg.base.priv_validator_laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg, genesis=gen)
+        # the node holds NO key; the signer (own thread ≈ own process) does
+        host, port = node.priv_validator.addr
+        signer_home = tmp_path / "signer"
+        signer_home.mkdir()
+        pv = FilePV(key, str(signer_home / "k.json"), str(signer_home / "s.json"))
+        pv.save_key()
+        pv.state.save()
+        signer = _SignerThread(pv, host, port)
+        try:
+            await node.start()
+            await node.wait_for_height(3, timeout=60)
+            meta = node.block_store.load_block_meta(2)
+            assert meta.header.proposer_address == key.pub_key().address()
+        finally:
+            await node.stop()
+            signer.stop()
+
+    asyncio.run(run())
