@@ -34,6 +34,7 @@ from tendermint_tpu.types import (
 from tendermint_tpu.types.basic import PartSetHeader, SignedMsgType, now_ns
 from tendermint_tpu.types.part_set import Part, PartSet
 from tendermint_tpu.types.vote_set import ConflictingVoteError, VoteSet
+from tendermint_tpu.utils.fail import fail_point
 from tendermint_tpu.utils.log import Logger, nop_logger
 
 from .config import ConsensusConfig
@@ -179,6 +180,7 @@ class ConsensusState:
                         # own votes/proposals must hit disk before dispatch
                         # (crash ⇒ no double-sign; reference state.go:741-751)
                         self.wal.write_sync(item)
+                        fail_point()  # reference state.go:747 (own msg fsynced)
                         self.handle_msg(item)
                     else:
                         self.wal.write(item)
@@ -683,17 +685,21 @@ class ConsensusState:
         # from here on, failure is a safety violation: +2/3 precommitted
         # this block, so an error storing/applying it must halt the node
         try:
+            fail_point()  # reference state.go:1524 (before save)
             if self.block_store.height() < block.header.height:
                 seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
                 self.block_store.save_block(block, block_parts, seen_commit)
+            fail_point()  # reference state.go:1538 (saved, before WAL barrier)
 
             # crash barrier: replay resumes AFTER this record (reference
             # state.go:1540-1557)
             self.wal.write_sync(EndHeightMessage(height))
+            fail_point()  # reference state.go:1559 (barrier written, before apply)
 
             state_copy, retain_height = self.block_exec.apply_block(
                 self.state.copy(), block_id, block
             )
+            fail_point()  # reference state.go:1577 (applied, before state save/advance)
         except ConsensusFailureError:
             raise
         except Exception as e:

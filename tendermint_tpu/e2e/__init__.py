@@ -1,0 +1,3 @@
+"""End-to-end harness: manifest-driven multi-process testnets, byzantine
+(maverick) consensus variants, load generation, perturbations, and
+invariant checks (reference test/e2e/ + test/maverick/)."""

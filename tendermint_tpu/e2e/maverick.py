@@ -1,0 +1,77 @@
+"""Maverick: a consensus state machine with pluggable per-height
+misbehaviors for byzantine testing.
+
+Parity: reference test/maverick/consensus/misbehavior.go — hooks at
+EnterPrevote/EnterPrecommit etc., selectable per height from the e2e
+manifest (`misbehaviors` map).  Here the hooks are methods on a
+ConsensusState subclass; the misbehavior map is {height: name}.
+
+Misbehaviors:
+  * "double-prevote": emit the honest prevote AND a conflicting prevote
+    for a fabricated block, signed with the raw validator key (bypassing
+    the privval double-sign guard — that guard is the node protecting
+    itself; a real byzantine actor has the key).
+  * "nil-prevote": prevote nil regardless of the proposal.
+  * "nil-precommit": precommit nil regardless of the polka.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.consensus.messages import VoteMessage
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.types import Vote
+from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+
+MISBEHAVIORS = ("double-prevote", "nil-prevote", "nil-precommit")
+
+
+class MaverickConsensusState(ConsensusState):
+    def __init__(self, *args, misbehaviors: dict[int, str] | None = None,
+                 raw_key=None, **kw):
+        super().__init__(*args, **kw)
+        self.misbehaviors = misbehaviors or {}
+        self.raw_key = raw_key
+        # Set by the node/reactor wiring: sends a vote straight to peers,
+        # bypassing our own vote set (which would reject the conflict —
+        # a node never gossips votes it knows to be equivocating; the
+        # reference maverick reactor broadcasts directly too).
+        self.broadcast_vote = None
+        for h, name in self.misbehaviors.items():
+            if name not in MISBEHAVIORS:
+                raise ValueError(f"unknown misbehavior {name!r} at height {h}")
+
+    def _active(self) -> str | None:
+        return self.misbehaviors.get(self.rs.height)
+
+    def sign_add_vote(self, msg_type: SignedMsgType, hash_, header) -> Vote | None:
+        mis = self._active()
+        if mis == "nil-prevote" and msg_type == SignedMsgType.PREVOTE:
+            hash_, header = b"", PartSetHeader(0, b"")
+        if mis == "nil-precommit" and msg_type == SignedMsgType.PRECOMMIT:
+            hash_, header = b"", PartSetHeader(0, b"")
+        vote = super().sign_add_vote(msg_type, hash_, header)
+        if (
+            mis == "double-prevote"
+            and msg_type == SignedMsgType.PREVOTE
+            and vote is not None
+            and self.raw_key is not None
+        ):
+            # conflicting prevote for a fabricated block at the same H/R,
+            # signed directly with the raw key (reference maverick
+            # double-prevote)
+            evil = Vote(
+                type=SignedMsgType.PREVOTE,
+                height=vote.height,
+                round=vote.round,
+                block_id=BlockID(hash=b"\xde" * 32,
+                                 part_set_header=PartSetHeader(1, b"\xad" * 32)),
+                timestamp_ns=vote.timestamp_ns,
+                validator_address=vote.validator_address,
+                validator_index=vote.validator_index,
+            )
+            evil.signature = self.raw_key.sign(evil.sign_bytes(self.state.chain_id))
+            if self.broadcast_vote is not None:
+                self.broadcast_vote(evil)
+            self.logger.info("maverick: double prevote emitted",
+                             height=vote.height, round=vote.round)
+        return vote

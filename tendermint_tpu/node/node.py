@@ -14,6 +14,7 @@ BatchVerifier backend (device when available).
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 
 from tendermint_tpu.abci import AppConns
@@ -209,7 +210,19 @@ class Node:
 
         # -- consensus --------------------------------------------------
         self.wal = WAL(config.wal_file)
-        self.consensus = ConsensusState(
+        cs_cls, cs_kw = ConsensusState, {}
+        mis_env = os.environ.get("TM_TPU_MISBEHAVIORS")
+        if mis_env:
+            # byzantine e2e node (reference test/maverick; selected per
+            # height from the e2e manifest)
+            from tendermint_tpu.e2e.maverick import MaverickConsensusState
+
+            cs_cls = MaverickConsensusState
+            cs_kw = {
+                "misbehaviors": {int(k): v for k, v in json.loads(mis_env).items()},
+                "raw_key": getattr(self.priv_validator, "priv_key", None),
+            }
+        self.consensus = cs_cls(
             config.consensus,
             state,
             self.executor,
@@ -218,11 +231,19 @@ class Node:
             priv_validator=self.priv_validator,
             evidence_pool=self.evidence_pool,
             logger=self.logger,
+            **cs_kw,
         )
         self.consensus.event_bus = self.event_bus
         self.consensus_reactor = ConsensusReactor(
             self.consensus, self.router, self.block_store, logger=self.logger
         )
+        if mis_env:
+            from tendermint_tpu.consensus.messages import VoteMessage
+            from tendermint_tpu.p2p.types import Envelope
+
+            self.consensus.broadcast_vote = lambda v: self.consensus_reactor.vote_ch.try_send(
+                Envelope(message=VoteMessage(v), broadcast=True)
+            )
         self.mempool_reactor = MempoolReactor(self.mempool, self.router, logger=self.logger)
         self.evidence_reactor = EvidenceReactor(
             self.evidence_pool, self.router, logger=self.logger

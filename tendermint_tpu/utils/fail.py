@@ -1,0 +1,44 @@
+"""Crash-point injection (reference libs/fail/fail.go:9-39).
+
+`fail_point()` increments a process-global counter; when env
+TM_TPU_FAIL_INDEX equals the counter value at a call, the process exits
+immediately (os._exit — no cleanup, no WAL flush beyond what already
+happened), simulating a hard crash at that exact point.  The
+crash/recovery matrix test (reference consensus/replay_test.go:1269)
+restarts the node at every index and asserts the chain recovers.
+"""
+
+from __future__ import annotations
+
+import os
+
+_counter = 0
+
+
+def fail_index() -> int | None:
+    v = os.environ.get("TM_TPU_FAIL_INDEX")
+    if v is None or v == "":
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def fail_point() -> None:
+    """Exit the process if the configured fail index is reached
+    (reference fail.Fail, instrumented through the commit sequence at
+    consensus/state.go:1524,1538,1559,1577,1595 and :747)."""
+    global _counter
+    idx = fail_index()
+    if idx is None:
+        return
+    if _counter == idx:
+        os.write(2, f"FAIL_POINT triggered at index {idx}\n".encode())
+        os._exit(13)
+    _counter += 1
+
+
+def reset() -> None:
+    global _counter
+    _counter = 0

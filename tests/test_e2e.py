@@ -1,0 +1,193 @@
+"""E2E: crash-recovery matrix over fail points, a 4-node multi-process
+testnet with load + kill/restart perturbation, and a maverick byzantine
+node whose double-prevote becomes committed evidence.
+
+Scenario parity: reference consensus/replay_test.go:1269 (crash matrix),
+test/e2e/runner (Setup/Start/Load/Perturb/Test), test/maverick.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.cli.main import main as cli_main
+from tendermint_tpu.e2e.runner import Testnet
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    from tendermint_tpu.crypto.batch import set_default_backend
+
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def _wait_rpc_height(port: int, h: int, timeout: float) -> int:
+    deadline = time.time() + timeout
+    last = -1
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=3
+            ) as r:
+                last = int(json.loads(r.read())["result"]["sync_info"]
+                           ["latest_block_height"])
+            if last >= h:
+                return last
+        except Exception:
+            pass
+        time.sleep(0.3)
+    raise TimeoutError(f"port {port} never reached height {h} (last {last})")
+
+
+def _tune_home_for_tests(home: str, rpc_port: int) -> None:
+    from tendermint_tpu.config import load_config, write_config
+    from tendermint_tpu.consensus.config import ConsensusConfig
+
+    cfg = load_config(home)
+    tc = ConsensusConfig.test_config()
+    for f in ("timeout_propose_ms", "timeout_propose_delta_ms",
+              "timeout_prevote_ms", "timeout_prevote_delta_ms",
+              "timeout_precommit_ms", "timeout_precommit_delta_ms",
+              "timeout_commit_ms"):
+        setattr(cfg.consensus, f, getattr(tc, f))
+    cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.base.fast_sync = False
+    write_config(cfg)
+
+
+def test_crash_recovery_matrix(tmp_path):
+    """Crash the node at every commit-path fail point; each restart must
+    recover via WAL replay + handshake and keep committing."""
+    home = str(tmp_path / "crash-home")
+    assert cli_main(["--home", home, "init", "--chain-id", "crash-chain"]) == 0
+    rpc_port = 29890
+    _tune_home_for_tests(home, rpc_port)
+    env_base = dict(os.environ, JAX_PLATFORMS="cpu", TM_TPU_CRYPTO_BACKEND="cpu")
+
+    def start(extra_env):
+        return subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cli", "--home", home, "start"],
+            env=dict(env_base, **extra_env),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    last_height = 0
+    for idx in (0, 2, 5, 9):
+        # run with the fail index armed until the process self-crashes
+        proc = start({"TM_TPU_FAIL_INDEX": str(idx)})
+        rc = proc.wait(timeout=120)
+        assert rc == 13, f"fail index {idx}: expected crash exit 13, got {rc}"
+
+        # recover cleanly and advance at least 2 blocks past the crash
+        proc = start({})
+        try:
+            last_height = _wait_rpc_height(rpc_port, last_height + 2, 120)
+        finally:
+            proc.terminate()
+            try:
+                rc = proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                raise
+        assert rc == 0, f"recovery run after index {idx} exited {rc}"
+
+
+def test_four_node_testnet_with_perturbation(tmp_path):
+    """4 validators in separate processes over real TCP: produce blocks
+    under tx load, kill one node, restart it, verify it catches up and
+    all nodes agree on every block."""
+
+    async def run():
+        net = Testnet(
+            {"chain_id": "e2e-net", "validators": 4, "base_port": 29900},
+            str(tmp_path / "net"),
+        )
+        net.setup()
+        net.start()
+        try:
+            await net.wait_for_height(2, timeout=180)
+            accepted = await net.load(total_txs=10, rate=10)
+            assert accepted >= 1, "no load txs accepted"
+
+            # perturb: kill node 3, let the rest progress, restart
+            victim = net.nodes[3]
+            victim.kill()
+            live = net.nodes[:3]
+            h = max(n.height() for n in live)
+            await net.wait_for_height(h + 2, nodes=live, timeout=180)
+
+            victim.start()
+            target = max(n.height() for n in live) + 2
+            await net.wait_for_height(target, timeout=180)
+
+            upto = min(n.height() for n in net.nodes)
+            net.check_blocks_identical(upto)
+            net.check_app_hashes_agree()
+        finally:
+            rcs = net.stop()
+        # the 3 untouched nodes exit cleanly; the restarted one does too
+        assert all(rc == 0 for rc in rcs), f"exit codes {rcs}"
+
+    asyncio.run(run())
+
+
+def test_maverick_double_prevote_in_proc():
+    """A 4-node net where node 3 runs the maverick state machine with
+    double-prevote at height 2: honest nodes commit the equivocation as
+    DuplicateVoteEvidence (in-proc for speed; same net harness as the
+    multinode suite)."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_multinode import make_net, start_mesh, wait_all_height
+
+    from tendermint_tpu.consensus.wal import NopWAL
+    from tendermint_tpu.e2e.maverick import MaverickConsensusState
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+    async def run():
+        nodes = make_net(4)
+        byz = nodes[3]
+        # swap node 3's consensus for a maverick with double-prevote @ h2
+        cs = byz.cs
+        byz.cs = MaverickConsensusState(
+            cs.config, cs.state, cs.block_exec, cs.block_store,
+            wal=NopWAL(), priv_validator=cs.priv_validator,
+            evidence_pool=cs.evpool,
+            misbehaviors={2: "double-prevote"}, raw_key=byz.key,
+        )
+        byz.reactor.cs = byz.cs
+        # reactor wiring: reuse the original channels on the new cs
+        byz.cs.event_bus = cs.event_bus
+        byz.cs.on_event = byz.reactor._on_cs_event
+        from tendermint_tpu.consensus.messages import VoteMessage
+        from tendermint_tpu.p2p.types import Envelope
+
+        byz.cs.broadcast_vote = lambda v: byz.reactor.vote_ch.try_send(
+            Envelope(message=VoteMessage(v), broadcast=True)
+        )
+        await start_mesh(nodes)
+        try:
+            await wait_all_height(nodes, 6)
+        finally:
+            for n in nodes:
+                await n.stop()
+
+        committed = []
+        for h in range(1, nodes[0].block_store.height() + 1):
+            committed.extend(nodes[0].block_store.load_block(h).evidence)
+        dupes = [e for e in committed if isinstance(e, DuplicateVoteEvidence)]
+        assert dupes, "maverick double prevote never became committed evidence"
+        assert dupes[0].vote_a.validator_address == byz.key.pub_key().address()
+
+    asyncio.run(run())
