@@ -285,6 +285,54 @@ def cmd_signer(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """Run a light-client verifying proxy against a primary node
+    (reference cmd/tendermint/commands/light.go)."""
+    from tendermint_tpu.light.client import Client, TrustOptions
+    from tendermint_tpu.light.http_provider import HTTPProvider
+    from tendermint_tpu.light.proxy import LightProxy
+    from tendermint_tpu.light.store import LightBlockStore
+    from tendermint_tpu.store.db import open_db
+    from tendermint_tpu.utils.log import new_logger
+
+    logger = new_logger(level=args.log_level or "info")
+    home = _home(args)
+    os.makedirs(os.path.join(home, "light"), exist_ok=True)
+    db = open_db("sqlite", os.path.join(home, "light", f"{args.chain_id}.db"))
+
+    primary = HTTPProvider(args.chain_id, args.primary)
+    witnesses = [HTTPProvider(args.chain_id, w)
+                 for w in (args.witnesses or "").split(",") if w]
+    client = Client(
+        chain_id=args.chain_id,
+        trust_options=TrustOptions(
+            period_ns=args.trust_period * 10**9,
+            height=args.trusted_height,
+            hash=bytes.fromhex(args.trusted_hash),
+        ),
+        primary=primary,
+        witnesses=witnesses or [primary],
+        trusted_store=LightBlockStore(db),
+        logger=logger,
+    )
+    proxy = LightProxy(client, args.primary, logger=logger)
+    host, _, port = args.laddr.split("://")[-1].rpartition(":")
+
+    async def run():
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop_ev.set)
+        addr = await proxy.start(host or "127.0.0.1", int(port or 8888))
+        logger.info("light proxy serving", addr=f"{addr[0]}:{addr[1]}",
+                    primary=args.primary)
+        await stop_ev.wait()
+        await proxy.stop()
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -319,6 +367,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hostname", default="127.0.0.1")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("light", help="run a light-client verifying proxy")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True, help="primary node RPC URL")
+    sp.add_argument("--witnesses", default="", help="comma-separated witness RPC URLs")
+    sp.add_argument("--trusted-height", type=int, required=True)
+    sp.add_argument("--trusted-hash", required=True, help="hex header hash")
+    sp.add_argument("--trust-period", type=int, default=168 * 3600, help="seconds")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument("--log-level", dest="log_level", default="info")
+    sp.set_defaults(fn=cmd_light)
 
     sp = sub.add_parser("signer", help="run a remote signer dialing a node")
     sp.add_argument("--addr", required=True, help="node priv_validator_laddr host:port")

@@ -1,0 +1,146 @@
+"""secp256k1 ECDSA keys (reference crypto/secp256k1/secp256k1.go:173 +
+secp256k1_nocgo.go:15-48).
+
+Semantics preserved from the reference:
+  * pubkey wire form: 33-byte compressed SEC1 point;
+  * address: RIPEMD160(SHA256(compressed pubkey)) — 20 bytes
+    (secp256k1.go Address());
+  * signature wire form: 64-byte big-endian r||s (NOT DER);
+  * signing produces canonical LOW-S signatures and verification REJECTS
+    high-S (malleability rule, secp256k1_nocgo.go Sign/VerifyBytes);
+  * message is SHA256-hashed before ECDSA (tendermint signs sign-bytes
+    with SHA256 as the ECDSA digest).
+
+Backed by the `cryptography` package's EC implementation (OpenSSL);
+DER ⇄ raw conversion at this boundary.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives.hashes import SHA256
+
+KEY_TYPE = "secp256k1"
+PUB_KEY_SIZE = 33
+PRIV_KEY_SIZE = 32
+SIGNATURE_SIZE = 64
+
+# curve group order (for the low-S rule)
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_HALF_N = _N // 2
+
+
+def _address(compressed_pub: bytes) -> bytes:
+    sha = hashlib.sha256(compressed_pub).digest()
+    return hashlib.new("ripemd160", sha).digest()
+
+
+class PubKeySecp256k1:
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def bytes_(self) -> bytes:
+        return self._bytes
+
+    @property
+    def data(self) -> bytes:
+        return self._bytes
+
+    def address(self) -> bytes:
+        return _address(self._bytes)
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if r == 0 or s == 0 or r >= _N:
+            return False
+        if s > _HALF_N:  # reject malleable high-S (reference :40-44)
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self._bytes
+            )
+            pub.verify(encode_dss_signature(r, s), msg, ec.ECDSA(SHA256()))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PubKeySecp256k1) and other._bytes == self._bytes
+
+    def __hash__(self) -> int:
+        return hash((KEY_TYPE, self._bytes))
+
+    def __repr__(self) -> str:
+        return f"PubKey(secp256k1:{self._bytes.hex()[:16]}…)"
+
+
+class PrivKeySecp256k1:
+    __slots__ = ("_priv", "_pub")
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIV_KEY_SIZE:
+            raise ValueError(f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes")
+        d = int.from_bytes(data, "big")
+        if not 0 < d < _N:
+            raise ValueError("secp256k1 privkey out of range")
+        self._priv = ec.derive_private_key(d, ec.SECP256K1())
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        self._pub = PubKeySecp256k1(
+            self._priv.public_key().public_bytes(
+                Encoding.X962, PublicFormat.CompressedPoint
+            )
+        )
+
+    def bytes_(self) -> bytes:
+        return self._priv.private_numbers().private_value.to_bytes(32, "big")
+
+    @property
+    def data(self) -> bytes:
+        return self.bytes_()
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._priv.sign(msg, ec.ECDSA(SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _HALF_N:  # canonicalize to low-S (reference Sign :24-30)
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKeySecp256k1:
+        return self._pub
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PrivKeySecp256k1) and other.bytes_() == self.bytes_()
+
+
+def gen_priv_key() -> PrivKeySecp256k1:
+    import secrets
+
+    while True:
+        data = secrets.token_bytes(32)
+        d = int.from_bytes(data, "big")
+        if 0 < d < _N:
+            return PrivKeySecp256k1(data)

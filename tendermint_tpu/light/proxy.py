@@ -1,0 +1,187 @@
+"""Light-client verifying proxy: serves the RPC surface with responses
+checked against light-verified headers.
+
+Parity: reference light/proxy/proxy.go:16 (daemon wrapping an rpc server)
++ light/rpc/client.go (per-route verification): block/commit/validators
+are returned from (or checked against) the light client's verified
+store; broadcast_tx*/abci_query/status forward to the primary, with
+abci_query pinned to a verified height.  Routes the proxy cannot verify
+are not exposed (reference light/rpc exposes the same reduced set).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.parse
+import urllib.request
+
+from tendermint_tpu.rpc import encoding as enc
+from tendermint_tpu.rpc.jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, RPCError
+from tendermint_tpu.rpc.server import RPCServer
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+
+class ProxyEnv:
+    """Stands in for rpc.core.Environment: carries the light client and
+    the primary's RPC address (duck-typed; proxy routes only)."""
+
+    def __init__(self, light_client, primary_url: str, timeout: float = 10.0):
+        self.light_client = light_client
+        self.primary_url = primary_url.rstrip("/")
+        self.timeout = timeout
+        self.config = None
+        self.event_bus = None
+
+    def forward(self, path: str) -> dict:
+        try:
+            with urllib.request.urlopen(self.primary_url + path,
+                                        timeout=self.timeout) as r:
+                doc = json.loads(r.read())
+        except (OSError, json.JSONDecodeError) as e:
+            raise RPCError(INTERNAL_ERROR, f"primary unreachable: {e}") from None
+        if "error" in doc:
+            raise RPCError(doc["error"].get("code", INTERNAL_ERROR),
+                           doc["error"].get("message", ""),
+                           doc["error"].get("data", ""))
+        return doc["result"]
+
+
+# -- verified routes (reference light/rpc/client.go) ------------------------
+
+async def _verified_light_block(env: ProxyEnv, height):
+    lc = env.light_client
+
+    def work():
+        h = int(height) if height else 0
+        if h <= 0:
+            lb = lc.update()
+            if lb is None:
+                h = lc.last_trusted_height()
+            else:
+                return lb
+        return lc.verify_light_block_at_height(h)
+
+    try:
+        return await asyncio.to_thread(work)
+    except Exception as e:
+        raise RPCError(INTERNAL_ERROR, f"light verification failed: {e}") from None
+
+
+async def commit(env: ProxyEnv, height=None) -> dict:
+    lb = await _verified_light_block(env, height)
+    return {
+        "signed_header": {
+            "header": enc.header_json(lb.header),
+            "commit": enc.commit_json(lb.commit),
+        },
+        "canonical": True,
+    }
+
+
+async def validators(env: ProxyEnv, height=None, page=None, per_page=None) -> dict:
+    lb = await _verified_light_block(env, height)
+    vals = lb.validator_set.validators
+    per = min(int(per_page) if per_page else 30, 100)
+    pg = max(int(page) if page else 1, 1)
+    start = (pg - 1) * per
+    return {
+        "block_height": enc.i64(lb.height),
+        "validators": [enc.validator_json(v) for v in vals[start:start + per]],
+        "count": enc.i64(len(vals[start:start + per])),
+        "total": enc.i64(len(vals)),
+    }
+
+
+async def block(env: ProxyEnv, height=None) -> dict:
+    """Fetch the full block from the primary, verify its header hash
+    against the light-verified header at that height."""
+    lb = await _verified_light_block(env, height)
+    res = await asyncio.to_thread(env.forward, f"/block?height={lb.height}")
+    got = (res.get("block_id") or {}).get("hash", "")
+    want = enc.hexu(lb.header.hash())
+    if got != want:
+        raise RPCError(
+            INTERNAL_ERROR,
+            f"primary returned block {got} but light client verified {want} "
+            f"at height {lb.height}",
+        )
+    return res
+
+
+async def status(env: ProxyEnv) -> dict:
+    res = await asyncio.to_thread(env.forward, "/status")
+    # overlay the light client's trusted view (reference light/rpc Status)
+    lc = env.light_client
+    res["sync_info"]["earliest_block_height"] = enc.i64(lc.first_trusted_height())
+    lb = lc.trusted_light_block(lc.last_trusted_height())
+    if lb is not None:
+        res["sync_info"]["latest_block_height"] = enc.i64(lb.height)
+        res["sync_info"]["latest_block_hash"] = enc.hexu(lb.header.hash())
+        res["sync_info"]["latest_app_hash"] = enc.hexu(lb.header.app_hash)
+    return res
+
+
+def health(env: ProxyEnv) -> dict:
+    return {}
+
+
+async def abci_query(env: ProxyEnv, path=None, data=None, height=None, prove=None) -> dict:
+    """Forward, pinned to the latest verified height so the answer is
+    anchored to a header this proxy has checked (reference light/rpc
+    ABCIQueryWithOptions; merkle proof-op verification is app-specific
+    and out of scope for the builtin kvstore)."""
+    lb = await _verified_light_block(env, height)
+    q = f"/abci_query?height={lb.height}"
+    if path:
+        q += f"&path={urllib.parse.quote(str(path))}"
+    if data:
+        q += f"&data={urllib.parse.quote(str(data))}"
+    return await asyncio.to_thread(env.forward, q)
+
+
+async def broadcast_tx_sync(env: ProxyEnv, tx=None) -> dict:
+    if not tx:
+        raise RPCError(INVALID_PARAMS, "tx is required")
+    return await asyncio.to_thread(
+        env.forward, f"/broadcast_tx_sync?tx={urllib.parse.quote(str(tx))}"
+    )
+
+
+async def broadcast_tx_async(env: ProxyEnv, tx=None) -> dict:
+    if not tx:
+        raise RPCError(INVALID_PARAMS, "tx is required")
+    return await asyncio.to_thread(
+        env.forward, f"/broadcast_tx_async?tx={urllib.parse.quote(str(tx))}"
+    )
+
+
+PROXY_ROUTES = {
+    "health": health,
+    "status": status,
+    "block": block,
+    "commit": commit,
+    "validators": validators,
+    "abci_query": abci_query,
+    "broadcast_tx_sync": broadcast_tx_sync,
+    "broadcast_tx_async": broadcast_tx_async,
+}
+
+
+class LightProxy:
+    """The daemon: light client + verifying RPC server
+    (reference light/proxy/proxy.go)."""
+
+    def __init__(self, light_client, primary_url: str,
+                 logger: Logger | None = None):
+        self.logger = logger or nop_logger()
+        self.env = ProxyEnv(light_client, primary_url)
+        self.server = RPCServer(self.env, logger=self.logger, routes=PROXY_ROUTES)
+        self.addr: tuple[str, int] | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self.addr = await self.server.start(host, port)
+        return self.addr
+
+    async def stop(self) -> None:
+        await self.server.stop()

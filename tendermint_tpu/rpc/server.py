@@ -87,8 +87,10 @@ class RPCServer:
     def __init__(self, env: core.Environment, logger: Logger | None = None,
                  max_body_bytes: int = 1_000_000,
                  max_open_connections: int = 900,
-                 cors_allowed_origins: list[str] | None = None):
+                 cors_allowed_origins: list[str] | None = None,
+                 routes: dict | None = None):
         self.env = env
+        self.routes = routes if routes is not None else core.ROUTES
         self.logger = logger or nop_logger()
         self.max_body_bytes = max_body_bytes
         self.max_open_connections = max_open_connections
@@ -247,7 +249,7 @@ class RPCServer:
             await self._handle_jsonrpc_post(writer, body, keep_alive, cors)
         elif method == "GET":
             if path in ("", "/"):
-                routes = "\n".join(sorted(core.ROUTES))
+                routes = "\n".join(sorted(self.routes))
                 await self._write_http_response(
                     writer, "200 OK", f"Available endpoints:\n{routes}\n".encode(),
                     keep_alive, "text/plain", cors,
@@ -297,7 +299,7 @@ class RPCServer:
         return await self._call(req.method, req.params, req_id=req.id)
 
     async def _call(self, name: str, params, req_id) -> dict:
-        fn = core.ROUTES.get(name)
+        fn = self.routes.get(name)
         if fn is None:
             return response_json(req_id, error=RPCError(METHOD_NOT_FOUND, f"unknown method {name}"))
         kwargs = {}

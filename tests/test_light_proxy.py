@@ -1,0 +1,150 @@
+"""Light proxy: an HTTPProvider-backed light client verifying a live
+node, served through the proxy's RPC surface.
+
+Scenario parity: reference light/proxy + light/rpc/client_test.go and
+light/provider/http/http_test.go.
+"""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.light.client import Client, TrustOptions
+from tendermint_tpu.light.http_provider import HTTPProvider
+from tendermint_tpu.light.proxy import LightProxy
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+async def _start_node(tmp_path):
+    key = priv_key_from_seed(b"\x77" * 32)
+    gen = GenesisDoc(
+        chain_id="light-proxy-chain",
+        genesis_time_ns=1_700_000_000 * 10**9,
+        validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+    )
+    cfg = make_test_config(str(tmp_path))
+    cfg.base.fast_sync = False
+    node = Node(cfg, genesis=gen)
+    node.priv_validator.priv_key = key
+    node.consensus.priv_validator = node.priv_validator
+    await node.start()
+    return node
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        doc = json.loads(r.read())
+    if "error" in doc:
+        raise RuntimeError(doc["error"])
+    return doc["result"]
+
+
+def test_light_proxy_end_to_end(tmp_path):
+    async def run():
+        node = await _start_node(tmp_path)
+        host, port = node.rpc_addr
+        base = f"http://{host}:{port}"
+        try:
+            await node.wait_for_height(3, timeout=30)
+
+            # trust root from height 2 (operator would get this out of band)
+            c2 = await asyncio.to_thread(_get, f"{base}/commit?height=2")
+            trusted_hash = c2["signed_header"]["commit"]["block_id"]["hash"]
+
+            def build_client():
+                provider = HTTPProvider("light-proxy-chain", base)
+                return Client(
+                    chain_id="light-proxy-chain",
+                    trust_options=TrustOptions(
+                        period_ns=3600 * 10**9, height=2,
+                        hash=bytes.fromhex(trusted_hash),
+                    ),
+                    primary=provider,
+                    witnesses=[HTTPProvider("light-proxy-chain", base)],
+                )
+
+            lc = await asyncio.to_thread(build_client)
+            proxy = LightProxy(lc, base)
+            phost, pport = await proxy.start("127.0.0.1", 0)
+            pbase = f"http://{phost}:{pport}"
+            try:
+                # verified commit + validators through the proxy
+                cm = await asyncio.to_thread(_get, f"{pbase}/commit?height=3")
+                assert int(cm["signed_header"]["header"]["height"]) == 3
+                vals = await asyncio.to_thread(_get, f"{pbase}/validators?height=3")
+                assert vals["total"] == "1"
+
+                # block checked against the light-verified header
+                blk = await asyncio.to_thread(_get, f"{pbase}/block?height=3")
+                assert blk["block_id"]["hash"] == cm["signed_header"]["commit"][
+                    "block_id"]["hash"]
+
+                # status overlays the trusted view
+                st = await asyncio.to_thread(_get, f"{pbase}/status")
+                assert int(st["sync_info"]["latest_block_height"]) >= 3
+                assert st["sync_info"]["earliest_block_height"] == "2"
+
+                # tx broadcast forwards to the primary and commits
+                import base64 as b64mod
+                from urllib.parse import quote
+
+                tx = b64mod.b64encode(b"light=proxy").decode()
+                res = await asyncio.to_thread(
+                    _get, f"{pbase}/broadcast_tx_sync?tx={quote(tx)}"
+                )
+                assert int(res["code"]) == 0
+                h0 = node.block_store.height()
+                await node.wait_for_height(h0 + 2, timeout=30)
+
+                # abci_query through the proxy reads the committed value
+                q = await asyncio.to_thread(
+                    _get,
+                    f"{pbase}/abci_query?data={quote(b64mod.b64encode(b'light').decode())}",
+                )
+                assert b64mod.b64decode(q["response"]["value"]) == b"proxy"
+
+                # verified range extends as the chain grows
+                lh = int((await asyncio.to_thread(
+                    _get, f"{pbase}/status"))["sync_info"]["latest_block_height"])
+                assert lh >= h0
+            finally:
+                await proxy.stop()
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_http_provider_light_block(tmp_path):
+    """HTTPProvider assembles a valid LightBlock from a live node."""
+
+    async def run():
+        node = await _start_node(tmp_path)
+        host, port = node.rpc_addr
+        try:
+            await node.wait_for_height(2, timeout=30)
+            provider = HTTPProvider("light-proxy-chain", f"http://{host}:{port}")
+            lb = await asyncio.to_thread(provider.light_block, 2)
+            assert lb.height == 2
+            assert lb.validator_set.validators[0].voting_power == 10
+            # header hash binds the validator set
+            assert lb.header.validators_hash == lb.validator_set.hash()
+            latest = await asyncio.to_thread(provider.light_block, 0)
+            assert latest.height >= 2
+        finally:
+            await node.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,66 @@
+"""secp256k1: sign/verify round-trip, 64-byte r||s wire form, low-S
+canonicalization + high-S rejection, RIPEMD160(SHA256) addresses.
+
+Scenario parity: reference crypto/secp256k1/secp256k1_test.go +
+secp256k1_nocgo_test.go (signature malleability cases).
+"""
+
+import hashlib
+
+from tendermint_tpu.crypto.secp256k1 import (
+    _HALF_N,
+    _N,
+    PrivKeySecp256k1,
+    PubKeySecp256k1,
+    gen_priv_key,
+)
+
+
+def test_sign_verify_roundtrip():
+    priv = gen_priv_key()
+    pub = priv.pub_key()
+    msg = b"proto-tx-bytes"
+    sig = priv.sign(msg)
+    assert len(sig) == 64
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(msg + b"x", sig)
+    assert not pub.verify_signature(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    # another key can't verify
+    assert not gen_priv_key().pub_key().verify_signature(msg, sig)
+
+
+def test_deterministic_key_from_bytes():
+    seed = bytes(range(1, 33))
+    a, b = PrivKeySecp256k1(seed), PrivKeySecp256k1(seed)
+    assert a.bytes_() == seed
+    assert a.pub_key() == b.pub_key()
+    # wire pubkey is 33-byte compressed SEC1
+    raw = a.pub_key().bytes_()
+    assert len(raw) == 33 and raw[0] in (2, 3)
+    assert PubKeySecp256k1(raw) == a.pub_key()
+
+
+def test_address_is_ripemd160_of_sha256():
+    priv = PrivKeySecp256k1(bytes(range(2, 34)))
+    pub = priv.pub_key()
+    addr = pub.address()
+    assert len(addr) == 20
+    expect = hashlib.new("ripemd160", hashlib.sha256(pub.bytes_()).digest()).digest()
+    assert addr == expect
+
+
+def test_low_s_enforced():
+    priv = PrivKeySecp256k1(bytes(range(3, 35)))
+    pub = priv.pub_key()
+    msg = b"malleability"
+    sig = priv.sign(msg)
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    # produced signatures are canonical low-S
+    assert s <= _HALF_N
+    # the algebraically-equivalent high-S twin must be REJECTED
+    high = r.to_bytes(32, "big") + (_N - s).to_bytes(32, "big")
+    assert not pub.verify_signature(msg, high)
+    # zero / out-of-range components rejected
+    assert not pub.verify_signature(msg, b"\x00" * 64)
+    assert not pub.verify_signature(msg, b"\xff" * 64)
