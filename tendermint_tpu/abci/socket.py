@@ -1,0 +1,347 @@
+"""ABCI socket transport: server (serves an Application to an engine)
+and client (the engine side, used by the node when abci=socket).
+
+Parity: reference abci/server/socket_server.go:261 +
+abci/client/socket_client.go:613 — varint-delimited proto envelopes
+(abci/wire.py) over TCP or unix sockets, requests answered in order,
+Flush as the pipeline barrier.
+
+The client is synchronous (the node's execution paths call *_sync) and
+thread-safe; `deliver_tx_batch` writes the whole tx stream before
+reading any response — the socket equivalent of the reference's
+DeliverTxAsync pipelining (state/execution.go:276-328).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket as _socket
+import threading
+
+from tendermint_tpu.utils.log import Logger, nop_logger
+from tendermint_tpu.wire.proto import encode_uvarint
+
+from . import types as abci
+from . import wire
+
+
+def parse_abci_laddr(addr: str) -> tuple[str, object]:
+    """tcp://host:port | unix:///path → (family, target)."""
+    if addr.startswith("unix://"):
+        return "unix", addr[len("unix://"):]
+    body = addr.split("://", 1)[-1]
+    host, _, port = body.rpartition(":")
+    return "tcp", (host or "127.0.0.1", int(port))
+
+
+class ABCIServerError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class SocketServer:
+    """Serves one Application to any number of engine connections; one
+    global lock serializes app access across connections, matching the
+    reference socket server (socket_server.go appMtx)."""
+
+    def __init__(self, app: abci.Application, logger: Logger | None = None):
+        self.app = app
+        self.logger = logger or nop_logger()
+        self._lock = threading.Lock()
+        self._server: asyncio.AbstractServer | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        self.addr: tuple[str, int] | str | None = None
+
+    async def start(self, laddr: str) -> None:
+        family, target = parse_abci_laddr(laddr)
+        if family == "unix":
+            self._server = await asyncio.start_unix_server(self._handle, path=target)
+            self.addr = target
+        else:
+            host, port = target
+            self._server = await asyncio.start_server(self._handle, host, port)
+            self.addr = self._server.sockets[0].getsockname()[:2]
+        self.logger.info("ABCI server listening", addr=str(self.addr))
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for t in list(self._conn_tasks):
+            t.cancel()
+        for t in list(self._conn_tasks):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._conn_tasks.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _read_delimited(self, reader) -> bytes | None:
+        # uvarint length prefix, byte at a time (reference protoio reader)
+        shift, n = 0, 0
+        while True:
+            b = await reader.read(1)
+            if not b:
+                return None
+            n |= (b[0] & 0x7F) << shift
+            if not b[0] & 0x80:
+                break
+            shift += 7
+            if shift > 63:
+                raise ABCIServerError("varint overflow")
+        if n > 64 * 1024 * 1024:
+            raise ABCIServerError(f"oversized ABCI frame {n}")
+        return await reader.readexactly(n)
+
+    async def _handle(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+        try:
+            while True:
+                frame = await self._read_delimited(reader)
+                if frame is None:
+                    break
+                kind, req = wire.decode_request(frame)
+                try:
+                    resp_kind, resp = await asyncio.to_thread(
+                        self._dispatch, kind, req
+                    )
+                except Exception as e:  # app exception → Response.Exception
+                    self.logger.error("ABCI app exception", err=str(e))
+                    resp_kind, resp = wire.EXCEPTION, str(e)
+                payload = wire.encode_response(resp_kind, resp)
+                writer.write(encode_uvarint(len(payload)) + payload)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, asyncio.CancelledError):
+            pass
+        except Exception as e:
+            self.logger.error("ABCI connection error", err=str(e))
+        finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def _dispatch(self, kind: int, req) -> tuple[int, object]:
+        app = self.app
+        with self._lock:
+            if kind == wire.ECHO:
+                return kind, req
+            if kind == wire.FLUSH:
+                return kind, None
+            if kind == wire.INFO:
+                return kind, app.info(req)
+            if kind == wire.INIT_CHAIN:
+                return kind, app.init_chain(req)
+            if kind == wire.QUERY:
+                return kind, app.query(req)
+            if kind == wire.BEGIN_BLOCK:
+                return kind, app.begin_block(req)
+            if kind == wire.CHECK_TX:
+                return kind, app.check_tx(req)
+            if kind == wire.DELIVER_TX:
+                return kind, app.deliver_tx(req)
+            if kind == wire.END_BLOCK:
+                return kind, app.end_block(req)
+            if kind == wire.COMMIT:
+                return kind, app.commit()
+            if kind == wire.LIST_SNAPSHOTS:
+                return kind, app.list_snapshots()
+            if kind == wire.OFFER_SNAPSHOT:
+                snapshot, app_hash = req
+                return kind, app.offer_snapshot(snapshot, app_hash)
+            if kind == wire.LOAD_SNAPSHOT_CHUNK:
+                h, f, c = req
+                return kind, app.load_snapshot_chunk(h, f, c)
+            if kind == wire.APPLY_SNAPSHOT_CHUNK:
+                i, c, s = req
+                return kind, app.apply_snapshot_chunk(i, c, s)
+            raise ABCIServerError(f"unknown request kind {kind}")
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class SocketClient:
+    """Blocking, thread-safe ABCI client over one socket connection
+    (one per logical connection, reference proxy/multi_app_conn.go)."""
+
+    def __init__(self, laddr: str, timeout: float = 30.0):
+        self.laddr = laddr
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: _socket.socket | None = None
+        self._rfile = None
+
+    # -- connection ------------------------------------------------------
+    def connect(self, retries: int = 20, delay: float = 0.25) -> None:
+        family, target = parse_abci_laddr(self.laddr)
+        last: Exception | None = None
+        for _ in range(retries):
+            try:
+                if family == "unix":
+                    s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+                    s.settimeout(self.timeout)
+                    s.connect(target)
+                else:
+                    s = _socket.create_connection(target, timeout=self.timeout)
+                self._sock = s
+                self._rfile = s.makefile("rb")
+                return
+            except OSError as e:
+                last = e
+                import time
+
+                time.sleep(delay)
+        raise ConnectionError(f"cannot connect to ABCI app at {self.laddr}: {last}")
+
+    def close(self) -> None:
+        if self._rfile is not None:
+            try:
+                self._rfile.close()
+            except Exception:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except Exception:
+                pass
+            self._sock = None
+
+    # -- framing ---------------------------------------------------------
+    def _write_req(self, kind: int, req) -> None:
+        payload = wire.encode_request(kind, req)
+        self._sock.sendall(encode_uvarint(len(payload)) + payload)
+
+    def _read_resp(self) -> tuple[int, object]:
+        shift, n = 0, 0
+        while True:
+            b = self._rfile.read(1)
+            if not b:
+                raise ConnectionError("ABCI server closed connection")
+            n |= (b[0] & 0x7F) << shift
+            if not b[0] & 0x80:
+                break
+            shift += 7
+            if shift > 63:
+                raise ConnectionError("varint overflow")
+        data = self._rfile.read(n)
+        if len(data) != n:
+            raise ConnectionError("short ABCI frame")
+        kind, resp = wire.decode_response(data)
+        if kind == wire.EXCEPTION:
+            raise ABCIServerError(f"app exception: {resp}")
+        return kind, resp
+
+    def _call(self, kind: int, req):
+        with self._lock:
+            if self._sock is None:
+                self.connect()
+            self._write_req(kind, req)
+            got, resp = self._read_resp()
+            if got != kind:
+                raise ConnectionError(f"ABCI response {got} for request {kind}")
+            return resp
+
+    # -- client interface (mirrors LocalClient) --------------------------
+    def echo(self, msg: str) -> str:
+        return self._call(wire.ECHO, msg)
+
+    def flush_sync(self) -> None:
+        self._call(wire.FLUSH, None)
+
+    def info_sync(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return self._call(wire.INFO, req)
+
+    def query_sync(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        return self._call(wire.QUERY, req)
+
+    def check_tx_sync(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return self._call(wire.CHECK_TX, req)
+
+    def init_chain_sync(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        return self._call(wire.INIT_CHAIN, req)
+
+    def begin_block_sync(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        return self._call(wire.BEGIN_BLOCK, req)
+
+    def deliver_tx_sync(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        return self._call(wire.DELIVER_TX, req)
+
+    def deliver_tx_batch(self, txs: list[bytes]) -> list[abci.ResponseDeliverTx]:
+        """Pipelined: write the whole stream, then read all responses
+        (reference DeliverTxAsync + FlushSync barrier)."""
+        with self._lock:
+            if self._sock is None:
+                self.connect()
+            buf = bytearray()
+            for tx in txs:
+                payload = wire.encode_request(wire.DELIVER_TX,
+                                              abci.RequestDeliverTx(tx=tx))
+                buf += encode_uvarint(len(payload)) + payload
+            self._sock.sendall(bytes(buf))
+            out = []
+            for _ in txs:
+                kind, resp = self._read_resp()
+                if kind != wire.DELIVER_TX:
+                    raise ConnectionError(f"unexpected response {kind} in batch")
+                out.append(resp)
+            return out
+
+    def end_block_sync(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return self._call(wire.END_BLOCK, req)
+
+    def commit_sync(self) -> abci.ResponseCommit:
+        return self._call(wire.COMMIT, None)
+
+    def list_snapshots_sync(self) -> list[abci.Snapshot]:
+        return self._call(wire.LIST_SNAPSHOTS, None)
+
+    def offer_snapshot_sync(self, snapshot, app_hash: bytes):
+        return self._call(wire.OFFER_SNAPSHOT, (snapshot, app_hash))
+
+    def load_snapshot_chunk_sync(self, height: int, format: int, chunk: int) -> bytes:
+        return self._call(wire.LOAD_SNAPSHOT_CHUNK, (height, format, chunk))
+
+    def apply_snapshot_chunk_sync(self, index: int, chunk: bytes, sender: str):
+        return self._call(wire.APPLY_SNAPSHOT_CHUNK, (index, chunk, sender))
+
+
+class SocketAppConns:
+    """Four logical connections to an external app over four sockets
+    (reference proxy/multi_app_conn.go:22-33)."""
+
+    def __init__(self, laddr: str):
+        self._consensus = SocketClient(laddr)
+        self._mempool = SocketClient(laddr)
+        self._query = SocketClient(laddr)
+        self._snapshot = SocketClient(laddr)
+        for c in (self._consensus, self._mempool, self._query, self._snapshot):
+            c.connect()
+
+    def consensus(self) -> SocketClient:
+        return self._consensus
+
+    def mempool(self) -> SocketClient:
+        return self._mempool
+
+    def query(self) -> SocketClient:
+        return self._query
+
+    def snapshot(self) -> SocketClient:
+        return self._snapshot
+
+    def close(self) -> None:
+        for c in (self._consensus, self._mempool, self._query, self._snapshot):
+            c.close()

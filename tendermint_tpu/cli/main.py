@@ -285,6 +285,30 @@ def cmd_signer(args) -> int:
     return 0
 
 
+def cmd_abci_server(args) -> int:
+    """Serve a builtin app over the ABCI socket protocol (reference
+    abci-cli kvstore/counter servers, abci/cmd/abci-cli)."""
+    from tendermint_tpu.abci.socket import SocketServer
+    from tendermint_tpu.node.node import _builtin_app
+    from tendermint_tpu.utils.log import new_logger
+
+    logger = new_logger(level="info")
+    app = _builtin_app(args.app)
+    server = SocketServer(app, logger=logger)
+
+    async def run():
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop_ev.set)
+        await server.start(args.addr)
+        await stop_ev.wait()
+        await server.stop()
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_light(args) -> int:
     """Run a light-client verifying proxy against a primary node
     (reference cmd/tendermint/commands/light.go)."""
@@ -367,6 +391,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hostname", default="127.0.0.1")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("abci-server", help="serve a builtin ABCI app over a socket")
+    sp.add_argument("--app", default="kvstore",
+                    help="kvstore | persistent_kvstore | counter")
+    sp.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    sp.set_defaults(fn=cmd_abci_server)
 
     sp = sub.add_parser("light", help="run a light-client verifying proxy")
     sp.add_argument("chain_id")

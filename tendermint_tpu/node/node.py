@@ -127,15 +127,18 @@ class Node:
         state = load_state_from_db_or_genesis(self.state_store, genesis)
 
         # -- app + handshake -------------------------------------------
-        if app is None:
-            if config.base.abci != "builtin":
-                raise NotImplementedError(
-                    "external ABCI transports arrive with the socket server; "
-                    "pass an app instance or use abci=builtin"
-                )
-            app = _builtin_app(config.base.proxy_app)
-        self.app = app
-        self.app_conns = AppConns(app)
+        if app is None and config.base.abci == "socket":
+            # external app over the ABCI socket protocol (reference
+            # proxy/client.go DefaultClientCreator "socket" branch)
+            from tendermint_tpu.abci.socket import SocketAppConns
+
+            self.app = None
+            self.app_conns = SocketAppConns(config.base.proxy_app)
+        else:
+            if app is None:
+                app = _builtin_app(config.base.proxy_app)
+            self.app = app
+            self.app_conns = AppConns(app)
 
         # -- event bus + indexer ---------------------------------------
         self.event_bus = EventBus()
@@ -459,6 +462,8 @@ class Node:
         await self.indexer_service.stop()
         self.event_bus.shutdown()
         self.wal.close()
+        if hasattr(self.app_conns, "close"):
+            self.app_conns.close()  # external socket app connections
         for db in (self.block_db, self.state_db, self.evidence_db, self.tx_index_db):
             try:
                 db.close()

@@ -172,9 +172,17 @@ class BlockExecutor:
                 byzantine_validators=byz,
             )
         )
-        deliver_txs = [
-            self.app.deliver_tx_sync(abci.RequestDeliverTx(tx=tx)) for tx in block.data.txs
-        ]
+        if hasattr(self.app, "deliver_tx_batch"):
+            # socket transport: pipeline the whole tx stream before reading
+            # responses (reference DeliverTxAsync, execution.go:276-328)
+            deliver_txs = self.app.deliver_tx_batch(
+                [bytes(tx) for tx in block.data.txs]
+            )
+        else:
+            deliver_txs = [
+                self.app.deliver_tx_sync(abci.RequestDeliverTx(tx=tx))
+                for tx in block.data.txs
+            ]
         reb = self.app.end_block_sync(abci.RequestEndBlock(height=block.header.height))
         return ABCIResponses(
             deliver_txs=deliver_txs, end_block=reb, begin_block_events=rbb.events

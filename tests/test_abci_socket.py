@@ -1,0 +1,223 @@
+"""ABCI socket transport: wire codec round-trips, client/server echo +
+app calls against a subprocess server, and a full node running against
+an EXTERNAL kvstore app over the socket protocol.
+
+Scenario parity: reference abci/client/socket_client_test.go,
+abci/server tests, abci/tests/test_cli conformance, and
+test/app/test.sh (node + external kvstore over socket).
+"""
+
+import asyncio
+import base64
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci import wire
+from tendermint_tpu.abci.socket import SocketClient, parse_abci_laddr
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.block import Header
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_all_kinds():
+    key = priv_key_from_seed(b"\x61" * 32)
+    cases = [
+        (wire.ECHO, "hello"),
+        (wire.FLUSH, None),
+        (wire.INFO, abci.RequestInfo(version="0.1", block_version=11, p2p_version=8)),
+        (wire.INIT_CHAIN, abci.RequestInitChain(
+            time_ns=123, chain_id="wire-chain",
+            validators=[abci.ValidatorUpdate(pub_key=key.pub_key(), power=5)],
+            app_state_bytes=b"{}", initial_height=7)),
+        (wire.QUERY, abci.RequestQuery(data=b"k", path="/key", height=3, prove=True)),
+        (wire.BEGIN_BLOCK, abci.RequestBeginBlock(
+            hash=b"\x01" * 32,
+            header=Header(chain_id="wire-chain", height=9,
+                          validators_hash=b"\x02" * 32),
+            last_commit_info=abci.LastCommitInfo(round=2, votes=[
+                abci.VoteInfo(validator=abci.Validator(address=b"\x03" * 20,
+                                                       power=10),
+                              signed_last_block=True)]),
+            byzantine_validators=[abci.Misbehavior(
+                type=1, validator=abci.Validator(address=b"\x04" * 20, power=3),
+                height=5, time_ns=999, total_voting_power=40)])),
+        (wire.CHECK_TX, abci.RequestCheckTx(tx=b"a=b",
+                                            type=abci.CheckTxType.RECHECK)),
+        (wire.DELIVER_TX, abci.RequestDeliverTx(tx=b"x=y")),
+        (wire.END_BLOCK, abci.RequestEndBlock(height=12)),
+        (wire.COMMIT, None),
+        (wire.LIST_SNAPSHOTS, None),
+        (wire.OFFER_SNAPSHOT, (abci.Snapshot(height=10, format=1, chunks=3,
+                                             hash=b"\x05" * 32, metadata=b"m"),
+                               b"\x06" * 32)),
+        (wire.LOAD_SNAPSHOT_CHUNK, (10, 1, 2)),
+        (wire.APPLY_SNAPSHOT_CHUNK, (1, b"chunk-bytes", "peer-1")),
+    ]
+    for kind, req in cases:
+        got_kind, got = wire.decode_request(wire.encode_request(kind, req))
+        assert got_kind == kind
+        if kind == wire.BEGIN_BLOCK:
+            assert got.hash == req.hash
+            assert got.header.height == 9 and got.header.chain_id == "wire-chain"
+            assert got.last_commit_info == req.last_commit_info
+            assert got.byzantine_validators == req.byzantine_validators
+        elif kind in (wire.FLUSH, wire.COMMIT, wire.LIST_SNAPSHOTS):
+            assert got is None
+        else:
+            assert got == req, f"kind {kind}"
+
+    resp_cases = [
+        (wire.ECHO, "hello"),
+        (wire.INFO, abci.ResponseInfo(data="kv", version="1", app_version=2,
+                                      last_block_height=5,
+                                      last_block_app_hash=b"\x07" * 8)),
+        (wire.INIT_CHAIN, abci.ResponseInitChain(
+            validators=[abci.ValidatorUpdate(pub_key=key.pub_key(), power=1)],
+            app_hash=b"\x08" * 8)),
+        (wire.QUERY, abci.ResponseQuery(code=0, log="l", info="i", index=4,
+                                        key=b"k", value=b"v", height=3,
+                                        codespace="cs")),
+        (wire.BEGIN_BLOCK, abci.ResponseBeginBlock(events=[
+            abci.Event(type="t", attributes=[
+                abci.EventAttribute(key=b"a", value=b"b", index=True)])])),
+        (wire.CHECK_TX, abci.ResponseCheckTx(code=1, data=b"d", log="bad",
+                                             gas_wanted=7, gas_used=3)),
+        (wire.DELIVER_TX, abci.ResponseDeliverTx(code=0, data=b"ok", events=[
+            abci.Event(type="app", attributes=[
+                abci.EventAttribute(key=b"key", value=b"val", index=True)])])),
+        (wire.END_BLOCK, abci.ResponseEndBlock(validator_updates=[
+            abci.ValidatorUpdate(pub_key=key.pub_key(), power=0)])),
+        (wire.COMMIT, abci.ResponseCommit(data=b"\x09" * 8, retain_height=2)),
+        (wire.LIST_SNAPSHOTS, [abci.Snapshot(height=1, format=1, chunks=1,
+                                             hash=b"\x0a" * 32)]),
+        (wire.OFFER_SNAPSHOT, abci.ResponseOfferSnapshot(
+            result=abci.ResponseOfferSnapshot.Result.ACCEPT)),
+        (wire.LOAD_SNAPSHOT_CHUNK, b"chunk"),
+        (wire.APPLY_SNAPSHOT_CHUNK, abci.ResponseApplySnapshotChunk(
+            result=abci.ResponseApplySnapshotChunk.Result.RETRY,
+            refetch_chunks=[0, 2], reject_senders=["bad-peer"])),
+        (wire.EXCEPTION, "boom"),
+    ]
+    for kind, resp in resp_cases:
+        got_kind, got = wire.decode_response(wire.encode_response(kind, resp))
+        assert got_kind == kind
+        assert got == resp, f"kind {kind}"
+
+
+def test_parse_abci_laddr():
+    assert parse_abci_laddr("tcp://127.0.0.1:26658") == ("tcp", ("127.0.0.1", 26658))
+    assert parse_abci_laddr("unix:///tmp/abci.sock") == ("unix", "/tmp/abci.sock")
+
+
+# ---------------------------------------------------------------------------
+# client ⇄ subprocess server
+# ---------------------------------------------------------------------------
+
+def _spawn_server(port: int, app: str = "kvstore") -> subprocess.Popen:
+    import os
+
+    return subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli", "abci-server",
+         "--app", app, "--addr", f"tcp://127.0.0.1:{port}"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.mark.slow
+def test_socket_client_against_subprocess_server():
+    port = 29870
+    proc = _spawn_server(port)
+    try:
+        c = SocketClient(f"tcp://127.0.0.1:{port}")
+        c.connect(retries=60, delay=0.5)
+        assert c.echo("ping") == "ping"
+        c.flush_sync()
+        info = c.info_sync(abci.RequestInfo(version="test"))
+        assert info.last_block_height == 0
+
+        c.begin_block_sync(abci.RequestBeginBlock(hash=b"", header=None))
+        rs = c.deliver_tx_batch([b"a=1", b"b=2", b"c=3"])
+        assert [r.code for r in rs] == [0, 0, 0]
+        c.end_block_sync(abci.RequestEndBlock(height=1))
+        commit = c.commit_sync()
+        assert commit.data  # app hash reflects 3 txs
+
+        q = c.query_sync(abci.RequestQuery(data=b"b", path="/key"))
+        assert q.value == b"2"
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_node_with_external_socket_app(tmp_path):
+    """Full consensus against an EXTERNAL kvstore over the ABCI socket:
+    blocks commit, txs execute in the external process, queries answer
+    from it (reference test/app/test.sh)."""
+    port = 29871
+    proc = _spawn_server(port)
+    try:
+        async def run():
+            key = priv_key_from_seed(b"\x62" * 32)
+            gen = GenesisDoc(
+                chain_id="socket-chain",
+                genesis_time_ns=1_700_000_000 * 10**9,
+                validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+            )
+            cfg = make_test_config(str(tmp_path))
+            cfg.base.fast_sync = False
+            cfg.base.abci = "socket"
+            cfg.base.proxy_app = f"tcp://127.0.0.1:{port}"
+            # wait for the server subprocess to listen
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    probe = SocketClient(cfg.base.proxy_app)
+                    probe.connect(retries=1)
+                    probe.close()
+                    break
+                except ConnectionError:
+                    await asyncio.sleep(0.5)
+            node = Node(cfg, genesis=gen)
+            node.priv_validator.priv_key = key
+            node.consensus.priv_validator = node.priv_validator
+            await node.start()
+            try:
+                node.mempool.check_tx(b"ext=app")
+                await node.wait_for_height(3, timeout=60)
+                # the tx executed in the EXTERNAL process
+                res = node.app_conns.query().query_sync(
+                    abci.RequestQuery(data=b"ext", path="/key")
+                )
+                assert res.value == b"app"
+                # app hash in headers comes from the external app
+                meta = node.block_store.load_block_meta(node.block_store.height())
+                assert meta.header.app_hash
+            finally:
+                await node.stop()
+                node.app_conns.close()
+
+        asyncio.run(run())
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
