@@ -45,7 +45,8 @@ def sharded_verify_fn(mesh: Mesh):
     per input shape under it."""
     batch = NamedSharding(mesh, P("batch"))
     batch2 = NamedSharding(mesh, P("batch", None))
-    in_sh = (batch2, batch, batch2, batch, batch2, batch2, batch)
+    # (pub_rows, r_rows, s_rows, k_rows, valid) — packed [N,32] u8 + bool[N]
+    in_sh = (batch2, batch2, batch2, batch2, batch)
     return jax.jit(_dev._verify_core, in_shardings=in_sh, out_shardings=batch)
 
 
