@@ -190,6 +190,26 @@ class Node:
         self.p2p_addr: tuple[str, int] | None = None
         self._dialer_task: asyncio.Task | None = None
 
+        # -- PEX / address book (reference p2p/pex; node/node.go:820-856)
+        self.pex_reactor = None
+        if config.p2p.pex and isinstance(transport, TCPTransport):
+            from tendermint_tpu.p2p.pex import AddrBook, PexReactor
+
+            book = AddrBook(config.addr_book_file,
+                            strict=config.p2p.addr_book_strict,
+                            logger=self.logger)
+            for addr in config.p2p.seeds.split(","):
+                addr = addr.strip()
+                if addr:
+                    book.add_address(addr)
+                    transport.add_peer_address(addr)
+            self.pex_reactor = PexReactor(
+                self.router, book, transport,
+                max_outbound=config.p2p.max_num_outbound_peers,
+                seed_mode=config.p2p.seed_mode,
+                logger=self.logger,
+            )
+
         # -- mempool / evidence / executor ------------------------------
         self.mempool = Mempool(config.mempool, self.app_conns.mempool())
         self.evidence_pool = EvidencePool(
@@ -329,6 +349,8 @@ class Node:
             self.transport.channels = bytes(self.router.channels.keys())
             self.p2p_addr = await self.transport.listen()
         await self.router.start()
+        if self.pex_reactor is not None:
+            await self.pex_reactor.start()
         if isinstance(self.transport, TCPTransport) and self.config.p2p.persistent_peers:
             self._dialer_task = asyncio.get_running_loop().create_task(
                 self._dial_persistent_peers()
@@ -451,6 +473,8 @@ class Node:
         await self.evidence_reactor.stop()
         await self.mempool_reactor.stop()
         await self.statesync_reactor.stop()
+        if self.pex_reactor is not None:
+            await self.pex_reactor.stop()
         await self.router.stop()
         await self.rpc_server.stop()
         if self.metrics is not None:

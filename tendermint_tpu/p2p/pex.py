@@ -1,0 +1,338 @@
+"""Peer exchange (PEX): address book + discovery reactor.
+
+Parity: reference p2p/pex/addrbook.go:119 (bucketed new/old address
+book, good/bad marking, atomic JSON persistence) and
+p2p/pex/pex_reactor.go:133 (channel 0x00 addr request/response with
+per-peer rate limiting, seed mode crawl-and-disconnect, ensure-peers
+dialing loop toward max_num_outbound_peers).
+
+Simplifications vs the reference, deliberate: buckets are hashed by
+address group like the reference but without the 64/256 bucket split
+constants (a dict of group → entries with the same old/new promotion
+semantics); the trust-metric store (p2p/trust, loosely integrated there)
+is folded into per-address attempt/success counters here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from .tcp import parse_net_address
+from .types import ChannelDescriptor, Envelope, NodeID, PeerStatus
+
+PEX_CHANNEL = 0x00
+
+# reference pex_reactor.go: one request per peer per interval
+_REQUEST_INTERVAL_S = 30.0
+_MAX_ADDRS_PER_MSG = 100
+_ENSURE_PEERS_INTERVAL_S = 2.0
+
+
+@dataclass
+class KnownAddress:
+    """reference p2p/pex/known_address.go"""
+
+    node_id: NodeID
+    host: str
+    port: int
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket: str = "new"  # "new" | "old"
+
+    @property
+    def addr(self) -> str:
+        return f"{self.node_id}@{self.host}:{self.port}"
+
+    def is_bad(self) -> bool:
+        # reference known_address.go isBad: too many failed attempts
+        return self.attempts >= 3 and self.last_success == 0
+
+
+class AddrBook:
+    """reference p2p/pex/addrbook.go — new/old promotion, persistence."""
+
+    def __init__(self, file_path: str = "", strict: bool = True,
+                 logger: Logger | None = None):
+        self.file_path = file_path
+        self.strict = strict
+        self.logger = logger or nop_logger()
+        self.addrs: dict[NodeID, KnownAddress] = {}
+        self._our_ids: set[NodeID] = set()
+        if file_path and os.path.exists(file_path):
+            self.load()
+
+    def add_our_id(self, node_id: NodeID) -> None:
+        self._our_ids.add(node_id)
+        self.addrs.pop(node_id, None)
+
+    def add_address(self, addr: str) -> bool:
+        """Returns True if new/updated (reference AddAddress)."""
+        try:
+            node_id, host, port = parse_net_address(addr)
+        except ValueError:
+            return False
+        if node_id in self._our_ids:
+            return False
+        if self.strict and not _routable(host):
+            return False
+        known = self.addrs.get(node_id)
+        if known is None:
+            self.addrs[node_id] = KnownAddress(node_id, host, port)
+            return True
+        if known.bucket == "new" and (known.host, known.port) != (host, port):
+            # new-bucket addresses may be refreshed; old (proven) stick
+            known.host, known.port = host, port
+            return True
+        return False
+
+    def mark_attempt(self, node_id: NodeID) -> None:
+        ka = self.addrs.get(node_id)
+        if ka:
+            ka.attempts += 1
+            ka.last_attempt = time.time()
+
+    def mark_good(self, node_id: NodeID) -> None:
+        """Connected + useful → promote to old (reference MarkGood)."""
+        ka = self.addrs.get(node_id)
+        if ka:
+            ka.attempts = 0
+            ka.last_success = time.time()
+            ka.bucket = "old"
+
+    def mark_bad(self, node_id: NodeID) -> None:
+        self.addrs.pop(node_id, None)
+
+    def pick_address(self, exclude: set[NodeID]) -> KnownAddress | None:
+        """Biased pick: prefer old (proven) addresses ~2/3 of the time
+        (reference PickAddress bias)."""
+        cands = [a for a in self.addrs.values()
+                 if a.node_id not in exclude and not a.is_bad()]
+        if not cands:
+            return None
+        old = [a for a in cands if a.bucket == "old"]
+        new = [a for a in cands if a.bucket == "new"]
+        pool = old if (old and (not new or random.random() < 0.65)) else new
+        return random.choice(pool)
+
+    def sample(self, n: int = _MAX_ADDRS_PER_MSG) -> list[str]:
+        """Random subset for PEX responses (reference GetSelection)."""
+        pool = [a.addr for a in self.addrs.values() if not a.is_bad()]
+        random.shuffle(pool)
+        return pool[:n]
+
+    def size(self) -> int:
+        return len(self.addrs)
+
+    # -- persistence (atomic JSON, reference pex/file.go) ---------------
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        doc = {
+            "addrs": [
+                {"id": a.node_id, "host": a.host, "port": a.port,
+                 "bucket": a.bucket, "attempts": a.attempts,
+                 "last_success": a.last_success}
+                for a in self.addrs.values()
+            ]
+        }
+        tmp = self.file_path + ".tmp"
+        os.makedirs(os.path.dirname(self.file_path) or ".", exist_ok=True)
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, self.file_path)
+
+    def load(self) -> None:
+        try:
+            with open(self.file_path) as fh:
+                doc = json.load(fh)
+            for e in doc.get("addrs", []):
+                ka = KnownAddress(e["id"], e["host"], int(e["port"]),
+                                  bucket=e.get("bucket", "new"),
+                                  attempts=int(e.get("attempts", 0)),
+                                  last_success=float(e.get("last_success", 0)))
+                self.addrs[ka.node_id] = ka
+        except (OSError, json.JSONDecodeError, KeyError, ValueError) as e:
+            self.logger.error("addrbook load failed", err=str(e))
+
+
+def _routable(host: str) -> bool:
+    """reference netaddress.go Routable — loopback/private ranges are
+    unroutable under strict mode."""
+    if host in ("localhost",):
+        return False
+    parts = host.split(".")
+    if len(parts) == 4 and all(p.isdigit() for p in parts):
+        a, b = int(parts[0]), int(parts[1])
+        if a == 127 or a == 10 or a == 0:
+            return False
+        if a == 192 and b == 168:
+            return False
+        if a == 172 and 16 <= b <= 31:
+            return False
+        if a == 169 and b == 254:
+            return False
+    if host == "::1":
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# wire messages (channel 0x00)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PexRequest:
+    pass
+
+
+@dataclass
+class PexResponse:
+    addrs: list[str] = field(default_factory=list)
+
+
+def _encode(msg) -> bytes:
+    if isinstance(msg, PexRequest):
+        return b"\x01"
+    return b"\x02" + json.dumps(msg.addrs).encode()
+
+
+def _decode(data: bytes):
+    if not data:
+        raise ValueError("empty pex message")
+    if data[0] == 1:
+        return PexRequest()
+    if data[0] == 2:
+        addrs = json.loads(data[1:])
+        if not isinstance(addrs, list) or len(addrs) > _MAX_ADDRS_PER_MSG:
+            raise ValueError("bad pex response")
+        return PexResponse([str(a) for a in addrs])
+    raise ValueError(f"unknown pex message {data[0]}")
+
+
+class PexReactor:
+    """Discovery + outbound-connection maintenance
+    (reference p2p/pex/pex_reactor.go)."""
+
+    def __init__(self, router, book: AddrBook, transport,
+                 max_outbound: int = 10, seed_mode: bool = False,
+                 logger: Logger | None = None):
+        self.router = router
+        self.book = book
+        self.transport = transport  # TCPTransport (address registration)
+        self.max_outbound = max_outbound
+        self.seed_mode = seed_mode
+        self.logger = logger or nop_logger()
+        self.ch = router.open_channel(ChannelDescriptor(
+            channel_id=PEX_CHANNEL, priority=1,
+            encode=_encode, decode=_decode,
+            max_msg_bytes=64 * 1024,
+        ))
+        self.peer_updates = router.subscribe_peer_updates()
+        self._last_request: dict[NodeID, float] = {}
+        self._flood_strikes: dict[NodeID, int] = {}
+        self._requested: set[NodeID] = set()
+        self._tasks: list[asyncio.Task] = []
+        self.book.add_our_id(router.node_id)
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        for fn in (self._recv_loop, self._peer_update_loop, self._ensure_peers_loop):
+            self._tasks.append(loop.create_task(fn()))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self.book.save()
+
+    # -- receive ---------------------------------------------------------
+    async def _recv_loop(self) -> None:
+        while True:
+            env = await self.ch.receive()
+            msg = env.message
+            if isinstance(msg, PexRequest):
+                now = time.monotonic()
+                last = self._last_request.get(env.from_, 0.0)
+                if now - last < _REQUEST_INTERVAL_S * 0.9:
+                    # Too-soon request: ignore it, and only treat a PATTERN
+                    # of early requests as abuse.  (A reconnecting peer's
+                    # first request can race the peer-update that resets
+                    # its session state — one early request is normal.)
+                    strikes = self._flood_strikes.get(env.from_, 0) + 1
+                    self._flood_strikes[env.from_] = strikes
+                    if strikes >= 3:
+                        await self.ch.error(env.from_, "pex request flood")
+                    continue
+                self._flood_strikes.pop(env.from_, None)
+                self._last_request[env.from_] = now
+                await self.ch.send(Envelope(
+                    to=env.from_, message=PexResponse(self.book.sample())
+                ))
+                if self.seed_mode:
+                    # seed: serve addresses then hang up to stay available
+                    # (reference SeedDisconnectWaitPeriod behavior)
+                    await asyncio.sleep(1.0)
+                    await self.router.disconnect(env.from_)
+            elif isinstance(msg, PexResponse):
+                if env.from_ not in self._requested:
+                    # unsolicited: drop without learning addresses (the
+                    # pollution defense) — no disconnect, a reconnect race
+                    # can legitimately produce one stray response
+                    self.logger.debug("unsolicited pex response ignored",
+                                      peer=env.from_[:8])
+                    continue
+                self._requested.discard(env.from_)
+                added = sum(1 for a in msg.addrs if self.book.add_address(a))
+                if added:
+                    self.logger.debug("pex learned addresses", n=added)
+                    self.book.save()
+
+    async def _peer_update_loop(self) -> None:
+        while True:
+            update = await self.peer_updates.get()
+            if update.status == PeerStatus.UP:
+                # per-connection state: a reconnecting peer starts fresh —
+                # the flood limiter must only see requests from ONE session
+                self._last_request.pop(update.node_id, None)
+                # ask a fresh peer for its addresses once
+                self._requested.add(update.node_id)
+                await self.ch.send(Envelope(to=update.node_id, message=PexRequest()))
+            else:
+                self._last_request.pop(update.node_id, None)
+                self._flood_strikes.pop(update.node_id, None)
+                self._requested.discard(update.node_id)
+
+    # -- dialing ---------------------------------------------------------
+    async def _ensure_peers_loop(self) -> None:
+        """Keep dialing discovered addresses until we hold max_outbound
+        connections (reference ensurePeersRoutine)."""
+        while True:
+            await asyncio.sleep(_ENSURE_PEERS_INTERVAL_S)
+            need = self.max_outbound - len(self.router.peers)
+            if need <= 0:
+                continue
+            exclude = set(self.router.peers) | {self.router.node_id}
+            for _ in range(min(need, 3)):  # a few dials per tick
+                ka = self.book.pick_address(exclude)
+                if ka is None:
+                    break
+                exclude.add(ka.node_id)
+                self.book.mark_attempt(ka.node_id)
+                try:
+                    if hasattr(self.transport, "add_peer_address"):
+                        self.transport.add_peer_address(ka.addr)
+                    await self.router.dial(ka.node_id)
+                    self.book.mark_good(ka.node_id)
+                except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                    self.logger.debug("pex dial failed", peer=ka.node_id[:8],
+                                      err=str(e))
+                    if self.book.addrs.get(ka.node_id, KnownAddress("", "", 0)).is_bad():
+                        self.book.mark_bad(ka.node_id)

@@ -119,6 +119,10 @@ class Router:
         self.logger.info("peer up", peer=node_id[:8])
         self._publish_peer_update(PeerUpdate(node_id, PeerStatus.UP))
 
+    async def disconnect(self, node_id: NodeID) -> None:
+        """Drop a peer deliberately (seed-mode hangup, operator action)."""
+        await self._disconnect(node_id)
+
     async def _disconnect(self, node_id: NodeID, notify: bool = True) -> None:
         peer = self.peers.pop(node_id, None)
         if peer is None:
