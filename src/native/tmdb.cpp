@@ -1,0 +1,345 @@
+// tmdb: ordered KV engine with a crc-framed write-ahead log.
+//
+// The native storage backend behind tendermint_tpu.store.db.KVStore
+// (reference rides cgo leveldb/rocksdb via tm-db build tags,
+// Makefile:33-48; this plays that role for the rebuilt framework).
+//
+// Design: append-only log + in-memory ordered index (std::map).
+//   record  := op(1) klen(4 LE) vlen(4 LE) key value crc32(4 LE)
+//   op      := 1 set | 2 del
+// Batches append all records then fsync once (atomic enough for the
+// caller's semantics: a torn tail record fails its CRC and is dropped
+// with everything after it on recovery — same contract as the consensus
+// WAL).  When the log exceeds 4x the live data size it is compacted by
+// rewriting a snapshot and atomically renaming.
+//
+// C ABI at the bottom; Python binds with ctypes
+// (tendermint_tpu/store/native_db.py).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+uint32_t crc32_of(const uint8_t* data, size_t n, uint32_t seed = 0) {
+    static uint32_t table[256];
+    static bool init = false;
+    if (!init) {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            table[i] = c;
+        }
+        init = true;
+    }
+    uint32_t c = ~seed;
+    for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    return ~c;
+}
+
+void put_u32(std::string& s, uint32_t v) {
+    char b[4] = {char(v), char(v >> 8), char(v >> 16), char(v >> 24)};
+    s.append(b, 4);
+}
+
+uint32_t get_u32(const uint8_t* p) {
+    return uint32_t(p[0]) | uint32_t(p[1]) << 8 | uint32_t(p[2]) << 16 |
+           uint32_t(p[3]) << 24;
+}
+
+struct DB {
+    std::map<std::string, std::string> data;
+    std::string path;
+    int fd = -1;
+    size_t log_bytes = 0;
+    size_t live_bytes = 0;
+    std::mutex mu;
+
+    bool open(const char* p) {
+        path = p;
+        if (!replay()) return false;
+        fd = ::open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+        return fd >= 0;
+    }
+
+    bool replay() {
+        FILE* f = fopen(path.c_str(), "rb");
+        if (!f) return true;  // fresh db
+        std::vector<uint8_t> buf;
+        fseek(f, 0, SEEK_END);
+        long n = ftell(f);
+        fseek(f, 0, SEEK_SET);
+        buf.resize(size_t(n));
+        if (n > 0 && fread(buf.data(), 1, size_t(n), f) != size_t(n)) {
+            fclose(f);
+            return false;
+        }
+        fclose(f);
+        size_t pos = 0;
+        while (pos + 13 <= buf.size()) {
+            uint8_t op = buf[pos];
+            uint32_t klen = get_u32(&buf[pos + 1]);
+            uint32_t vlen = get_u32(&buf[pos + 5]);
+            size_t need = 9 + size_t(klen) + vlen + 4;
+            if (op != 1 && op != 2) break;
+            if (pos + need > buf.size()) break;  // torn tail
+            uint32_t want = get_u32(&buf[pos + 9 + klen + vlen]);
+            if (crc32_of(&buf[pos], 9 + klen + vlen) != want) break;  // corrupt tail
+            std::string key(reinterpret_cast<char*>(&buf[pos + 9]), klen);
+            if (op == 1) {
+                std::string val(reinterpret_cast<char*>(&buf[pos + 9 + klen]), vlen);
+                auto it = data.find(key);
+                if (it != data.end()) live_bytes -= it->first.size() + it->second.size();
+                live_bytes += key.size() + val.size();
+                data[key] = std::move(val);
+            } else {
+                auto it = data.find(key);
+                if (it != data.end()) {
+                    live_bytes -= it->first.size() + it->second.size();
+                    data.erase(it);
+                }
+            }
+            pos += need;
+        }
+        log_bytes = pos;
+        if (pos < buf.size()) {
+            // drop the torn/corrupt tail so the next append starts clean
+            if (truncate(path.c_str(), off_t(pos)) != 0) return false;
+        }
+        return true;
+    }
+
+    void encode(std::string& out, uint8_t op, const uint8_t* k, size_t klen,
+                const uint8_t* v, size_t vlen) {
+        std::string rec;
+        rec.push_back(char(op));
+        put_u32(rec, uint32_t(klen));
+        put_u32(rec, uint32_t(vlen));
+        rec.append(reinterpret_cast<const char*>(k), klen);
+        if (vlen) rec.append(reinterpret_cast<const char*>(v), vlen);
+        uint32_t crc = crc32_of(reinterpret_cast<const uint8_t*>(rec.data()), rec.size());
+        put_u32(rec, crc);
+        out += rec;
+    }
+
+    bool append(const std::string& recs, bool sync) {
+        if (::write(fd, recs.data(), recs.size()) != ssize_t(recs.size())) return false;
+        log_bytes += recs.size();
+        if (sync && fsync(fd) != 0) return false;
+        return true;
+    }
+
+    void apply_set(const uint8_t* k, size_t klen, const uint8_t* v, size_t vlen) {
+        std::string key(reinterpret_cast<const char*>(k), klen);
+        auto it = data.find(key);
+        if (it != data.end()) live_bytes -= it->first.size() + it->second.size();
+        live_bytes += klen + vlen;
+        data[std::move(key)] = std::string(reinterpret_cast<const char*>(v), vlen);
+    }
+
+    void apply_del(const uint8_t* k, size_t klen) {
+        std::string key(reinterpret_cast<const char*>(k), klen);
+        auto it = data.find(key);
+        if (it != data.end()) {
+            live_bytes -= it->first.size() + it->second.size();
+            data.erase(it);
+        }
+    }
+
+    bool maybe_compact() {
+        if (log_bytes < (1u << 20) || log_bytes < 4 * (live_bytes + 1)) return true;
+        return compact();
+    }
+
+    bool compact() {
+        std::string tmp = path + ".compact";
+        int cfd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+        if (cfd < 0) return false;
+        std::string out;
+        size_t written = 0;
+        for (auto& kv : data) {
+            encode(out, 1, reinterpret_cast<const uint8_t*>(kv.first.data()),
+                   kv.first.size(),
+                   reinterpret_cast<const uint8_t*>(kv.second.data()),
+                   kv.second.size());
+            if (out.size() > (1u << 20)) {
+                if (::write(cfd, out.data(), out.size()) != ssize_t(out.size())) {
+                    ::close(cfd);
+                    return false;
+                }
+                written += out.size();
+                out.clear();
+            }
+        }
+        if (!out.empty() &&
+            ::write(cfd, out.data(), out.size()) != ssize_t(out.size())) {
+            ::close(cfd);
+            return false;
+        }
+        written += out.size();
+        if (fsync(cfd) != 0) { ::close(cfd); return false; }
+        ::close(cfd);
+        if (rename(tmp.c_str(), path.c_str()) != 0) return false;
+        ::close(fd);
+        fd = ::open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+        log_bytes = written;
+        return fd >= 0;
+    }
+};
+
+struct Iter {
+    std::vector<std::pair<std::string, std::string>> items;  // snapshot
+    size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tmdb_open(const char* path) {
+    DB* db = new DB();
+    if (!db->open(path)) {
+        delete db;
+        return nullptr;
+    }
+    return db;
+}
+
+void tmdb_close(void* h) {
+    DB* db = static_cast<DB*>(h);
+    if (db->fd >= 0) {
+        fsync(db->fd);
+        ::close(db->fd);
+    }
+    delete db;
+}
+
+// 1 = found (out malloc'd), 0 = missing, -1 = error
+int tmdb_get(void* h, const uint8_t* k, size_t klen, uint8_t** out,
+             size_t* outlen) {
+    DB* db = static_cast<DB*>(h);
+    std::lock_guard<std::mutex> g(db->mu);
+    auto it = db->data.find(std::string(reinterpret_cast<const char*>(k), klen));
+    if (it == db->data.end()) return 0;
+    *outlen = it->second.size();
+    *out = static_cast<uint8_t*>(malloc(*outlen ? *outlen : 1));
+    if (!*out) return -1;
+    memcpy(*out, it->second.data(), *outlen);
+    return 1;
+}
+
+void tmdb_free(uint8_t* p) { free(p); }
+
+int tmdb_set(void* h, const uint8_t* k, size_t klen, const uint8_t* v,
+             size_t vlen) {
+    DB* db = static_cast<DB*>(h);
+    std::lock_guard<std::mutex> g(db->mu);
+    std::string recs;
+    db->encode(recs, 1, k, klen, v, vlen);
+    if (!db->append(recs, false)) return -1;
+    db->apply_set(k, klen, v, vlen);
+    return db->maybe_compact() ? 0 : -1;
+}
+
+int tmdb_del(void* h, const uint8_t* k, size_t klen) {
+    DB* db = static_cast<DB*>(h);
+    std::lock_guard<std::mutex> g(db->mu);
+    std::string recs;
+    db->encode(recs, 2, k, klen, nullptr, 0);
+    if (!db->append(recs, false)) return -1;
+    db->apply_del(k, klen);
+    return 0;
+}
+
+// batch buffer: repeated  op(1) klen(4) vlen(4) key value  — one fsync.
+int tmdb_batch(void* h, const uint8_t* buf, size_t len) {
+    DB* db = static_cast<DB*>(h);
+    std::lock_guard<std::mutex> g(db->mu);
+    // validate + build log records first (all-or-nothing append)
+    std::string recs;
+    size_t pos = 0;
+    while (pos < len) {
+        if (pos + 9 > len) return -1;
+        uint8_t op = buf[pos];
+        uint32_t klen = get_u32(buf + pos + 1);
+        uint32_t vlen = get_u32(buf + pos + 5);
+        if (pos + 9 + klen + vlen > len || (op != 1 && op != 2)) return -1;
+        db->encode(recs, op, buf + pos + 9, klen, buf + pos + 9 + klen, vlen);
+        pos += 9 + klen + vlen;
+    }
+    if (!db->append(recs, true)) return -1;
+    pos = 0;
+    while (pos < len) {
+        uint8_t op = buf[pos];
+        uint32_t klen = get_u32(buf + pos + 1);
+        uint32_t vlen = get_u32(buf + pos + 5);
+        if (op == 1)
+            db->apply_set(buf + pos + 9, klen, buf + pos + 9 + klen, vlen);
+        else
+            db->apply_del(buf + pos + 9, klen);
+        pos += 9 + klen + vlen;
+    }
+    return db->maybe_compact() ? 0 : -1;
+}
+
+int tmdb_sync(void* h) {
+    DB* db = static_cast<DB*>(h);
+    std::lock_guard<std::mutex> g(db->mu);
+    return fsync(db->fd) == 0 ? 0 : -1;
+}
+
+void* tmdb_iter_new(void* h, const uint8_t* start, size_t slen,
+                    const uint8_t* end, size_t elen) {
+    DB* db = static_cast<DB*>(h);
+    std::lock_guard<std::mutex> g(db->mu);
+    Iter* it = new Iter();
+    std::string s(reinterpret_cast<const char*>(start), slen);
+    auto lo = db->data.lower_bound(s);
+    if (elen) {
+        std::string e(reinterpret_cast<const char*>(end), elen);
+        for (auto i = lo; i != db->data.end() && i->first < e; ++i)
+            it->items.emplace_back(i->first, i->second);
+    } else {
+        for (auto i = lo; i != db->data.end(); ++i)
+            it->items.emplace_back(i->first, i->second);
+    }
+    return it;
+}
+
+// 1 = item produced (pointers valid until next call/free), 0 = done
+int tmdb_iter_next(void* ih, const uint8_t** k, size_t* klen,
+                   const uint8_t** v, size_t* vlen) {
+    Iter* it = static_cast<Iter*>(ih);
+    if (it->pos >= it->items.size()) return 0;
+    auto& kv = it->items[it->pos++];
+    *k = reinterpret_cast<const uint8_t*>(kv.first.data());
+    *klen = kv.first.size();
+    *v = reinterpret_cast<const uint8_t*>(kv.second.data());
+    *vlen = kv.second.size();
+    return 1;
+}
+
+void tmdb_iter_free(void* ih) { delete static_cast<Iter*>(ih); }
+
+int tmdb_compact(void* h) {
+    DB* db = static_cast<DB*>(h);
+    std::lock_guard<std::mutex> g(db->mu);
+    return db->compact() ? 0 : -1;
+}
+
+size_t tmdb_size(void* h) {
+    DB* db = static_cast<DB*>(h);
+    std::lock_guard<std::mutex> g(db->mu);
+    return db->data.size();
+}
+
+}  // extern "C"

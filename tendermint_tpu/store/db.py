@@ -139,4 +139,10 @@ def open_db(backend: str, path: str | None = None) -> KVStore:
         if not path:
             raise ValueError("sqlite backend requires a path")
         return SQLiteDB(path)
+    if backend == "native":
+        if not path:
+            raise ValueError("native backend requires a path")
+        from .native_db import NativeDB
+
+        return NativeDB(path)
     raise ValueError(f"unknown db backend {backend!r}")

@@ -1,0 +1,167 @@
+"""ctypes binding for the native C++ KV engine (src/native/tmdb.cpp).
+
+Plays the role of the reference's cgo leveldb/rocksdb backends
+(tm-db build tags, reference Makefile:33-48): a native ordered store
+behind the same KVStore interface as MemDB/SQLiteDB.  The shared
+library is built by `make -C src/native` (attempted automatically on
+first use if missing).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterator
+
+_LIB_NAME = "libtmdb.so"
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+
+
+def _src_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "src", "native"
+    )
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = os.path.join(_native_dir(), _LIB_NAME)
+        if not os.path.exists(path):
+            src = _src_dir()
+            if os.path.isdir(src):
+                try:
+                    subprocess.run(["make", "-C", src], check=True,
+                                   capture_output=True, timeout=120)
+                except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                        FileNotFoundError) as e:
+                    raise RuntimeError(
+                        f"native KV engine not built and build failed: {e}; "
+                        f"run `make -C {src}`"
+                    ) from None
+        lib = ctypes.CDLL(path)
+        lib.tmdb_open.restype = ctypes.c_void_p
+        lib.tmdb_open.argtypes = [ctypes.c_char_p]
+        lib.tmdb_close.argtypes = [ctypes.c_void_p]
+        lib.tmdb_get.restype = ctypes.c_int
+        lib.tmdb_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.tmdb_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.tmdb_set.restype = ctypes.c_int
+        lib.tmdb_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t]
+        lib.tmdb_del.restype = ctypes.c_int
+        lib.tmdb_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.tmdb_batch.restype = ctypes.c_int
+        lib.tmdb_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.tmdb_sync.restype = ctypes.c_int
+        lib.tmdb_sync.argtypes = [ctypes.c_void_p]
+        lib.tmdb_iter_new.restype = ctypes.c_void_p
+        lib.tmdb_iter_new.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_size_t, ctypes.c_char_p,
+                                      ctypes.c_size_t]
+        lib.tmdb_iter_next.restype = ctypes.c_int
+        lib.tmdb_iter_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.tmdb_iter_free.argtypes = [ctypes.c_void_p]
+        lib.tmdb_compact.restype = ctypes.c_int
+        lib.tmdb_compact.argtypes = [ctypes.c_void_p]
+        lib.tmdb_size.restype = ctypes.c_size_t
+        lib.tmdb_size.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeDB:
+    """KVStore backed by the C++ engine."""
+
+    def __init__(self, path: str):
+        self._lib = _load_lib()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._h = self._lib.tmdb_open(path.encode())
+        if not self._h:
+            raise RuntimeError(f"tmdb_open failed for {path!r} (corrupt log?)")
+        self._closed = False
+
+    def get(self, key: bytes) -> bytes | None:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_size_t()
+        rc = self._lib.tmdb_get(self._h, key, len(key),
+                                ctypes.byref(out), ctypes.byref(n))
+        if rc == 0:
+            return None
+        if rc < 0:
+            raise RuntimeError("tmdb_get failed")
+        try:
+            return ctypes.string_at(out, n.value)
+        finally:
+            self._lib.tmdb_free(out)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if self._lib.tmdb_set(self._h, key, len(key), value, len(value)) != 0:
+            raise RuntimeError("tmdb_set failed")
+
+    def delete(self, key: bytes) -> None:
+        if self._lib.tmdb_del(self._h, key, len(key)) != 0:
+            raise RuntimeError("tmdb_del failed")
+
+    def write_batch(self, sets, deletes) -> None:
+        buf = bytearray()
+        for k, v in sets:
+            buf += struct.pack("<BII", 1, len(k), len(v)) + k + v
+        for k in deletes:
+            buf += struct.pack("<BII", 2, len(k), 0) + k
+        if not buf:
+            return
+        if self._lib.tmdb_batch(self._h, bytes(buf), len(buf)) != 0:
+            raise RuntimeError("tmdb_batch failed")
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None
+                ) -> Iterator[tuple[bytes, bytes]]:
+        ih = self._lib.tmdb_iter_new(self._h, start, len(start),
+                                     end or b"", len(end) if end else 0)
+        k = ctypes.POINTER(ctypes.c_uint8)()
+        v = ctypes.POINTER(ctypes.c_uint8)()
+        klen = ctypes.c_size_t()
+        vlen = ctypes.c_size_t()
+        try:
+            while self._lib.tmdb_iter_next(ih, ctypes.byref(k), ctypes.byref(klen),
+                                           ctypes.byref(v), ctypes.byref(vlen)):
+                yield (ctypes.string_at(k, klen.value),
+                       ctypes.string_at(v, vlen.value))
+        finally:
+            self._lib.tmdb_iter_free(ih)
+
+    def sync(self) -> None:
+        if self._lib.tmdb_sync(self._h) != 0:
+            raise RuntimeError("tmdb_sync failed")
+
+    def compact(self) -> None:
+        if self._lib.tmdb_compact(self._h) != 0:
+            raise RuntimeError("tmdb_compact failed")
+
+    def size(self) -> int:
+        return int(self._lib.tmdb_size(self._h))
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.tmdb_close(self._h)
