@@ -285,6 +285,74 @@ def cmd_signer(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    """Snapshot a running node's observable state over RPC into a
+    directory (reference cmd/tendermint/commands/debug: dump.go collects
+    status, consensus state, net info; SIGABRT profiles don't apply)."""
+    import urllib.request
+
+    out = args.output_dir
+    os.makedirs(out, exist_ok=True)
+    base = args.rpc_laddr or "http://127.0.0.1:26657"
+    if base.startswith("tcp://"):
+        base = "http://" + base[len("tcp://"):]
+    collected = []
+    for route in ("status", "consensus_state", "dump_consensus_state",
+                  "net_info", "num_unconfirmed_txs", "genesis"):
+        try:
+            with urllib.request.urlopen(f"{base}/{route}", timeout=10) as r:
+                doc = json.loads(r.read())
+            with open(os.path.join(out, f"{route}.json"), "w") as fh:
+                json.dump(doc.get("result", doc), fh, indent=2)
+            collected.append(route)
+        except Exception as e:
+            print(f"skip {route}: {e}", file=sys.stderr)
+    # include the node's config for context
+    home = _home(args)
+    cfg_path = os.path.join(home, "config", "config.toml")
+    if os.path.exists(cfg_path):
+        import shutil as _sh
+
+        _sh.copy(cfg_path, os.path.join(out, "config.toml"))
+        collected.append("config.toml")
+    print(f"wrote {len(collected)} artifacts to {out}: {', '.join(collected)}")
+    return 0 if collected else 1
+
+
+def cmd_replay(args) -> int:
+    """Replay the consensus WAL through a fresh node (reference
+    consensus/replay_file.go RunReplayFile): rebuilds consensus state by
+    re-handshaking the app against the block store, then reports the WAL
+    tail relative to the store."""
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.consensus.wal import WAL
+    from tendermint_tpu.node import Node
+
+    cfg = load_config(_home(args))
+    cfg.rpc.laddr = ""  # no servers during replay
+    cfg.instrumentation.prometheus = False
+    node = Node(cfg)  # construction runs the handshake replay
+    height = node.block_store.height()
+    print(f"store height {height}; app replayed to height "
+          f"{node.initial_state.last_block_height}")
+    wal = WAL(cfg.wal_file)
+    try:
+        n_msgs = len(wal.all_messages())
+        print(f"WAL holds {n_msgs} records")
+    except Exception as e:
+        print(f"WAL read ended: {e}")
+    finally:
+        wal.close()
+
+    async def _close():
+        # node never started; release resources
+        node.event_bus.shutdown()
+        node.wal.close()
+
+    asyncio.run(_close())
+    return 0
+
+
 def cmd_abci_server(args) -> int:
     """Serve a builtin app over the ABCI socket protocol (reference
     abci-cli kvstore/counter servers, abci/cmd/abci-cli)."""
@@ -391,6 +459,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hostname", default="127.0.0.1")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("debug", help="snapshot a running node's state over RPC")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="http://127.0.0.1:26657")
+    sp.add_argument("--output-dir", dest="output_dir", default="./debug-dump")
+    sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("replay", help="replay block store + WAL through the app")
+    sp.set_defaults(fn=cmd_replay)
 
     sp = sub.add_parser("abci-server", help="serve a builtin ABCI app over a socket")
     sp.add_argument("--app", default="kvstore",

@@ -52,6 +52,7 @@ class MempoolConfig:
     max_tx_bytes: int = 1024 * 1024
     keep_invalid_txs_in_cache: bool = False
     recheck: bool = True
+    wal_dir: str = ""  # optional raw-tx log (recovery aid, reference InitWAL)
 
 
 @dataclass
@@ -103,6 +104,14 @@ class Mempool:
         self.post_check = None  # callable(tx, ResponseCheckTx) -> None
         self._txs_available: asyncio.Event | None = None
         self._notified_txs_available = False
+        # optional raw-tx WAL (reference clist_mempool.go InitWAL: recovery
+        # aid only — replayed manually by operators, never by the node)
+        self._wal = None
+        if config.wal_dir:
+            import os
+
+            os.makedirs(config.wal_dir, exist_ok=True)
+            self._wal = open(os.path.join(config.wal_dir, "mempool.wal"), "ab")
 
     # -- notification ---------------------------------------------------
     def enable_txs_available(self) -> None:
@@ -172,9 +181,18 @@ class Mempool:
             self.cache.remove(tx)
             raise
 
+        if self._wal is not None:
+            # length-prefixed raw tx, appended BEFORE the app sees it
+            self._wal.write(len(tx).to_bytes(4, "big") + tx)
+            self._wal.flush()
         res = self.app.check_tx_sync(abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW))
         self._res_cb_first_time(tx, sender, res)
         return res
+
+    def close_wal(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
 
     def _res_cb_first_time(self, tx: bytes, sender: str, res: abci.ResponseCheckTx) -> None:
         if res.code == abci.CodeTypeOK:

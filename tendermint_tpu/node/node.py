@@ -181,6 +181,8 @@ class Node:
                     host=host, port=port, moniker=config.base.moniker,
                     logger=self.logger,
                     max_incoming_connections=config.p2p.max_num_inbound_peers,
+                    send_rate=config.p2p.send_rate,
+                    recv_rate=config.p2p.recv_rate,
                 )
             else:
                 # private in-memory net (single-node / in-proc tests)
@@ -486,6 +488,7 @@ class Node:
         await self.indexer_service.stop()
         self.event_bus.shutdown()
         self.wal.close()
+        self.mempool.close_wal()
         if hasattr(self.app_conns, "close"):
             self.app_conns.close()  # external socket app connections
         for db in (self.block_db, self.state_db, self.evidence_db, self.tx_index_db):

@@ -50,7 +50,10 @@ class TCPConnection:
     SecretConnection)."""
 
     def __init__(self, sconn: SecretConnection, writer, remote_id: NodeID,
-                 remote_node_info: dict, on_close=None):
+                 remote_node_info: dict, on_close=None,
+                 send_limiter=None, recv_limiter=None):
+        from tendermint_tpu.utils.flowrate import NopLimiter
+
         self._sconn = sconn
         self._writer = writer
         self.remote_id = remote_id
@@ -58,12 +61,15 @@ class TCPConnection:
         self._closed = False
         self._send_lock = asyncio.Lock()
         self._on_close = on_close
+        self._send_limiter = send_limiter or NopLimiter()
+        self._recv_limiter = recv_limiter or NopLimiter()
 
     async def send(self, channel_id: int, data: bytes) -> None:
         if self._closed:
             raise ConnectionError("connection closed")
         try:
             async with self._send_lock:
+                await self._send_limiter.limit(len(data) + 1)
                 await self._sconn.send(bytes([channel_id]) + data)
         except (OSError, asyncio.IncompleteReadError) as e:
             raise ConnectionError(str(e)) from None
@@ -77,6 +83,7 @@ class TCPConnection:
             raise ConnectionError(str(e)) from None
         if not msg:
             raise ConnectionError("empty frame")
+        await self._recv_limiter.limit(len(msg))
         return msg[0], msg[1:]
 
     async def close(self) -> None:
@@ -100,7 +107,8 @@ class TCPTransport:
     def __init__(self, node_key, network: str, host: str = "0.0.0.0",
                  port: int = 26656, moniker: str = "", channels: bytes = b"",
                  logger: Logger | None = None,
-                 max_incoming_connections: int = 64):
+                 max_incoming_connections: int = 64,
+                 send_rate: int = 0, recv_rate: int = 0):
         self.node_key = node_key
         self.network = network
         self.host = host
@@ -109,6 +117,8 @@ class TCPTransport:
         self.channels = channels
         self.logger = logger or nop_logger()
         self.max_incoming_connections = max_incoming_connections
+        self.send_rate = send_rate  # bytes/sec per peer, 0 = unlimited
+        self.recv_rate = recv_rate
         self.node_id: NodeID = node_key.node_id
         self.listen_addr: tuple[str, int] | None = None
         self._server: asyncio.AbstractServer | None = None
@@ -173,7 +183,13 @@ class TCPTransport:
         self._check_compat(info)
         if info.get("node_id") != remote_id:
             raise HandshakeError("node info id does not match authenticated key")
-        return TCPConnection(sconn, writer, remote_id, info, on_close=on_close)
+        from tendermint_tpu.utils.flowrate import RateLimiter
+
+        return TCPConnection(
+            sconn, writer, remote_id, info, on_close=on_close,
+            send_limiter=RateLimiter(self.send_rate) if self.send_rate else None,
+            recv_limiter=RateLimiter(self.recv_rate) if self.recv_rate else None,
+        )
 
     # -- transport interface ---------------------------------------------
     async def listen(self) -> tuple[str, int]:

@@ -1,0 +1,41 @@
+"""Token-bucket flow control (reference libs/flowrate + MConnection
+send/recv throttling, p2p/conn/connection.go:422-434).
+
+Async-friendly: `await limit(n)` sleeps just long enough to hold the
+configured byte rate; a burst allowance of one second's quota keeps
+small messages latency-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class RateLimiter:
+    def __init__(self, bytes_per_sec: int, burst: int | None = None):
+        self.rate = max(int(bytes_per_sec), 1)
+        self.burst = burst if burst is not None else self.rate
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self.total = 0  # lifetime bytes, for metrics
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    async def limit(self, n: int) -> None:
+        """Account n bytes; sleeps when the bucket is dry."""
+        self.total += n
+        self._refill()
+        self._tokens -= n
+        if self._tokens < 0:
+            await asyncio.sleep(-self._tokens / self.rate)
+
+
+class NopLimiter:
+    total = 0
+
+    async def limit(self, n: int) -> None:
+        self.total += n
