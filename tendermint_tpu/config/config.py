@@ -46,6 +46,7 @@ class BaseConfig:
 @dataclass
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
+    grpc_laddr: str = ""  # optional gRPC broadcast API (reference GRPCListenAddress)
     cors_allowed_origins: list[str] = field(default_factory=list)
     max_open_connections: int = 900
     max_subscription_clients: int = 100

@@ -307,6 +307,7 @@ class Node:
             node_id=self.node_key.node_id,
             moniker=config.base.moniker,
         )
+        self.grpc_server = None
         self.rpc_server = RPCServer(
             self.rpc_env,
             logger=self.logger,
@@ -341,6 +342,11 @@ class Node:
         if self.config.rpc.laddr:
             host, port = _parse_laddr(self.config.rpc.laddr)
             self.rpc_addr = await self.rpc_server.start(host, port)
+        if self.config.rpc.grpc_laddr:
+            from tendermint_tpu.rpc.grpc_api import GRPCBroadcastServer
+
+            self.grpc_server = GRPCBroadcastServer(self.rpc_env, logger=self.logger)
+            await self.grpc_server.start(self.config.rpc.grpc_laddr)
         if self.metrics is not None:
             host, port = _parse_laddr(self.config.instrumentation.prometheus_listen_addr,
                                       default_port=26660)
@@ -479,6 +485,8 @@ class Node:
             await self.pex_reactor.stop()
         await self.router.stop()
         await self.rpc_server.stop()
+        if self.grpc_server is not None:
+            await self.grpc_server.stop()
         if self.metrics is not None:
             await self.metrics.stop()
         from tendermint_tpu.privval.socket_pv import SignerClient

@@ -1,0 +1,131 @@
+"""gRPC broadcast API (reference rpc/grpc/api.go + types.pb.go):
+service BroadcastAPI { Ping; BroadcastTx } — the minimal gRPC surface
+the reference exposes next to JSON-RPC, here over grpc.aio with
+hand-rolled proto codecs (no codegen; the message shapes match the
+reference's types.proto field numbering).
+
+  RequestPing {}                      ResponsePing {}
+  RequestBroadcastTx { bytes tx=1 }   ResponseBroadcastTx {
+                                        check_tx=1 (ResponseCheckTx)
+                                        deliver_tx=2 (ResponseDeliverTx) }
+"""
+
+from __future__ import annotations
+
+import base64
+
+import grpc
+
+from tendermint_tpu.utils.log import Logger, nop_logger
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from . import core
+
+_SERVICE = "tendermint.rpc.grpc.BroadcastAPI"
+
+
+def _encode_tx_result(doc: dict) -> bytes:
+    """RPC-JSON deliver/check result → abci proto-ish message."""
+    w = (ProtoWriter()
+         .varint(1, int(doc.get("code", 0)))
+         .bytes_(2, base64.b64decode(doc.get("data") or ""))
+         .string(3, doc.get("log", ""))
+         .varint(5, int(doc.get("gas_wanted", 0) or 0))
+         .varint(6, int(doc.get("gas_used", 0) or 0)))
+    return w.bytes_out()
+
+
+def _decode_tx_result(data: bytes) -> dict:
+    d = fields_to_dict(data)
+
+    def iv(f):
+        v = d.get(f)
+        return int(v[0]) if v else 0
+
+    def bv(f):
+        v = d.get(f)
+        return v[0] if v and isinstance(v[0], bytes) else b""
+
+    return {
+        "code": iv(1),
+        "data": bv(2),
+        "log": bv(3).decode("utf-8", "replace"),
+        "gas_wanted": iv(5),
+        "gas_used": iv(6),
+    }
+
+
+class GRPCBroadcastServer:
+    def __init__(self, env: core.Environment, logger: Logger | None = None):
+        self.env = env
+        self.logger = logger or nop_logger()
+        self._server: grpc.aio.Server | None = None
+        self.addr: str | None = None
+
+    async def start(self, laddr: str) -> str:
+        """laddr: host:port (or tcp://host:port); port 0 = ephemeral."""
+        target = laddr.split("://", 1)[-1]
+        env = self.env
+
+        async def ping(request: bytes, context) -> bytes:
+            return b""
+
+        async def broadcast_tx(request: bytes, context) -> bytes:
+            d = fields_to_dict(request)
+            tx = d.get(1, [b""])[0]
+            res = await core.broadcast_tx_commit(
+                env, tx=base64.b64encode(tx).decode()
+            )
+            return (ProtoWriter()
+                    .message(1, _encode_tx_result(res["check_tx"]), always=True)
+                    .message(2, _encode_tx_result(res["deliver_tx"]), always=True)
+                    .bytes_out())
+
+        handler = grpc.method_handlers_generic_handler(_SERVICE, {
+            "Ping": grpc.unary_unary_rpc_method_handler(
+                ping, request_deserializer=None, response_serializer=None),
+            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
+                broadcast_tx, request_deserializer=None, response_serializer=None),
+        })
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((handler,))
+        port = self._server.add_insecure_port(target)
+        await self._server.start()
+        host = target.rsplit(":", 1)[0]
+        self.addr = f"{host}:{port}"
+        self.logger.info("gRPC broadcast API listening", addr=self.addr)
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+
+
+class GRPCBroadcastClient:
+    """reference rpc/grpc/client_server.go StartGRPCClient."""
+
+    def __init__(self, addr: str):
+        self.addr = addr.split("://", 1)[-1]
+        self._channel: grpc.aio.Channel | None = None
+
+    async def connect(self) -> None:
+        self._channel = grpc.aio.insecure_channel(self.addr)
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+
+    async def ping(self) -> None:
+        fn = self._channel.unary_unary(f"/{_SERVICE}/Ping")
+        await fn(b"")
+
+    async def broadcast_tx(self, tx: bytes) -> dict:
+        fn = self._channel.unary_unary(f"/{_SERVICE}/BroadcastTx")
+        raw = await fn(ProtoWriter().bytes_(1, tx).bytes_out())
+        d = fields_to_dict(raw)
+        return {
+            "check_tx": _decode_tx_result(d.get(1, [b""])[0]),
+            "deliver_tx": _decode_tx_result(d.get(2, [b""])[0]),
+        }
