@@ -40,6 +40,7 @@ class BaseConfig:
     node_key_file: str = "config/node_key.json"
     abci: str = "builtin"  # builtin | socket
     proxy_app: str = "kvstore"  # app name (builtin) or address (socket)
+    snapshot_interval: int = 0  # builtin-app snapshots every N heights (statesync serving)
     filter_peers: bool = False
 
 

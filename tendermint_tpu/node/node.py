@@ -79,10 +79,10 @@ def _parse_laddr(laddr: str, default_port: int = 26657) -> tuple[str, int]:
     return host or "127.0.0.1", int(port) if port else default_port
 
 
-def _builtin_app(name: str):
+def _builtin_app(name: str, snapshot_interval: int = 0):
     """reference proxy/client.go DefaultClientCreator local apps."""
     if name in ("kvstore", "persistent_kvstore"):
-        return KVStoreApplication(snapshot_interval=0)
+        return KVStoreApplication(snapshot_interval=snapshot_interval)
     if name == "counter":
         return CounterApplication()
     if name == "counter_serial":
@@ -136,7 +136,8 @@ class Node:
             self.app_conns = SocketAppConns(config.base.proxy_app)
         else:
             if app is None:
-                app = _builtin_app(config.base.proxy_app)
+                app = _builtin_app(config.base.proxy_app,
+                                   snapshot_interval=config.base.snapshot_interval)
             self.app = app
             self.app_conns = AppConns(app)
 
@@ -284,6 +285,24 @@ class Node:
             on_caught_up=self._on_caught_up,
             logger=self.logger,
         )
+        if state_provider is None and config.statesync.enable:
+            # config-driven: light-client state provider over the
+            # configured RPC servers (reference statesync/stateprovider.go:47
+            # via node/node.go startStateSync)
+            from tendermint_tpu.light.client import TrustOptions
+            from tendermint_tpu.light.http_provider import HTTPProvider
+            from tendermint_tpu.statesync import LightClientStateProvider
+
+            providers = [HTTPProvider(genesis.chain_id, url)
+                         for url in config.statesync.rpc_servers]
+            state_provider = LightClientStateProvider(
+                genesis.chain_id, genesis, providers,
+                TrustOptions(
+                    period_ns=config.statesync.trust_period_s * 10**9,
+                    height=config.statesync.trust_height,
+                    hash=bytes.fromhex(config.statesync.trust_hash),
+                ),
+            )
         self.statesync_reactor = StateSyncReactor(
             self.app_conns.snapshot(), self.router, state_provider, logger=self.logger
         )

@@ -26,7 +26,10 @@ def i64(n: int) -> str:
 def rfc3339(time_ns: int) -> str:
     secs, nanos = divmod(int(time_ns), 10**9)
     dt = _dt.datetime.fromtimestamp(secs, _dt.timezone.utc)
-    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{nanos:09d}Z"
+    # strftime leaves years < 1000 unpadded ("1-01-01" for the Go zero
+    # time carried by absent commit sigs) — pad to valid RFC3339
+    return (f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}"
+            f"T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}.{nanos:09d}Z")
 
 
 def parse_rfc3339(s: str) -> int:

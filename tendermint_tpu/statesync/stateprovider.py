@@ -61,7 +61,9 @@ class LightClientStateProvider:
             initial_height=getattr(self.genesis, "initial_height", 1) or 1,
             last_block_height=cur.height - 1,
             last_block_id=cur.header.last_block_id,
-            last_block_time_ns=cur.header.time_ns,
+            # time of the LAST COMMITTED block (height), not of height+1 —
+            # the next real block must still satisfy time monotonicity
+            last_block_time_ns=last.header.time_ns,
             validators=cur.validator_set,
             next_validators=nxt.validator_set,
             last_validators=last.validator_set,
