@@ -1,0 +1,163 @@
+"""ProofOperator composition for multi-store proofs.
+
+Parity: reference crypto/merkle/{proof_op.go:139 (ProofRuntime),
+proof_key_path.go (KeyPath encoding), proof_value.go (ValueOp)}: a chain
+of operators, each transforming the child's output into its parent's
+input, verified outermost root against the final output; keys pop off a
+URL-encoded key path one operator at a time.  This is the mechanism
+IAVL-style apps use for `abci_query(prove=true)` responses.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Callable
+
+from . import merkle
+
+VALUE_OP_TYPE = "simple:v"  # reference ProofOpValue / "simple:v"
+
+
+@dataclass
+class ProofOp:
+    """Wire shape (proto/tendermint/crypto/proof.proto ProofOp)."""
+
+    type: str
+    key: bytes
+    data: bytes  # op-specific encoding
+
+
+class ProofError(Exception):
+    pass
+
+
+@dataclass
+class ValueOp:
+    """Leaf operator: proves value -> root for `key` via a merkle Proof
+    (reference proof_value.go: leaf = sha256(value) keyed into the tree)."""
+
+    key: bytes
+    proof: merkle.Proof
+
+    def run(self, args: list[bytes]) -> list[bytes]:
+        if len(args) != 1:
+            raise ProofError(f"ValueOp expects 1 arg, got {len(args)}")
+        vhash = hashlib.sha256(args[0]).digest()
+        bz = _encode_kv(self.key, vhash)
+        if merkle.leaf_hash(bz) != self.proof.leaf_hash:
+            raise ProofError("leaf hash mismatch")
+        root = self.proof.compute_root()
+        if root is None:
+            raise ProofError("invalid proof shape")
+        return [root]
+
+    def proof_op(self) -> ProofOp:
+        data = _encode_proof(self.proof)
+        return ProofOp(type=VALUE_OP_TYPE, key=self.key, data=data)
+
+    @classmethod
+    def decode(cls, op: ProofOp) -> "ValueOp":
+        return cls(key=op.key, proof=_decode_proof(op.data))
+
+
+def _encode_kv(key: bytes, vhash: bytes) -> bytes:
+    from tendermint_tpu.wire.proto import encode_uvarint
+
+    return (encode_uvarint(len(key)) + key + encode_uvarint(len(vhash)) + vhash)
+
+
+def _encode_proof(p: merkle.Proof) -> bytes:
+    from tendermint_tpu.wire.proto import ProtoWriter
+
+    w = (ProtoWriter().varint(1, p.total).varint(2, p.index)
+         .bytes_(3, p.leaf_hash))
+    for a in p.aunts:
+        w.bytes_(4, a)
+    return w.bytes_out()
+
+
+def _decode_proof(data: bytes) -> merkle.Proof:
+    from tendermint_tpu.wire.proto import fields_to_dict
+
+    d = fields_to_dict(data)
+    return merkle.Proof(
+        total=int(d.get(1, [0])[0]),
+        index=int(d.get(2, [0])[0]),
+        leaf_hash=d.get(3, [b""])[0],
+        aunts=list(d.get(4, [])),
+    )
+
+
+# -- key paths (reference proof_key_path.go) --------------------------------
+
+def key_path(*keys: bytes) -> str:
+    """Encode store keys into a /-separated URL-encoded path, outermost
+    first (reference KeyPath.String)."""
+    return "/" + "/".join(urllib.parse.quote(k.decode("latin-1"), safe="")
+                          for k in keys)
+
+
+def parse_key_path(path: str) -> list[bytes]:
+    if not path.startswith("/"):
+        raise ProofError(f"key path must start with '/': {path!r}")
+    return [urllib.parse.unquote(seg).encode("latin-1")
+            for seg in path.split("/")[1:] if seg]
+
+
+# -- runtime (reference proof_op.go ProofRuntime) ---------------------------
+
+class ProofRuntime:
+    def __init__(self) -> None:
+        self._decoders: dict[str, Callable[[ProofOp], object]] = {}
+        self.register(VALUE_OP_TYPE, ValueOp.decode)
+
+    def register(self, op_type: str, decoder: Callable[[ProofOp], object]) -> None:
+        self._decoders[op_type] = decoder
+
+    def verify_value(self, ops: list[ProofOp], root: bytes, keypath: str,
+                     value: bytes) -> None:
+        self.verify(ops, root, keypath, [value])
+
+    def verify(self, ops: list[ProofOp], root: bytes, keypath: str,
+               args: list[bytes]) -> None:
+        """Run the operator chain innermost-first; each op's key must pop
+        the NEXT segment off the key path (innermost = last segment); the
+        final output must equal the trusted root."""
+        keys = parse_key_path(keypath)
+        for op in ops:
+            dec = self._decoders.get(op.type)
+            if dec is None:
+                raise ProofError(f"unregistered proof op type {op.type!r}")
+            operator = dec(op)
+            if op.key:
+                if not keys:
+                    raise ProofError(f"key path exhausted at op key {op.key!r}")
+                if keys[-1] != op.key:
+                    raise ProofError(
+                        f"key mismatch: op {op.key!r} vs path {keys[-1]!r}")
+                keys = keys[:-1]
+            args = operator.run(args)
+        if keys:
+            raise ProofError(f"unconsumed key path segments: {keys!r}")
+        if len(args) != 1 or args[0] != root:
+            raise ProofError("computed root does not match trusted root")
+
+
+def default_runtime() -> ProofRuntime:
+    return ProofRuntime()
+
+
+# -- simple-store prover ----------------------------------------------------
+
+def prove_value(kv: dict[bytes, bytes], key: bytes) -> tuple[bytes, ValueOp]:
+    """Build the simple-merkle store root over `kv` and an inclusion
+    ValueOp for `key` (reference SimpleProofsFromMap semantics: leaves
+    are kv-encoded (key, sha256(value)) pairs in key order)."""
+    keys = sorted(kv)
+    if key not in kv:
+        raise ProofError(f"key {key!r} not in store")
+    leaves = [_encode_kv(k, hashlib.sha256(kv[k]).digest()) for k in keys]
+    root, proofs = merkle.proofs_from_byte_slices(leaves)
+    return root, ValueOp(key=key, proof=proofs[keys.index(key)])

@@ -95,6 +95,20 @@ class NodeMetrics:
             "peers", "Connected peers", namespace=ns, subsystem="p2p",
             fn=lambda: len(node.router.peers),
         ))
+        from tendermint_tpu.utils.metrics import LabeledCallbackGauge
+
+        self.p2p_recv_bytes = reg.register(LabeledCallbackGauge(
+            "message_receive_bytes_total", "Bytes received per channel",
+            namespace=ns, subsystem="p2p",
+            fn=lambda: [({"chID": f"{cid:#x}"}, v)
+                        for cid, v in sorted(node.router.bytes_received.items())],
+        ))
+        self.p2p_send_bytes = reg.register(LabeledCallbackGauge(
+            "message_send_bytes_total", "Bytes sent per channel",
+            namespace=ns, subsystem="p2p",
+            fn=lambda: [({"chID": f"{cid:#x}"}, v)
+                        for cid, v in sorted(node.router.bytes_sent.items())],
+        ))
 
         # -- state ------------------------------------------------------
         self.state = StateMetrics(reg, ns)

@@ -43,6 +43,10 @@ class Router:
         self._tasks: list[asyncio.Task] = []
         self._seq = itertools.count()
         self._stopping = False
+        # per-channel traffic counters (reference p2p/metrics.go bytes
+        # by channel), read by the metrics scraper
+        self.bytes_received: dict[int, int] = {}
+        self.bytes_sent: dict[int, int] = {}
 
     # -- channels --------------------------------------------------------
     def open_channel(self, descriptor: ChannelDescriptor) -> Channel:
@@ -139,6 +143,9 @@ class Router:
         try:
             while True:
                 channel_id, data = await peer.conn.receive()
+                self.bytes_received[channel_id] = (
+                    self.bytes_received.get(channel_id, 0) + len(data)
+                )
                 ch = self.channels.get(channel_id)
                 if ch is None:
                     continue  # unknown channel: drop silently
@@ -163,6 +170,9 @@ class Router:
             while True:
                 _, _, channel_id, data = await peer.send_q.get()
                 await peer.conn.send(channel_id, data)
+                self.bytes_sent[channel_id] = (
+                    self.bytes_sent.get(channel_id, 0) + len(data)
+                )
         except asyncio.CancelledError:
             return
         except ConnectionError:

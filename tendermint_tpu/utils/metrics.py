@@ -139,6 +139,23 @@ class Histogram(_Metric):
         return out
 
 
+class LabeledCallbackGauge(_Metric):
+    """Gauge whose labeled samples come from a callback evaluated at
+    scrape time: fn() -> list[(labels_dict, value)]."""
+
+    kind = "gauge"
+
+    def __init__(self, *args, fn: Callable[[], list] = None, **kw):
+        super().__init__(*args, **kw)
+        self._fn = fn
+
+    def samples(self):
+        try:
+            return [("", labels, float(v)) for labels, v in self._fn()]
+        except Exception:
+            return []
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: list[_Metric] = []

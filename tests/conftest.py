@@ -1,15 +1,22 @@
 """Test environment: force JAX onto a virtual 8-device CPU platform so the
 multi-chip sharding paths compile/execute without TPU hardware.
 
-Must run before the first `import jax` anywhere in the test session.  Note
-the image's sitecustomize pins JAX_PLATFORMS=axon (the TPU tunnel), so a
-plain env prefix or setdefault is not enough — assign explicitly.  Set
+Must run before the first backend initialization.  The image's
+sitecustomize registers the 'axon' TPU tunnel backend and may import jax
+during interpreter startup, so setting env vars alone is not always
+enough — the platform is also forced through jax.config, which still
+works as long as no device has been touched yet.  Set
 TM_TPU_TEST_PLATFORM=axon to deliberately run the suite on real TPU.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = os.environ.get("TM_TPU_TEST_PLATFORM", "cpu")
+_platform = os.environ.get("TM_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)

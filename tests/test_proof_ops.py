@@ -1,0 +1,91 @@
+"""ProofOperator composition: value → store root → multi-store root
+chains, key-path handling, tamper rejection.
+
+Scenario parity: reference crypto/merkle/proof_op_test.go +
+proof_value.go semantics.
+"""
+
+import pytest
+
+from tendermint_tpu.crypto.proof_ops import (
+    ProofError,
+    ProofOp,
+    ValueOp,
+    default_runtime,
+    key_path,
+    parse_key_path,
+    prove_value,
+)
+
+
+def test_key_path_roundtrip():
+    keys = [b"store/with/slashes", b"plain", b"\x00\xffbin"]
+    p = key_path(*keys)
+    assert parse_key_path(p) == keys
+    with pytest.raises(ProofError):
+        parse_key_path("no-leading-slash")
+
+
+def test_single_store_value_proof():
+    kv = {b"a": b"1", b"b": b"2", b"key": b"value", b"z": b"26"}
+    root, op = prove_value(kv, b"key")
+    rt = default_runtime()
+    rt.verify_value([op.proof_op()], root, key_path(b"key"), b"value")
+
+    # wrong value rejected
+    with pytest.raises(ProofError):
+        rt.verify_value([op.proof_op()], root, key_path(b"key"), b"other")
+    # wrong root rejected
+    with pytest.raises(ProofError):
+        rt.verify_value([op.proof_op()], b"\x00" * 32, key_path(b"key"), b"value")
+    # wrong key path rejected
+    with pytest.raises(ProofError):
+        rt.verify_value([op.proof_op()], root, key_path(b"a"), b"value")
+
+
+def test_two_level_multistore_chain():
+    """Inner store proves value under its root; the outer (multistore)
+    proves the inner root as ITS value — the chained verification walks
+    /outer/inner key path (reference multi-store pattern)."""
+    inner_kv = {b"balance": b"100", b"nonce": b"7"}
+    inner_root, inner_op = prove_value(inner_kv, b"balance")
+
+    outer_kv = {b"bank": inner_root, b"staking": b"other-root"}
+    outer_root, outer_op = prove_value(outer_kv, b"bank")
+
+    rt = default_runtime()
+    rt.verify_value(
+        [inner_op.proof_op(), outer_op.proof_op()],
+        outer_root,
+        key_path(b"bank", b"balance"),
+        b"100",
+    )
+    # swapped op order breaks the chain
+    with pytest.raises(ProofError):
+        rt.verify_value(
+            [outer_op.proof_op(), inner_op.proof_op()],
+            outer_root, key_path(b"bank", b"balance"), b"100",
+        )
+    # leftover key-path segments rejected
+    with pytest.raises(ProofError):
+        rt.verify_value([inner_op.proof_op()], inner_root,
+                        key_path(b"bank", b"balance"), b"100")
+
+
+def test_unregistered_op_type_rejected():
+    rt = default_runtime()
+    with pytest.raises(ProofError, match="unregistered"):
+        rt.verify([ProofOp(type="iavl:v", key=b"k", data=b"")],
+                  b"\x00" * 32, key_path(b"k"), [b"v"])
+
+
+def test_proof_op_wire_roundtrip():
+    kv = {b"k%d" % i: b"v%d" % i for i in range(10)}
+    root, op = prove_value(kv, b"k3")
+    wire = op.proof_op()
+    back = ValueOp.decode(wire)
+    assert back.key == op.key
+    assert back.proof.total == op.proof.total
+    assert back.proof.index == op.proof.index
+    assert back.proof.leaf_hash == op.proof.leaf_hash
+    assert back.proof.aunts == op.proof.aunts
