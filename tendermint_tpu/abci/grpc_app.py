@@ -1,0 +1,210 @@
+"""ABCI over gRPC: the third app transport next to local and socket.
+
+Parity: reference abci/client/grpc_client.go:506 +
+abci/server/grpc_server.go — per-method RPCs on service
+tendermint.abci.ABCIApplication, synchronous call semantics (the
+reference emulates async over gRPC anyway).  Payloads reuse the
+framework's ABCI wire envelopes (abci/wire.py), so the codec is shared
+with the socket transport; the gRPC method name selects the handler for
+wire-level parity.
+
+Server side uses grpc.aio (fits the node/app asyncio runtime); client
+side uses sync grpc stubs — blocking fits the *_sync client interface
+the executor drives, and channels are thread-safe.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from . import types as abci
+from . import wire
+from .socket import SocketServer  # reuse its _dispatch
+
+_SERVICE = "tendermint.abci.ABCIApplication"
+
+_METHODS = {
+    "Echo": wire.ECHO,
+    "Flush": wire.FLUSH,
+    "Info": wire.INFO,
+    "InitChain": wire.INIT_CHAIN,
+    "Query": wire.QUERY,
+    "BeginBlock": wire.BEGIN_BLOCK,
+    "CheckTx": wire.CHECK_TX,
+    "DeliverTx": wire.DELIVER_TX,
+    "EndBlock": wire.END_BLOCK,
+    "Commit": wire.COMMIT,
+    "ListSnapshots": wire.LIST_SNAPSHOTS,
+    "OfferSnapshot": wire.OFFER_SNAPSHOT,
+    "LoadSnapshotChunk": wire.LOAD_SNAPSHOT_CHUNK,
+    "ApplySnapshotChunk": wire.APPLY_SNAPSHOT_CHUNK,
+}
+_KIND_TO_METHOD = {v: k for k, v in _METHODS.items()}
+
+
+class GRPCAppServer:
+    """Serves an Application over gRPC (reference grpc_server.go)."""
+
+    def __init__(self, app: abci.Application, logger: Logger | None = None):
+        self.app = app
+        self.logger = logger or nop_logger()
+        self._dispatcher = SocketServer(app, logger=self.logger)
+        self._server: grpc.aio.Server | None = None
+        self.addr: str | None = None
+
+    async def start(self, laddr: str) -> str:
+        import asyncio
+
+        target = laddr.split("://", 1)[-1]
+        dispatcher = self._dispatcher
+
+        def make_handler(expected_kind: int):
+            async def handler(request: bytes, context) -> bytes:
+                kind, req = wire.decode_request(request)
+                if kind != expected_kind:
+                    return wire.encode_response(
+                        wire.EXCEPTION,
+                        f"method expects kind {expected_kind}, got {kind}")
+                try:
+                    resp_kind, resp = await asyncio.to_thread(
+                        dispatcher._dispatch, kind, req)
+                except Exception as e:
+                    self.logger.error("ABCI gRPC app exception", err=str(e))
+                    resp_kind, resp = wire.EXCEPTION, str(e)
+                return wire.encode_response(resp_kind, resp)
+
+            return handler
+
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                make_handler(kind), request_deserializer=None,
+                response_serializer=None)
+            for name, kind in _METHODS.items()
+        }
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),))
+        port = self._server.add_insecure_port(target)
+        await self._server.start()
+        self.addr = f"{target.rsplit(':', 1)[0]}:{port}"
+        self.logger.info("ABCI gRPC server listening", addr=self.addr)
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+
+
+class GRPCAppClient:
+    """Blocking *_sync client over a sync gRPC channel
+    (reference grpc_client.go — per-call sync semantics)."""
+
+    def __init__(self, laddr: str, timeout: float = 30.0):
+        self.laddr = laddr.split("://", 1)[-1]
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._channel: grpc.Channel | None = None
+
+    def connect(self, retries: int = 40, delay: float = 0.25) -> None:
+        self._channel = grpc.insecure_channel(self.laddr)
+        try:
+            grpc.channel_ready_future(self._channel).result(
+                timeout=retries * delay + 5)
+        except grpc.FutureTimeoutError:
+            raise ConnectionError(
+                f"cannot connect to ABCI gRPC app at {self.laddr}") from None
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+    def _call(self, kind: int, req):
+        with self._lock:
+            if self._channel is None:
+                self.connect()
+            fn = self._channel.unary_unary(f"/{_SERVICE}/{_KIND_TO_METHOD[kind]}")
+            raw = fn(wire.encode_request(kind, req), timeout=self.timeout)
+        got, resp = wire.decode_response(raw)
+        if got == wire.EXCEPTION:
+            raise RuntimeError(f"app exception: {resp}")
+        if got != kind:
+            raise ConnectionError(f"ABCI gRPC response {got} for request {kind}")
+        return resp
+
+    # -- client interface (mirrors LocalClient/SocketClient) -------------
+    def echo(self, msg: str) -> str:
+        return self._call(wire.ECHO, msg)
+
+    def flush_sync(self) -> None:
+        self._call(wire.FLUSH, None)
+
+    def info_sync(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return self._call(wire.INFO, req)
+
+    def query_sync(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        return self._call(wire.QUERY, req)
+
+    def check_tx_sync(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return self._call(wire.CHECK_TX, req)
+
+    def init_chain_sync(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        return self._call(wire.INIT_CHAIN, req)
+
+    def begin_block_sync(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        return self._call(wire.BEGIN_BLOCK, req)
+
+    def deliver_tx_sync(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        return self._call(wire.DELIVER_TX, req)
+
+    def end_block_sync(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return self._call(wire.END_BLOCK, req)
+
+    def commit_sync(self) -> abci.ResponseCommit:
+        return self._call(wire.COMMIT, None)
+
+    def list_snapshots_sync(self) -> list[abci.Snapshot]:
+        return self._call(wire.LIST_SNAPSHOTS, None)
+
+    def offer_snapshot_sync(self, snapshot, app_hash: bytes):
+        return self._call(wire.OFFER_SNAPSHOT, (snapshot, app_hash))
+
+    def load_snapshot_chunk_sync(self, height: int, format: int, chunk: int) -> bytes:
+        return self._call(wire.LOAD_SNAPSHOT_CHUNK, (height, format, chunk))
+
+    def apply_snapshot_chunk_sync(self, index: int, chunk: bytes, sender: str):
+        return self._call(wire.APPLY_SNAPSHOT_CHUNK, (index, chunk, sender))
+
+
+class GRPCAppConns:
+    """Four logical connections over one shared channel per connection
+    (reference proxy/multi_app_conn.go over grpc_client)."""
+
+    def __init__(self, laddr: str):
+        self._consensus = GRPCAppClient(laddr)
+        self._mempool = GRPCAppClient(laddr)
+        self._query = GRPCAppClient(laddr)
+        self._snapshot = GRPCAppClient(laddr)
+        for c in (self._consensus, self._mempool, self._query, self._snapshot):
+            c.connect()
+
+    def consensus(self) -> GRPCAppClient:
+        return self._consensus
+
+    def mempool(self) -> GRPCAppClient:
+        return self._mempool
+
+    def query(self) -> GRPCAppClient:
+        return self._query
+
+    def snapshot(self) -> GRPCAppClient:
+        return self._snapshot
+
+    def close(self) -> None:
+        for c in (self._consensus, self._mempool, self._query, self._snapshot):
+            c.close()

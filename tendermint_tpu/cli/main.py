@@ -60,7 +60,8 @@ def _add_node_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--moniker", help="node name")
     p.add_argument("--proxy-app", dest="proxy_app",
                    help="ABCI app (builtin name or socket address)")
-    p.add_argument("--abci", choices=["builtin", "socket"], help="ABCI transport")
+    p.add_argument("--abci", choices=["builtin", "socket", "grpc"],
+                   help="ABCI transport")
     p.add_argument("--fast-sync", dest="fast_sync", action="store_true", default=None)
     p.add_argument("--no-fast-sync", dest="fast_sync", action="store_false")
     p.add_argument("--db-backend", dest="db_backend")
@@ -354,15 +355,21 @@ def cmd_replay(args) -> int:
 
 
 def cmd_abci_server(args) -> int:
-    """Serve a builtin app over the ABCI socket protocol (reference
-    abci-cli kvstore/counter servers, abci/cmd/abci-cli)."""
-    from tendermint_tpu.abci.socket import SocketServer
+    """Serve a builtin app over the ABCI socket or gRPC protocol
+    (reference abci-cli kvstore/counter servers, abci/cmd/abci-cli)."""
     from tendermint_tpu.node.node import _builtin_app
     from tendermint_tpu.utils.log import new_logger
 
     logger = new_logger(level="info")
     app = _builtin_app(args.app)
-    server = SocketServer(app, logger=logger)
+    if args.transport == "grpc":
+        from tendermint_tpu.abci.grpc_app import GRPCAppServer
+
+        server = GRPCAppServer(app, logger=logger)
+    else:
+        from tendermint_tpu.abci.socket import SocketServer
+
+        server = SocketServer(app, logger=logger)
 
     async def run():
         stop_ev = asyncio.Event()
@@ -472,6 +479,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--app", default="kvstore",
                     help="kvstore | persistent_kvstore | counter")
     sp.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    sp.add_argument("--transport", default="socket", choices=["socket", "grpc"])
     sp.set_defaults(fn=cmd_abci_server)
 
     sp = sub.add_parser("light", help="run a light-client verifying proxy")

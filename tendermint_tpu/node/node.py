@@ -134,6 +134,11 @@ class Node:
 
             self.app = None
             self.app_conns = SocketAppConns(config.base.proxy_app)
+        elif app is None and config.base.abci == "grpc":
+            from tendermint_tpu.abci.grpc_app import GRPCAppConns
+
+            self.app = None
+            self.app_conns = GRPCAppConns(config.base.proxy_app)
         else:
             if app is None:
                 app = _builtin_app(config.base.proxy_app,

@@ -131,12 +131,14 @@ def test_parse_abci_laddr():
 # client ⇄ subprocess server
 # ---------------------------------------------------------------------------
 
-def _spawn_server(port: int, app: str = "kvstore") -> subprocess.Popen:
+def _spawn_server(port: int, app: str = "kvstore",
+                  transport: str = "socket") -> subprocess.Popen:
     import os
 
     return subprocess.Popen(
         [sys.executable, "-m", "tendermint_tpu.cli", "abci-server",
-         "--app", app, "--addr", f"tcp://127.0.0.1:{port}"],
+         "--app", app, "--addr", f"tcp://127.0.0.1:{port}",
+         "--transport", transport],
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
@@ -164,6 +166,50 @@ def test_socket_client_against_subprocess_server():
         q = c.query_sync(abci.RequestQuery(data=b"b", path="/key"))
         assert q.value == b"2"
         c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_node_with_external_grpc_app(tmp_path):
+    """Same external-app scenario over the gRPC ABCI transport
+    (reference abci/client/grpc_client.go)."""
+    port = 29872
+    proc = _spawn_server(port, transport="grpc")
+    try:
+        async def run():
+            from tendermint_tpu.abci.grpc_app import GRPCAppClient
+
+            key = priv_key_from_seed(b"\x63" * 32)
+            gen = GenesisDoc(
+                chain_id="grpc-abci-chain",
+                genesis_time_ns=1_700_000_000 * 10**9,
+                validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+            )
+            cfg = make_test_config(str(tmp_path))
+            cfg.base.fast_sync = False
+            cfg.base.abci = "grpc"
+            cfg.base.proxy_app = f"tcp://127.0.0.1:{port}"
+            probe = GRPCAppClient(cfg.base.proxy_app)
+            await asyncio.to_thread(probe.connect)
+            assert (await asyncio.to_thread(probe.echo, "hi")) == "hi"
+            probe.close()
+            node = Node(cfg, genesis=gen)
+            node.priv_validator.priv_key = key
+            node.consensus.priv_validator = node.priv_validator
+            await node.start()
+            try:
+                node.mempool.check_tx(b"grpc-abci=yes")
+                await node.wait_for_height(3, timeout=60)
+                res = node.app_conns.query().query_sync(
+                    abci.RequestQuery(data=b"grpc-abci", path="/key")
+                )
+                assert res.value == b"yes"
+            finally:
+                await node.stop()
+
+        asyncio.run(run())
     finally:
         proc.terminate()
         proc.wait(timeout=10)
