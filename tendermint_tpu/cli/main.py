@@ -258,17 +258,17 @@ def cmd_testnet(args) -> int:
 
 
 def cmd_signer(args) -> int:
-    """Run a remote signer: serve this home's priv validator key to a
-    node listening on --addr (reference privval/signer_server.go; the
-    signer dials the node)."""
+    """Run a remote signer for this home's priv validator key.
+
+    socket transport (default): dial the node's priv_validator_laddr
+    (reference privval/signer_server.go).  grpc transport: LISTEN on
+    --addr and let the node dial us (reference privval/grpc/server.go)."""
     from tendermint_tpu.config import load_config
     from tendermint_tpu.privval.file_pv import load_or_gen_file_pv
-    from tendermint_tpu.privval.socket_pv import SignerServer
     from tendermint_tpu.utils.log import new_logger
 
     cfg = load_config(_home(args))
     pv = load_or_gen_file_pv(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
-    host, _, port = args.addr.rpartition(":")
     logger = new_logger(level="info")
 
     async def run():
@@ -276,8 +276,17 @@ def cmd_signer(args) -> int:
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop_ev.set)
-        server = SignerServer(pv, host or "127.0.0.1", int(port), logger=logger)
-        await server.start()
+        if args.transport == "grpc":
+            from tendermint_tpu.privval.grpc_pv import GRPCSignerServer
+
+            server = GRPCSignerServer(pv, logger=logger)
+            await server.start(args.addr)
+        else:
+            from tendermint_tpu.privval.socket_pv import SignerServer
+
+            host, _, port = args.addr.rpartition(":")
+            server = SignerServer(pv, host or "127.0.0.1", int(port), logger=logger)
+            await server.start()
         logger.info("signer serving", validator=pv.get_pub_key().address().hex())
         await stop_ev.wait()
         await server.stop()
@@ -493,8 +502,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--log-level", dest="log_level", default="info")
     sp.set_defaults(fn=cmd_light)
 
-    sp = sub.add_parser("signer", help="run a remote signer dialing a node")
-    sp.add_argument("--addr", required=True, help="node priv_validator_laddr host:port")
+    sp = sub.add_parser("signer", help="run a remote signer")
+    sp.add_argument("--addr", required=True,
+                    help="socket: node's priv_validator_laddr to dial; "
+                         "grpc: address to listen on")
+    sp.add_argument("--transport", default="socket", choices=["socket", "grpc"])
     sp.set_defaults(fn=cmd_signer)
 
     for name, fn in (

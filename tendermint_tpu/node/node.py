@@ -168,8 +168,16 @@ class Node:
             self.priv_validator = load_or_gen_file_pv(
                 config.priv_validator_key_file, config.priv_validator_state_file
             )
+        elif config.base.priv_validator_laddr.startswith("grpc://"):
+            # gRPC signer: the SIGNER serves, the node dials
+            # (reference privval/grpc/client.go)
+            from tendermint_tpu.privval.grpc_pv import GRPCSignerClient
+
+            self.priv_validator = GRPCSignerClient(
+                config.base.priv_validator_laddr, logger=self.logger
+            )
         else:
-            # remote signer: the node listens, the signer process dials in
+            # socket signer: the node listens, the signer process dials in
             # (reference node/node.go:695-710 + privval/signer_client.go)
             from tendermint_tpu.privval.socket_pv import SignerClient
 
@@ -357,11 +365,14 @@ class Node:
         if self._started:
             raise RuntimeError("node already started")
         self._started = True
+        from tendermint_tpu.privval.grpc_pv import GRPCSignerClient
         from tendermint_tpu.privval.socket_pv import SignerClient
 
         if isinstance(self.priv_validator, SignerClient):
             # block until the remote signer dials in and the pubkey primes
             await asyncio.to_thread(self.priv_validator.wait_for_signer, 30.0)
+        elif isinstance(self.priv_validator, GRPCSignerClient):
+            await asyncio.to_thread(self.priv_validator.connect, 30.0)
         await self.indexer_service.start()
         if self.config.rpc.laddr:
             host, port = _parse_laddr(self.config.rpc.laddr)
@@ -513,9 +524,10 @@ class Node:
             await self.grpc_server.stop()
         if self.metrics is not None:
             await self.metrics.stop()
+        from tendermint_tpu.privval.grpc_pv import GRPCSignerClient
         from tendermint_tpu.privval.socket_pv import SignerClient
 
-        if isinstance(self.priv_validator, SignerClient):
+        if isinstance(self.priv_validator, (SignerClient, GRPCSignerClient)):
             await asyncio.to_thread(self.priv_validator.close)
         await self.indexer_service.stop()
         self.event_bus.shutdown()

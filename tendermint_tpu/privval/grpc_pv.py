@@ -1,0 +1,161 @@
+"""Remote signer over gRPC (reference privval/grpc/{client,server}.go):
+the SIGNER runs a gRPC server exposing PrivValidatorAPI
+{GetPubKey, SignVote, SignProposal}; the node dials it as a client —
+the opposite connection direction from the socket signer.
+
+Method payloads (shared shapes with privval/socket_pv.py):
+  GetPubKeyRequest {}             GetPubKeyResponse { pub_key=1, error=2 }
+  SignVoteRequest { vote=1, chain_id=2 }       SignedVoteResponse { vote=1, error=2 }
+  SignProposalRequest { proposal=1, chain_id=2 } SignedProposalResponse { proposal=1, error=2 }
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.log import Logger, nop_logger
+from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+
+from .socket_pv import RemoteSignerError
+
+_SERVICE = "tendermint.privval.PrivValidatorAPI"
+
+
+def _bv(d: dict, f: int) -> bytes:
+    v = d.get(f)
+    return v[0] if v and isinstance(v[0], bytes) else b""
+
+
+def _sv(d: dict, f: int) -> str:
+    return _bv(d, f).decode("utf-8", "replace")
+
+
+class GRPCSignerServer:
+    """Runs next to the key (reference privval/grpc/server.go)."""
+
+    def __init__(self, pv, logger: Logger | None = None):
+        self.pv = pv
+        self.logger = logger or nop_logger()
+        self._server: grpc.aio.Server | None = None
+        self.addr: str | None = None
+
+    async def start(self, laddr: str) -> str:
+        target = laddr.split("://", 1)[-1]
+        pv = self.pv
+
+        async def get_pub_key(request: bytes, context) -> bytes:
+            try:
+                return ProtoWriter().bytes_(1, pv.get_pub_key().bytes_()).bytes_out()
+            except Exception as e:
+                return ProtoWriter().string(2, str(e)).bytes_out()
+
+        async def sign_vote(request: bytes, context) -> bytes:
+            d = fields_to_dict(request)
+            try:
+                vote = Vote.decode(_bv(d, 1))
+                pv.sign_vote(_sv(d, 2), vote)
+                return ProtoWriter().bytes_(1, vote.encode()).bytes_out()
+            except Exception as e:
+                return ProtoWriter().string(2, str(e)).bytes_out()
+
+        async def sign_proposal(request: bytes, context) -> bytes:
+            d = fields_to_dict(request)
+            try:
+                prop = Proposal.decode(_bv(d, 1))
+                pv.sign_proposal(_sv(d, 2), prop)
+                return ProtoWriter().bytes_(1, prop.encode()).bytes_out()
+            except Exception as e:
+                return ProtoWriter().string(2, str(e)).bytes_out()
+
+        handlers = {
+            "GetPubKey": grpc.unary_unary_rpc_method_handler(
+                get_pub_key, request_deserializer=None, response_serializer=None),
+            "SignVote": grpc.unary_unary_rpc_method_handler(
+                sign_vote, request_deserializer=None, response_serializer=None),
+            "SignProposal": grpc.unary_unary_rpc_method_handler(
+                sign_proposal, request_deserializer=None, response_serializer=None),
+        }
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),))
+        port = self._server.add_insecure_port(target)
+        await self._server.start()
+        self.addr = f"{target.rsplit(':', 1)[0]}:{port}"
+        self.logger.info("gRPC signer listening", addr=self.addr)
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+
+
+class GRPCSignerClient:
+    """types.PrivValidator in the node, dialing the signer's gRPC server
+    (reference privval/grpc/client.go).  Blocking sync stubs: signing
+    sits on the consensus critical path, same as the reference."""
+
+    def __init__(self, laddr: str, timeout: float = 5.0,
+                 logger: Logger | None = None):
+        self.laddr = laddr.split("://", 1)[-1]
+        self.timeout = timeout
+        self.logger = logger or nop_logger()
+        self._channel: grpc.Channel | None = None
+        self._cached_pub = None
+
+    def connect(self, timeout: float = 30.0) -> None:
+        self._channel = grpc.insecure_channel(self.laddr)
+        try:
+            grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        except grpc.FutureTimeoutError:
+            raise RemoteSignerError(
+                f"cannot reach gRPC signer at {self.laddr}") from None
+        self._cached_pub = self._get_pub_key()
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+    def _call(self, method: str, body: bytes) -> dict:
+        if self._channel is None:
+            raise RemoteSignerError("signer not connected")
+        fn = self._channel.unary_unary(f"/{_SERVICE}/{method}")
+        try:
+            raw = fn(body, timeout=self.timeout)
+        except grpc.RpcError as e:
+            raise RemoteSignerError(f"signer rpc: {e.code()}") from None
+        d = fields_to_dict(raw)
+        err = _sv(d, 2)
+        if err:
+            raise RemoteSignerError(err)
+        return d
+
+    def _get_pub_key(self):
+        from tendermint_tpu.crypto.keys import PubKey
+
+        d = self._call("GetPubKey", b"")
+        return PubKey(_bv(d, 1))
+
+    # -- PrivValidator interface -----------------------------------------
+    def get_pub_key(self):
+        if self._cached_pub is None:
+            raise RemoteSignerError("signer not connected (pubkey not primed)")
+        return self._cached_pub
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        body = ProtoWriter().bytes_(1, vote.encode()).string(2, chain_id).bytes_out()
+        d = self._call("SignVote", body)
+        signed = Vote.decode(_bv(d, 1))
+        vote.signature = signed.signature
+        vote.timestamp_ns = signed.timestamp_ns
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        body = (ProtoWriter().bytes_(1, proposal.encode())
+                .string(2, chain_id).bytes_out())
+        d = self._call("SignProposal", body)
+        signed = Proposal.decode(_bv(d, 1))
+        proposal.signature = signed.signature
+        proposal.timestamp_ns = signed.timestamp_ns

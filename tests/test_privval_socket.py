@@ -134,6 +134,73 @@ class _SignerThread:
         self.thread.join(timeout=5)
 
 
+def test_grpc_signer_roundtrip_and_node(tmp_path):
+    """gRPC signer: sign round-trip with double-sign protection, then a
+    node producing blocks against it (reference privval/grpc)."""
+    import threading
+
+    from tendermint_tpu.privval.grpc_pv import GRPCSignerClient, GRPCSignerServer
+
+    async def run():
+        key = priv_key_from_seed(b"\x44" * 32)
+        signer_home = tmp_path / "signer"
+        signer_home.mkdir()
+        pv = FilePV(key, str(signer_home / "k.json"), str(signer_home / "s.json"))
+        pv.save_key()
+        pv.state.save()
+
+        # signer on its own thread+loop (separate-process topology in-proc)
+        loop = asyncio.new_event_loop()
+        server = GRPCSignerServer(pv)
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        addr = asyncio.run_coroutine_threadsafe(
+            server.start("127.0.0.1:0"), loop).result(10)
+        try:
+            client = GRPCSignerClient(addr)
+            await asyncio.to_thread(client.connect)
+            assert client.get_pub_key() == key.pub_key()
+            v = _vote(3)
+            await asyncio.to_thread(client.sign_vote, "grpc-pv-chain", v)
+            assert key.pub_key().verify_signature(
+                v.sign_bytes("grpc-pv-chain"), v.signature)
+            with pytest.raises(RemoteSignerError, match="regression"):
+                await asyncio.to_thread(client.sign_vote, "grpc-pv-chain", _vote(2))
+            client.close()
+
+            # fresh sign-state for the node phase: the guard above already
+            # advanced this signer to height 3 (a real deployment never
+            # shares one signer state across chains)
+            pv.state.height = 0
+            pv.state.round = 0
+            pv.state.step = 0
+            pv.state.signature = b""
+            pv.state.sign_bytes = b""
+            pv.state.save()
+
+            # full node against the grpc signer
+            gen = GenesisDoc(
+                chain_id="grpc-pv-net",
+                genesis_time_ns=1_700_000_000 * 10**9,
+                validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+            )
+            cfg = make_test_config(str(tmp_path / "node"))
+            cfg.base.fast_sync = False
+            cfg.base.priv_validator_laddr = f"grpc://{addr}"
+            node = Node(cfg, genesis=gen)
+            await node.start()
+            try:
+                await node.wait_for_height(2, timeout=60)
+            finally:
+                await node.stop()
+        finally:
+            asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(timeout=5)
+
+    asyncio.run(run())
+
+
 def test_node_with_remote_signer_produces_blocks(tmp_path):
     async def run():
         key = priv_key_from_seed(b"\x43" * 32)
