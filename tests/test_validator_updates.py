@@ -1,0 +1,144 @@
+"""Dynamic validator sets: ABCI EndBlock updates flowing through
+consensus (effective H+2), proposer-priority distribution properties.
+
+Scenario parity: reference types/validator_set_test.go (1711 lines —
+proposer distribution ∝ power, new-validator priority penalty) and
+test/e2e validator_update schedules + persistent_kvstore ValSetChange.
+"""
+
+import asyncio
+from collections import Counter
+
+import pytest
+
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.validator import Validator, ValidatorSet
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+# ---------------------------------------------------------------------------
+# proposer-priority properties (pure)
+# ---------------------------------------------------------------------------
+
+def _mkset(powers):
+    keys = [priv_key_from_seed(bytes([0xA1 + i]) * 32) for i in range(len(powers))]
+    return ValidatorSet([Validator(pub_key=k.pub_key(), voting_power=p)
+                         for k, p in zip(keys, powers)]), keys
+
+
+def test_proposer_frequency_proportional_to_power():
+    vals, _ = _mkset([1, 2, 3, 4])
+    counts = Counter()
+    rounds = 1000
+    for _ in range(rounds):
+        counts[vals.get_proposer().address] += 1
+        vals.increment_proposer_priority(1)
+    by_power = sorted(counts.values())
+    # a-priori weighted round-robin: exact proportions over long runs
+    assert by_power == [100, 200, 300, 400], by_power
+
+
+def test_new_validator_does_not_immediately_propose():
+    """A freshly-added validator starts with a priority penalty and must
+    wait its turn (reference TestValidatorSetUpdatePriorityOrder)."""
+    vals, _ = _mkset([10, 10, 10])
+    newcomer = priv_key_from_seed(b"\xee" * 32)
+    vals.update_with_change_set(
+        [Validator(pub_key=newcomer.pub_key(), voting_power=10)]
+    )
+    assert len(vals.validators) == 4
+    # the newcomer is not the first proposer after joining
+    first_proposers = []
+    for _ in range(3):
+        first_proposers.append(vals.get_proposer().address)
+        vals.increment_proposer_priority(1)
+    assert newcomer.pub_key().address() not in first_proposers
+
+
+def test_priorities_stay_centered_and_bounded():
+    vals, _ = _mkset([5, 10, 200])
+    total = vals.total_voting_power()
+    for _ in range(500):
+        vals.increment_proposer_priority(1)
+        pris = [v.proposer_priority for v in vals.validators]
+        # centering: sum stays near zero; bound: |pri| <= 2*total
+        assert abs(sum(pris)) <= total, pris
+        assert all(abs(p) <= 2 * total for p in pris), pris
+
+
+# ---------------------------------------------------------------------------
+# consensus-driven set change (ABCI EndBlock → H+2)
+# ---------------------------------------------------------------------------
+
+def test_validator_set_change_through_consensus(tmp_path):
+    async def run():
+        key = priv_key_from_seed(b"\xa5" * 32)
+        gen = GenesisDoc(
+            chain_id="valup-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.base.fast_sync = False
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = key
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        try:
+            await node.wait_for_height(1, timeout=30)
+            # add a second validator (offline; power below 1/3 so the
+            # chain keeps committing) via the kvstore val: tx
+            new_key = priv_key_from_seed(b"\xa6" * 32)
+            tx = b"val:" + new_key.pub_key().bytes_().hex().encode() + b"!3"
+            res = node.mempool.check_tx(tx)
+            assert res.code == 0, res.log
+
+            # find the height that included the tx
+            deadline = asyncio.get_running_loop().time() + 30
+            included = None
+            while included is None:
+                for h in range(1, node.block_store.height() + 1):
+                    b = node.block_store.load_block(h)
+                    if b and any(bytes(t) == tx for t in b.data.txs):
+                        included = h
+                if included is None:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise AssertionError("val tx never committed")
+                    await asyncio.sleep(0.1)
+
+            await node.wait_for_height(included + 3, timeout=30)
+
+            # effective H+2 (reference state/execution.go:406: updates
+            # land in NextValidators, used at H+2)
+            before = node.state_store.load_validators(included + 1)
+            after = node.state_store.load_validators(included + 2)
+            assert len(before.validators) == 1
+            assert len(after.validators) == 2
+            _, v = after.get_by_address(new_key.pub_key().address())
+            assert v is not None and v.voting_power == 3
+
+            # headers advertise the change one height ahead
+            meta = node.block_store.load_block_meta(included + 1)
+            assert meta.header.next_validators_hash == after.hash()
+
+            # remove the validator again (power 0)
+            tx2 = b"val:" + new_key.pub_key().bytes_().hex().encode() + b"!0"
+            assert node.mempool.check_tx(tx2).code == 0
+            h0 = node.block_store.height()
+            await node.wait_for_height(h0 + 4, timeout=30)
+            final = node.state_store.load_validators(node.block_store.height())
+            assert len(final.validators) == 1
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
