@@ -67,18 +67,28 @@ class CPUBatchVerifier(_BaseBatch):
 
 
 class JAXBatchVerifier(_BaseBatch):
-    """One XLA device program verifies the entire batch (vmapped, bucketed)."""
+    """One XLA device program verifies the entire batch (vmapped, bucketed).
 
-    def __init__(self) -> None:
+    Batches below `cpu_threshold` run on the CPU reference instead: the
+    host→device round trip dwarfs a handful of verifies, and consensus
+    liveness depends on small vote batches staying sub-millisecond
+    (SURVEY §7 hard part 2 — deadline flush with CPU fallback for
+    singletons)."""
+
+    def __init__(self, cpu_threshold: int = 64) -> None:
         super().__init__()
         from tendermint_tpu.ops import ed25519_jax  # lazy: jax import
 
         self._impl = ed25519_jax
+        self.cpu_threshold = cpu_threshold
 
     def verify(self) -> tuple[bool, list[bool]]:
         pubs, msgs, sigs = self._take()
         if not pubs:
             return False, []
+        if len(pubs) < self.cpu_threshold:
+            oks = _ed.verify_batch_reference(pubs, msgs, sigs)
+            return all(oks) if oks else False, oks
         oks = self._impl.verify_batch(pubs, msgs, sigs)
         return bool(all(oks)), [bool(v) for v in oks]
 
