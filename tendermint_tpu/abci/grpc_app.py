@@ -23,7 +23,7 @@ from tendermint_tpu.utils.log import Logger, nop_logger
 
 from . import types as abci
 from . import wire
-from .socket import SocketServer  # reuse its _dispatch
+from .socket import dispatch_request
 
 _SERVICE = "tendermint.abci.ABCIApplication"
 
@@ -50,17 +50,20 @@ class GRPCAppServer:
     """Serves an Application over gRPC (reference grpc_server.go)."""
 
     def __init__(self, app: abci.Application, logger: Logger | None = None):
+        import threading
+
         self.app = app
         self.logger = logger or nop_logger()
-        self._dispatcher = SocketServer(app, logger=self.logger)
+        self._lock = threading.Lock()
         self._server: grpc.aio.Server | None = None
         self.addr: str | None = None
 
     async def start(self, laddr: str) -> str:
         import asyncio
 
-        target = laddr.split("://", 1)[-1]
-        dispatcher = self._dispatcher
+        from tendermint_tpu.utils.grpc_util import start_generic_server
+
+        app, lock = self.app, self._lock
 
         def make_handler(expected_kind: int):
             async def handler(request: bytes, context) -> bytes:
@@ -71,7 +74,7 @@ class GRPCAppServer:
                         f"method expects kind {expected_kind}, got {kind}")
                 try:
                     resp_kind, resp = await asyncio.to_thread(
-                        dispatcher._dispatch, kind, req)
+                        dispatch_request, app, lock, kind, req)
                 except Exception as e:
                     self.logger.error("ABCI gRPC app exception", err=str(e))
                     resp_kind, resp = wire.EXCEPTION, str(e)
@@ -79,25 +82,17 @@ class GRPCAppServer:
 
             return handler
 
-        handlers = {
-            name: grpc.unary_unary_rpc_method_handler(
-                make_handler(kind), request_deserializer=None,
-                response_serializer=None)
-            for name, kind in _METHODS.items()
-        }
-        self._server = grpc.aio.server()
-        self._server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(_SERVICE, handlers),))
-        port = self._server.add_insecure_port(target)
-        await self._server.start()
-        self.addr = f"{target.rsplit(':', 1)[0]}:{port}"
+        handlers = {name: make_handler(kind) for name, kind in _METHODS.items()}
+        self._server, self.addr = await start_generic_server(
+            _SERVICE, handlers, laddr)
         self.logger.info("ABCI gRPC server listening", addr=self.addr)
         return self.addr
 
     async def stop(self) -> None:
-        if self._server is not None:
-            await self._server.stop(grace=1.0)
-            self._server = None
+        from tendermint_tpu.utils.grpc_util import stop_server
+
+        await stop_server(self._server)
+        self._server = None
 
 
 class GRPCAppClient:

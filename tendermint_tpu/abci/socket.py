@@ -38,6 +38,47 @@ class ABCIServerError(Exception):
     pass
 
 
+def dispatch_request(app: abci.Application, lock: threading.Lock,
+                     kind: int, req) -> tuple[int, object]:
+    """Dispatch one decoded ABCI request to the app under `lock`
+    (shared by the socket and gRPC transports; the single lock
+    serializes app access across connections like the reference
+    socket_server.go appMtx)."""
+    with lock:
+        if kind == wire.ECHO:
+            return kind, req
+        if kind == wire.FLUSH:
+            return kind, None
+        if kind == wire.INFO:
+            return kind, app.info(req)
+        if kind == wire.INIT_CHAIN:
+            return kind, app.init_chain(req)
+        if kind == wire.QUERY:
+            return kind, app.query(req)
+        if kind == wire.BEGIN_BLOCK:
+            return kind, app.begin_block(req)
+        if kind == wire.CHECK_TX:
+            return kind, app.check_tx(req)
+        if kind == wire.DELIVER_TX:
+            return kind, app.deliver_tx(req)
+        if kind == wire.END_BLOCK:
+            return kind, app.end_block(req)
+        if kind == wire.COMMIT:
+            return kind, app.commit()
+        if kind == wire.LIST_SNAPSHOTS:
+            return kind, app.list_snapshots()
+        if kind == wire.OFFER_SNAPSHOT:
+            snapshot, app_hash = req
+            return kind, app.offer_snapshot(snapshot, app_hash)
+        if kind == wire.LOAD_SNAPSHOT_CHUNK:
+            h, f, c = req
+            return kind, app.load_snapshot_chunk(h, f, c)
+        if kind == wire.APPLY_SNAPSHOT_CHUNK:
+            i, c, s = req
+            return kind, app.apply_snapshot_chunk(i, c, s)
+        raise ABCIServerError(f"unknown request kind {kind}")
+
+
 # ---------------------------------------------------------------------------
 # server
 # ---------------------------------------------------------------------------
@@ -133,40 +174,7 @@ class SocketServer:
                 pass
 
     def _dispatch(self, kind: int, req) -> tuple[int, object]:
-        app = self.app
-        with self._lock:
-            if kind == wire.ECHO:
-                return kind, req
-            if kind == wire.FLUSH:
-                return kind, None
-            if kind == wire.INFO:
-                return kind, app.info(req)
-            if kind == wire.INIT_CHAIN:
-                return kind, app.init_chain(req)
-            if kind == wire.QUERY:
-                return kind, app.query(req)
-            if kind == wire.BEGIN_BLOCK:
-                return kind, app.begin_block(req)
-            if kind == wire.CHECK_TX:
-                return kind, app.check_tx(req)
-            if kind == wire.DELIVER_TX:
-                return kind, app.deliver_tx(req)
-            if kind == wire.END_BLOCK:
-                return kind, app.end_block(req)
-            if kind == wire.COMMIT:
-                return kind, app.commit()
-            if kind == wire.LIST_SNAPSHOTS:
-                return kind, app.list_snapshots()
-            if kind == wire.OFFER_SNAPSHOT:
-                snapshot, app_hash = req
-                return kind, app.offer_snapshot(snapshot, app_hash)
-            if kind == wire.LOAD_SNAPSHOT_CHUNK:
-                h, f, c = req
-                return kind, app.load_snapshot_chunk(h, f, c)
-            if kind == wire.APPLY_SNAPSHOT_CHUNK:
-                i, c, s = req
-                return kind, app.apply_snapshot_chunk(i, c, s)
-            raise ABCIServerError(f"unknown request kind {kind}")
+        return dispatch_request(self.app, self._lock, kind, req)
 
 
 # ---------------------------------------------------------------------------

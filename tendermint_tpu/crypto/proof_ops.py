@@ -94,16 +94,19 @@ def _decode_proof(data: bytes) -> merkle.Proof:
 
 def key_path(*keys: bytes) -> str:
     """Encode store keys into a /-separated URL-encoded path, outermost
-    first (reference KeyPath.String)."""
-    return "/" + "/".join(urllib.parse.quote(k.decode("latin-1"), safe="")
-                          for k in keys)
+    first (reference KeyPath.String).  Percent-escapes are RAW BYTES
+    (0xFF → %FF), never UTF-8 — wire compatibility with the reference."""
+    return "/" + "/".join(urllib.parse.quote(bytes(k), safe="") for k in keys)
 
 
 def parse_key_path(path: str) -> list[bytes]:
     if not path.startswith("/"):
         raise ProofError(f"key path must start with '/': {path!r}")
-    return [urllib.parse.unquote(seg).encode("latin-1")
-            for seg in path.split("/")[1:] if seg]
+    try:
+        return [urllib.parse.unquote_to_bytes(seg)
+                for seg in path.split("/")[1:] if seg]
+    except (ValueError, UnicodeError) as e:
+        raise ProofError(f"bad key path {path!r}: {e}") from None
 
 
 # -- runtime (reference proof_op.go ProofRuntime) ---------------------------

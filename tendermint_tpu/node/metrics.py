@@ -99,13 +99,13 @@ class NodeMetrics:
 
         self.p2p_recv_bytes = reg.register(LabeledCallbackGauge(
             "message_receive_bytes_total", "Bytes received per channel",
-            namespace=ns, subsystem="p2p",
+            namespace=ns, subsystem="p2p", kind="counter",
             fn=lambda: [({"chID": f"{cid:#x}"}, v)
                         for cid, v in sorted(node.router.bytes_received.items())],
         ))
         self.p2p_send_bytes = reg.register(LabeledCallbackGauge(
             "message_send_bytes_total", "Bytes sent per channel",
-            namespace=ns, subsystem="p2p",
+            namespace=ns, subsystem="p2p", kind="counter",
             fn=lambda: [({"chID": f"{cid:#x}"}, v)
                         for cid, v in sorted(node.router.bytes_sent.items())],
         ))

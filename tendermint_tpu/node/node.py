@@ -164,6 +164,7 @@ class Node:
 
         # -- validator key ---------------------------------------------
         self.priv_validator = None
+        self._pv_remote = ""  # "" (local file) | "socket" | "grpc"
         if not config.base.priv_validator_laddr:
             self.priv_validator = load_or_gen_file_pv(
                 config.priv_validator_key_file, config.priv_validator_state_file
@@ -176,6 +177,7 @@ class Node:
             self.priv_validator = GRPCSignerClient(
                 config.base.priv_validator_laddr, logger=self.logger
             )
+            self._pv_remote = "grpc"
         else:
             # socket signer: the node listens, the signer process dials in
             # (reference node/node.go:695-710 + privval/signer_client.go)
@@ -184,6 +186,7 @@ class Node:
             host, port = _parse_laddr(config.base.priv_validator_laddr)
             self.priv_validator = SignerClient(host, port, logger=self.logger)
             self.priv_validator.start()
+            self._pv_remote = "socket"
 
         # -- p2p ---------------------------------------------------------
         self.node_key = load_or_gen_node_key(config.node_key_file)
@@ -365,13 +368,10 @@ class Node:
         if self._started:
             raise RuntimeError("node already started")
         self._started = True
-        from tendermint_tpu.privval.grpc_pv import GRPCSignerClient
-        from tendermint_tpu.privval.socket_pv import SignerClient
-
-        if isinstance(self.priv_validator, SignerClient):
+        if self._pv_remote == "socket":
             # block until the remote signer dials in and the pubkey primes
             await asyncio.to_thread(self.priv_validator.wait_for_signer, 30.0)
-        elif isinstance(self.priv_validator, GRPCSignerClient):
+        elif self._pv_remote == "grpc":
             await asyncio.to_thread(self.priv_validator.connect, 30.0)
         await self.indexer_service.start()
         if self.config.rpc.laddr:
@@ -524,10 +524,7 @@ class Node:
             await self.grpc_server.stop()
         if self.metrics is not None:
             await self.metrics.stop()
-        from tendermint_tpu.privval.grpc_pv import GRPCSignerClient
-        from tendermint_tpu.privval.socket_pv import SignerClient
-
-        if isinstance(self.priv_validator, (SignerClient, GRPCSignerClient)):
+        if self._pv_remote:
             await asyncio.to_thread(self.priv_validator.close)
         await self.indexer_service.stop()
         self.event_bus.shutdown()

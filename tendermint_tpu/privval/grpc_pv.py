@@ -69,27 +69,23 @@ class GRPCSignerServer:
             except Exception as e:
                 return ProtoWriter().string(2, str(e)).bytes_out()
 
+        from tendermint_tpu.utils.grpc_util import start_generic_server
+
         handlers = {
-            "GetPubKey": grpc.unary_unary_rpc_method_handler(
-                get_pub_key, request_deserializer=None, response_serializer=None),
-            "SignVote": grpc.unary_unary_rpc_method_handler(
-                sign_vote, request_deserializer=None, response_serializer=None),
-            "SignProposal": grpc.unary_unary_rpc_method_handler(
-                sign_proposal, request_deserializer=None, response_serializer=None),
+            "GetPubKey": get_pub_key,
+            "SignVote": sign_vote,
+            "SignProposal": sign_proposal,
         }
-        self._server = grpc.aio.server()
-        self._server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(_SERVICE, handlers),))
-        port = self._server.add_insecure_port(target)
-        await self._server.start()
-        self.addr = f"{target.rsplit(':', 1)[0]}:{port}"
+        self._server, self.addr = await start_generic_server(
+            _SERVICE, handlers, target)
         self.logger.info("gRPC signer listening", addr=self.addr)
         return self.addr
 
     async def stop(self) -> None:
-        if self._server is not None:
-            await self._server.stop(grace=1.0)
-            self._server = None
+        from tendermint_tpu.utils.grpc_util import stop_server
+
+        await stop_server(self._server)
+        self._server = None
 
 
 class GRPCSignerClient:

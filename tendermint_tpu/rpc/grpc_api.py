@@ -81,25 +81,18 @@ class GRPCBroadcastServer:
                     .message(2, _encode_tx_result(res["deliver_tx"]), always=True)
                     .bytes_out())
 
-        handler = grpc.method_handlers_generic_handler(_SERVICE, {
-            "Ping": grpc.unary_unary_rpc_method_handler(
-                ping, request_deserializer=None, response_serializer=None),
-            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
-                broadcast_tx, request_deserializer=None, response_serializer=None),
-        })
-        self._server = grpc.aio.server()
-        self._server.add_generic_rpc_handlers((handler,))
-        port = self._server.add_insecure_port(target)
-        await self._server.start()
-        host = target.rsplit(":", 1)[0]
-        self.addr = f"{host}:{port}"
+        from tendermint_tpu.utils.grpc_util import start_generic_server
+
+        self._server, self.addr = await start_generic_server(
+            _SERVICE, {"Ping": ping, "BroadcastTx": broadcast_tx}, target)
         self.logger.info("gRPC broadcast API listening", addr=self.addr)
         return self.addr
 
     async def stop(self) -> None:
-        if self._server is not None:
-            await self._server.stop(grace=1.0)
-            self._server = None
+        from tendermint_tpu.utils.grpc_util import stop_server
+
+        await stop_server(self._server)
+        self._server = None
 
 
 class GRPCBroadcastClient:

@@ -1,0 +1,30 @@
+"""Shared grpc.aio serving scaffold for the framework's generic-handler
+services (ABCI app transport, privval signer, RPC broadcast API)."""
+
+from __future__ import annotations
+
+import grpc
+
+
+async def start_generic_server(service: str, handlers: dict, laddr: str
+                               ) -> tuple[grpc.aio.Server, str]:
+    """Start a grpc.aio server exposing `handlers` (method name →
+    async fn(bytes, context) -> bytes) on `laddr` (tcp://host:port or
+    host:port; port 0 = ephemeral).  Returns (server, bound_addr)."""
+    target = laddr.split("://", 1)[-1]
+    rpc_handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=None, response_serializer=None)
+        for name, fn in handlers.items()
+    }
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service, rpc_handlers),))
+    port = server.add_insecure_port(target)
+    await server.start()
+    return server, f"{target.rsplit(':', 1)[0]}:{port}"
+
+
+async def stop_server(server: grpc.aio.Server | None, grace: float = 1.0) -> None:
+    if server is not None:
+        await server.stop(grace=grace)

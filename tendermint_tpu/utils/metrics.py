@@ -140,14 +140,18 @@ class Histogram(_Metric):
 
 
 class LabeledCallbackGauge(_Metric):
-    """Gauge whose labeled samples come from a callback evaluated at
-    scrape time: fn() -> list[(labels_dict, value)]."""
+    """Metric whose labeled samples come from a callback evaluated at
+    scrape time: fn() -> list[(labels_dict, value)].  kind defaults to
+    gauge; pass kind="counter" for monotonically increasing *_total
+    series so the exposition type matches."""
 
     kind = "gauge"
 
-    def __init__(self, *args, fn: Callable[[], list] = None, **kw):
+    def __init__(self, *args, fn: Callable[[], list] = None,
+                 kind: str = "gauge", **kw):
         super().__init__(*args, **kw)
         self._fn = fn
+        self.kind = kind
 
     def samples(self):
         try:

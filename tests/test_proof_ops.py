@@ -24,6 +24,9 @@ def test_key_path_roundtrip():
     assert parse_key_path(p) == keys
     with pytest.raises(ProofError):
         parse_key_path("no-leading-slash")
+    # wire format: RAW byte escapes, never UTF-8 (reference KeyPath)
+    assert key_path(b"\xff") == "/%FF"
+    assert parse_key_path("/%FF") == [b"\xff"]
 
 
 def test_single_store_value_proof():
