@@ -1,0 +1,78 @@
+"""OpenPGP-style ASCII armor (RFC 4880 §6) for key material at rest.
+
+Parity: reference crypto/armor/armor.go (EncodeArmor/DecodeArmor over
+golang.org/x/crypto/openpgp/armor): BEGIN/END lines around optional
+`Key: Value` headers, a blank line, base64 body wrapped at 64 columns,
+and an `=`-prefixed base64 CRC-24 (the OpenPGP polynomial) checksum.
+"""
+
+from __future__ import annotations
+
+import base64
+
+_CRC24_INIT = 0xB704CE
+_CRC24_POLY = 0x1864CFB
+
+
+def _crc24(data: bytes) -> int:
+    crc = _CRC24_INIT
+    for b in data:
+        crc ^= b << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= _CRC24_POLY
+    return crc & 0xFFFFFF
+
+
+def encode_armor(block_type: str, headers: dict[str, str], data: bytes) -> str:
+    lines = [f"-----BEGIN {block_type}-----"]
+    for k in sorted(headers):
+        lines.append(f"{k}: {headers[k]}")
+    lines.append("")
+    body = base64.b64encode(data).decode()
+    lines.extend(body[i : i + 64] for i in range(0, len(body), 64))
+    crc = _crc24(data).to_bytes(3, "big")
+    lines.append("=" + base64.b64encode(crc).decode())
+    lines.append(f"-----END {block_type}-----")
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(armor_str: str) -> tuple[str, dict[str, str], bytes]:
+    """Returns (block_type, headers, data); raises ValueError on any
+    structural or checksum failure."""
+    lines = [ln.rstrip("\r") for ln in armor_str.strip().split("\n")]
+    if not lines or not lines[0].startswith("-----BEGIN ") or not lines[0].endswith("-----"):
+        raise ValueError("invalid armor: missing BEGIN line")
+    block_type = lines[0][len("-----BEGIN ") : -len("-----")]
+    end = f"-----END {block_type}-----"
+    if lines[-1] != end:
+        raise ValueError(f"invalid armor: missing {end!r}")
+
+    headers: dict[str, str] = {}
+    i = 1
+    while i < len(lines) - 1 and lines[i]:
+        if ":" not in lines[i]:
+            break  # no blank separator and no header — body starts here
+        k, v = lines[i].split(":", 1)
+        headers[k.strip()] = v.strip()
+        i += 1
+    if i < len(lines) - 1 and not lines[i]:
+        i += 1  # blank separator
+
+    body_lines = []
+    checksum = None
+    for ln in lines[i:-1]:
+        if ln.startswith("="):
+            checksum = ln[1:]
+        elif ln:
+            body_lines.append(ln)
+    try:
+        data = base64.b64decode("".join(body_lines), validate=True)
+    except Exception as e:
+        raise ValueError(f"invalid armor body: {e}") from e
+    if checksum is not None:
+        want = base64.b64encode(_crc24(data).to_bytes(3, "big")).decode()
+        if checksum != want:
+            raise ValueError("invalid armor: CRC mismatch")
+    return block_type, headers, data

@@ -1,0 +1,228 @@
+"""Symmetric AEAD helpers: XChaCha20-Poly1305 and XSalsa20-Poly1305.
+
+Parity: reference crypto/xchacha20poly1305/xchachapoly.go (24-byte-nonce
+AEAD built from HChaCha20 + ChaCha20-Poly1305) and
+crypto/xsalsa20symmetric/symmetric.go (NaCl secretbox with the nonce
+prepended to the ciphertext; secret = 32 bytes, e.g. SHA-256 of a
+passphrase KDF).  These protect key material at rest — host-side, small
+inputs — so the extended-nonce cores (HChaCha20, Salsa20) are pure
+Python; the bulk AEAD under XChaCha20 is delegated to the C-backed
+ChaCha20-Poly1305 in `cryptography`.
+
+The ChaCha quarter-round core is differentially tested against
+`cryptography`'s ChaCha20 keystream; the Salsa core (no independent
+implementation available in-image) is pinned by a regression
+known-answer vector that was cross-checked once against NaCl's
+crypto_secretbox KAT (tests/test_symmetric.py).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.poly1305 import Poly1305
+
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)  # "expand 32-byte k"
+_MASK = 0xFFFFFFFF
+
+KEY_SIZE = 32
+XCHACHA_NONCE_SIZE = 24
+XSALSA_NONCE_SIZE = 24
+TAG_SIZE = 16
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & _MASK
+
+
+# ---------------------------------------------------------------------------
+# ChaCha20 core / HChaCha20
+# ---------------------------------------------------------------------------
+
+def _chacha_rounds(state: list[int]) -> list[int]:
+    """20 rounds (10 column+diagonal double-rounds) WITHOUT the final
+    feed-forward addition — the shared core of ChaCha20 and HChaCha20."""
+    x = list(state)
+
+    def qr(a, b, c, d):
+        x[a] = (x[a] + x[b]) & _MASK
+        x[d] = _rotl(x[d] ^ x[a], 16)
+        x[c] = (x[c] + x[d]) & _MASK
+        x[b] = _rotl(x[b] ^ x[c], 12)
+        x[a] = (x[a] + x[b]) & _MASK
+        x[d] = _rotl(x[d] ^ x[a], 8)
+        x[c] = (x[c] + x[d]) & _MASK
+        x[b] = _rotl(x[b] ^ x[c], 7)
+
+    for _ in range(10):
+        qr(0, 4, 8, 12)
+        qr(1, 5, 9, 13)
+        qr(2, 6, 10, 14)
+        qr(3, 7, 11, 15)
+        qr(0, 5, 10, 15)
+        qr(1, 6, 11, 12)
+        qr(2, 7, 8, 13)
+        qr(3, 4, 9, 14)
+    return x
+
+
+def chacha20_block(key: bytes, counter: int, nonce12: bytes) -> bytes:
+    """One 64-byte ChaCha20 keystream block (RFC 8439 layout); used only
+    by the differential tests to pin the core against `cryptography`."""
+    state = list(_SIGMA) + list(struct.unpack("<8L", key)) + [counter & _MASK] + list(
+        struct.unpack("<3L", nonce12)
+    )
+    x = _chacha_rounds(state)
+    out = [(a + b) & _MASK for a, b in zip(x, state)]
+    return struct.pack("<16L", *out)
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    """HChaCha20 subkey derivation (draft-irtf-cfrg-xchacha): run the
+    ChaCha core over (sigma, key, nonce16) and emit words 0-3 and 12-15
+    with no feed-forward."""
+    if len(key) != KEY_SIZE:
+        raise ValueError(f"key must be {KEY_SIZE} bytes, got {len(key)}")
+    if len(nonce16) != 16:
+        raise ValueError(f"HChaCha20 nonce must be 16 bytes, got {len(nonce16)}")
+    state = list(_SIGMA) + list(struct.unpack("<8L", key)) + list(
+        struct.unpack("<4L", nonce16)
+    )
+    x = _chacha_rounds(state)
+    return struct.pack("<8L", *(x[0:4] + x[12:16]))
+
+
+class XChaCha20Poly1305:
+    """24-byte-nonce AEAD (reference xchachapoly.go): derive a subkey via
+    HChaCha20(key, nonce[:16]), then ChaCha20-Poly1305 with the IETF
+    12-byte nonce 0x00000000 || nonce[16:24]."""
+
+    def __init__(self, key: bytes):
+        if len(key) != KEY_SIZE:
+            raise ValueError(f"xchacha20poly1305: bad key length {len(key)}")
+        self._key = bytes(key)
+
+    @property
+    def nonce_size(self) -> int:
+        return XCHACHA_NONCE_SIZE
+
+    def _inner(self, nonce: bytes) -> tuple[ChaCha20Poly1305, bytes]:
+        if len(nonce) != XCHACHA_NONCE_SIZE:
+            raise ValueError(f"xchacha20poly1305: bad nonce length {len(nonce)}")
+        subkey = hchacha20(self._key, nonce[:16])
+        return ChaCha20Poly1305(subkey), b"\x00\x00\x00\x00" + nonce[16:]
+
+    def seal(self, nonce: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
+        aead, n12 = self._inner(nonce)
+        return aead.encrypt(n12, plaintext, aad or None)
+
+    def open(self, nonce: bytes, ciphertext: bytes, aad: bytes = b"") -> bytes:
+        aead, n12 = self._inner(nonce)
+        return aead.decrypt(n12, ciphertext, aad or None)
+
+
+# ---------------------------------------------------------------------------
+# Salsa20 core / XSalsa20-Poly1305 secretbox
+# ---------------------------------------------------------------------------
+
+def _salsa_core(state: list[int], rounds: int = 20, feedforward: bool = True) -> list[int]:
+    x = list(state)
+
+    def qr(a, b, c, d):
+        x[b] ^= _rotl((x[a] + x[d]) & _MASK, 7)
+        x[c] ^= _rotl((x[b] + x[a]) & _MASK, 9)
+        x[d] ^= _rotl((x[c] + x[b]) & _MASK, 13)
+        x[a] ^= _rotl((x[d] + x[c]) & _MASK, 18)
+
+    for _ in range(rounds // 2):
+        # column round
+        qr(0, 4, 8, 12)
+        qr(5, 9, 13, 1)
+        qr(10, 14, 2, 6)
+        qr(15, 3, 7, 11)
+        # row round
+        qr(0, 1, 2, 3)
+        qr(5, 6, 7, 4)
+        qr(10, 11, 8, 9)
+        qr(15, 12, 13, 14)
+    if feedforward:
+        return [(a + b) & _MASK for a, b in zip(x, state)]
+    return x
+
+
+def _salsa_state(key: bytes, nonce_and_counter16: bytes) -> list[int]:
+    k = struct.unpack("<8L", key)
+    n = struct.unpack("<4L", nonce_and_counter16)
+    # Salsa20 matrix: diagonal constants, key split 4/4 around nonce+counter
+    return [
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        n[2], n[3], _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    ]
+
+
+def hsalsa20(key: bytes, nonce16: bytes) -> bytes:
+    """HSalsa20 subkey derivation (NaCl): core without feed-forward,
+    emitting the diagonal words 0,5,10,15 and the input words 6-9."""
+    x = _salsa_core(_salsa_state(key, nonce16), feedforward=False)
+    return struct.pack("<8L", x[0], x[5], x[10], x[15], x[6], x[7], x[8], x[9])
+
+
+def _xsalsa20_keystream(key: bytes, nonce24: bytes, length: int) -> bytes:
+    subkey = hsalsa20(key, nonce24[:16])
+    out = bytearray()
+    counter = 0
+    while len(out) < length:
+        block_input = nonce24[16:24] + struct.pack("<Q", counter)
+        out += struct.pack("<16L", *_salsa_core(_salsa_state(subkey, block_input)))
+        counter += 1
+    return bytes(out[:length])
+
+
+def secretbox_seal(plaintext: bytes, nonce: bytes, key: bytes) -> bytes:
+    """NaCl crypto_secretbox (XSalsa20-Poly1305): returns tag || cipher.
+    The first 32 keystream bytes key the one-time Poly1305; the message
+    is XORed against the stream from offset 32."""
+    if len(key) != KEY_SIZE:
+        raise ValueError(f"secret must be {KEY_SIZE} bytes, got {len(key)}")
+    if len(nonce) != XSALSA_NONCE_SIZE:
+        raise ValueError(f"nonce must be {XSALSA_NONCE_SIZE} bytes, got {len(nonce)}")
+    stream = _xsalsa20_keystream(key, nonce, 32 + len(plaintext))
+    cipher = bytes(a ^ b for a, b in zip(plaintext, stream[32:]))
+    tag = Poly1305.generate_tag(stream[:32], cipher)
+    return tag + cipher
+
+
+def secretbox_open(boxed: bytes, nonce: bytes, key: bytes) -> bytes:
+    if len(key) != KEY_SIZE:
+        raise ValueError(f"secret must be {KEY_SIZE} bytes, got {len(key)}")
+    if len(nonce) != XSALSA_NONCE_SIZE:
+        raise ValueError(f"nonce must be {XSALSA_NONCE_SIZE} bytes, got {len(nonce)}")
+    if len(boxed) < TAG_SIZE:
+        raise ValueError("ciphertext is too short")
+    tag, cipher = boxed[:TAG_SIZE], boxed[TAG_SIZE:]
+    stream = _xsalsa20_keystream(key, nonce, 32 + len(cipher))
+    try:
+        Poly1305.verify_tag(stream[:32], cipher, tag)
+    except InvalidSignature:
+        raise ValueError("ciphertext decryption failed") from None
+    return bytes(a ^ b for a, b in zip(cipher, stream[32:]))
+
+
+def encrypt_symmetric(plaintext: bytes, secret: bytes) -> bytes:
+    """Reference EncryptSymmetric (symmetric.go:19-32): random 24-byte
+    nonce prepended; output is plaintext + 40 bytes (nonce + tag)."""
+    nonce = os.urandom(XSALSA_NONCE_SIZE)
+    return nonce + secretbox_seal(plaintext, nonce, secret)
+
+
+def decrypt_symmetric(ciphertext: bytes, secret: bytes) -> bytes:
+    """Reference DecryptSymmetric (symmetric.go:36-55)."""
+    if len(ciphertext) <= XSALSA_NONCE_SIZE + TAG_SIZE:
+        raise ValueError("ciphertext is too short")
+    nonce = ciphertext[:XSALSA_NONCE_SIZE]
+    return secretbox_open(ciphertext[XSALSA_NONCE_SIZE:], nonce, secret)
