@@ -1,0 +1,151 @@
+"""abci-cli: console/batch driver for exercising an ABCI server.
+
+Parity: reference abci/cmd/abci-cli/abci-cli.go — the conformance-test
+driver behind abci/tests/test_cli/: `batch` replays newline-separated
+commands from stdin, `console` is the interactive variant, and the
+single-shot commands (echo, info, check_tx, deliver_tx, query, commit)
+speak the socket ABCI protocol to a running server.  Output format
+matches printResponse (abci-cli.go:661-701): `-> code: OK`, `-> data:`,
+`-> data.hex: 0x…`, query key/value/height lines — so golden-file
+conformance suites work the same way (tests/data/*.abci[.out]).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.socket import SocketClient
+
+
+class CommandError(Exception):
+    """Bad command input; `lines` carries preformatted response output
+    (used by the unimplemented-command path so batch goldens match the
+    reference's cmdUnimplemented format)."""
+
+    def __init__(self, msg: str, lines: list[str] | None = None):
+        super().__init__(msg)
+        self.lines = lines or [f"-> code: 1", f"-> log: {msg}"]
+
+
+def string_or_hex_to_bytes(s: str) -> bytes:
+    """Reference stringOrHexToBytes (abci-cli.go:704-719): 0x-prefixed
+    hex, or a double-quoted literal."""
+    if len(s) > 2 and s[:2].lower() == "0x":
+        try:
+            return bytes.fromhex(s[2:])
+        except ValueError as e:
+            raise CommandError(f"error decoding hex argument: {e}") from None
+    if not (s.startswith('"') and s.endswith('"') and len(s) >= 2):
+        raise CommandError(
+            f'invalid string arg: "{s}". Must be quoted or a "0x"-prefixed hex string'
+        )
+    return s[1:-1].encode()
+
+
+def _fmt_response(cmd: str, *, code: int = 0, data: bytes = b"", log: str = "",
+                  query: abci.ResponseQuery | None = None) -> list[str]:
+    out = []
+    out.append("-> code: OK" if code == 0 else f"-> code: {code}")
+    if data:
+        if cmd != "commit":  # commit data is a raw app hash — hex only
+            out.append(f"-> data: {data.decode('utf-8', 'replace')}")
+        out.append(f"-> data.hex: 0x{data.hex().upper()}")
+    if log:
+        out.append(f"-> log: {log}")
+    if query is not None:
+        out.append(f"-> height: {query.height}")
+        if query.key:
+            out.append(f"-> key: {query.key.decode('utf-8', 'replace')}")
+            out.append(f"-> key.hex: {query.key.hex().upper()}")
+        if query.value:
+            out.append(f"-> value: {query.value.decode('utf-8', 'replace')}")
+            out.append(f"-> value.hex: {query.value.hex().upper()}")
+    return out
+
+
+def execute_line(client: SocketClient, line: str) -> list[str]:
+    """Run one `<command> [arg]` line; returns the printResponse lines.
+    Splits like the reference's persistentArgs (whitespace, quotes kept
+    as part of the token)."""
+    parts = line.strip().split(None, 1)
+    if not parts:
+        return []
+    cmd, rest = parts[0].lower(), (parts[1].strip() if len(parts) > 1 else "")
+
+    if cmd == "echo":
+        res = client.echo(rest)
+        return _fmt_response(cmd, data=res.encode())
+    if cmd == "info":
+        res = client.info_sync(abci.RequestInfo())
+        return _fmt_response(cmd, data=res.data.encode())
+    if cmd == "check_tx":
+        if not rest:
+            raise CommandError("want the tx to check: check_tx 'tx bytes'")
+        res = client.check_tx_sync(
+            abci.RequestCheckTx(tx=string_or_hex_to_bytes(rest), type=abci.CheckTxType.NEW)
+        )
+        return _fmt_response(cmd, code=res.code, data=res.data, log=res.log)
+    if cmd == "deliver_tx":
+        if not rest:
+            raise CommandError("want the tx to deliver: deliver_tx 'tx bytes'")
+        res = client.deliver_tx_sync(
+            abci.RequestDeliverTx(tx=string_or_hex_to_bytes(rest))
+        )
+        return _fmt_response(cmd, code=res.code, data=res.data, log=res.log)
+    if cmd == "query":
+        if not rest:
+            raise CommandError("want the query: query 'account'")
+        res = client.query_sync(
+            abci.RequestQuery(data=string_or_hex_to_bytes(rest), path="/store")
+        )
+        return _fmt_response(cmd, code=res.code, log=res.log, query=res)
+    if cmd == "commit":
+        res = client.commit_sync()
+        return _fmt_response(cmd, data=res.data)
+
+    raise CommandError(
+        f"unimplemented command args: [{line.strip()}]",
+        lines=[
+            "-> code: 1",
+            f"-> log: unimplemented command args: [{line.strip()}]",
+            "Available commands: echo info check_tx deliver_tx query commit",
+        ],
+    )
+
+
+def run_batch(client: SocketClient, in_stream, out_stream, *, echo_commands: bool = True) -> int:
+    """Reference cmdBatch (abci-cli.go:338-362) with --verbose semantics:
+    echo each command as `> cmd args`, then its response, then a blank
+    line."""
+    for raw in in_stream:
+        line = raw.rstrip("\n")
+        if not line.strip():
+            continue
+        if echo_commands:
+            out_stream.write(f"> {line.strip()}\n")
+        try:
+            for ln in execute_line(client, line):
+                out_stream.write(ln + "\n")
+        except CommandError as e:
+            for ln in e.lines:
+                out_stream.write(ln + "\n")
+        out_stream.write("\n")
+    return 0
+
+
+def run_console(client: SocketClient, in_stream, out_stream) -> int:
+    """Reference cmdConsole (abci-cli.go:364-380)."""
+    while True:
+        out_stream.write("> ")
+        out_stream.flush()
+        raw = in_stream.readline()
+        if not raw:
+            return 0
+        if not raw.strip():
+            continue
+        try:
+            for ln in execute_line(client, raw):
+                out_stream.write(ln + "\n")
+        except CommandError as e:
+            for ln in e.lines:
+                out_stream.write(ln + "\n")
+        out_stream.flush()

@@ -393,6 +393,45 @@ def cmd_abci_server(args) -> int:
     return 0
 
 
+def cmd_abci_cli(args) -> int:
+    """Console/batch/one-shot driver against an ABCI socket server
+    (reference abci/cmd/abci-cli: the conformance-test harness behind
+    abci/tests/test_cli/)."""
+    import sys
+
+    from tendermint_tpu.abci.cli import CommandError, execute_line, run_batch, run_console
+    from tendermint_tpu.abci.socket import SocketClient
+
+    client = SocketClient(args.address)
+    try:
+        client.connect()
+    except (ConnectionError, OSError) as e:
+        print(f"error connecting to {args.address}: {e}", file=sys.stderr)
+        return 1
+    try:
+        if args.abci_command == "batch":
+            return run_batch(client, sys.stdin, sys.stdout)
+        if args.abci_command == "console":
+            return run_console(client, sys.stdin, sys.stdout)
+        line = args.abci_command + (
+            " " + " ".join(args.abci_args) if args.abci_args else ""
+        )
+        try:
+            for ln in execute_line(client, line):
+                print(ln)
+        except CommandError as e:
+            for ln in e.lines:
+                print(ln)
+            return 1
+        return 0
+    except (ConnectionError, OSError, EOFError) as e:
+        # server dropped mid-command: report, don't traceback
+        print(f"error talking to {args.address}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+
+
 def cmd_light(args) -> int:
     """Run a light-client verifying proxy against a primary node
     (reference cmd/tendermint/commands/light.go)."""
@@ -490,6 +529,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--addr", default="tcp://127.0.0.1:26658")
     sp.add_argument("--transport", default="socket", choices=["socket", "grpc"])
     sp.set_defaults(fn=cmd_abci_server)
+
+    sp = sub.add_parser("abci-cli", help="console/batch driver for an ABCI server")
+    sp.add_argument("abci_command",
+                    help="batch | console | echo | info | check_tx | deliver_tx | query | commit")
+    sp.add_argument("abci_args", nargs="*", help="command argument (quoted or 0x-hex)")
+    sp.add_argument("--address", default="tcp://127.0.0.1:26658")
+    sp.set_defaults(fn=cmd_abci_cli)
 
     sp = sub.add_parser("light", help="run a light-client verifying proxy")
     sp.add_argument("chain_id")

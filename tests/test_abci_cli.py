@@ -1,0 +1,91 @@
+"""abci-cli conformance: golden-file batch runs against socket servers.
+
+Parity: reference abci/tests/test_cli/ (ex1.abci/ex2.abci golden
+outputs driven through `abci-cli batch`) and abci-cli.go arg parsing
+(stringOrHexToBytes).
+"""
+
+import asyncio
+import io
+import os
+import threading
+
+import pytest
+
+from tendermint_tpu.abci.cli import (
+    CommandError,
+    execute_line,
+    run_batch,
+    string_or_hex_to_bytes,
+)
+from tendermint_tpu.abci.kvstore import CounterApplication, KVStoreApplication
+from tendermint_tpu.abci.socket import SocketClient, SocketServer
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _run_batch_against(app, infile: str) -> str:
+    """Serve `app` on an ephemeral socket; drive the batch file through
+    a client in a worker thread (the client API is sync)."""
+    out = io.StringIO()
+
+    async def main():
+        srv = SocketServer(app)
+        await srv.start("tcp://127.0.0.1:0")
+        host, port = srv.addr
+        done = asyncio.Event()
+        loop = asyncio.get_running_loop()
+
+        def client_side():
+            c = SocketClient(f"tcp://{host}:{port}")
+            c.connect()
+            try:
+                with open(infile) as f:
+                    run_batch(c, f, out)
+            finally:
+                c.close()
+                loop.call_soon_threadsafe(done.set)
+
+        t = threading.Thread(target=client_side)
+        t.start()
+        await done.wait()
+        t.join()
+        await srv.stop()
+
+    asyncio.run(main())
+    return out.getvalue()
+
+
+def test_batch_kvstore_golden():
+    got = _run_batch_against(KVStoreApplication(), os.path.join(DATA, "abci_cli_ex1.abci"))
+    with open(os.path.join(DATA, "abci_cli_ex1.abci.out")) as f:
+        assert got == f.read()
+
+
+def test_batch_counter_golden():
+    got = _run_batch_against(
+        CounterApplication(serial=True), os.path.join(DATA, "abci_cli_ex2.abci")
+    )
+    with open(os.path.join(DATA, "abci_cli_ex2.abci.out")) as f:
+        assert got == f.read()
+
+
+def test_string_or_hex_to_bytes():
+    assert string_or_hex_to_bytes('"abc"') == b"abc"
+    assert string_or_hex_to_bytes("0x6162") == b"ab"
+    assert string_or_hex_to_bytes("0X6162") == b"ab"
+    assert string_or_hex_to_bytes('""') == b""
+    with pytest.raises(CommandError, match="quoted"):
+        string_or_hex_to_bytes("abc")
+    with pytest.raises(CommandError, match="hex"):
+        string_or_hex_to_bytes("0xzz")
+
+
+def test_execute_line_missing_args():
+    class NoClient:
+        pass
+
+    for cmd in ("check_tx", "deliver_tx", "query"):
+        with pytest.raises(CommandError):
+            execute_line(NoClient(), cmd)
+    assert execute_line(NoClient(), "   ") == []
