@@ -55,6 +55,9 @@ class RPCConfig:
     timeout_broadcast_tx_commit_ms: int = 10_000
     max_body_bytes: int = 1_000_000
     pprof_laddr: str = ""
+    # expose the unsafe control routes (dial_seeds, dial_peers,
+    # unsafe_flush_mempool) — reference config.RPC.Unsafe / routes.go:51-56
+    unsafe: bool = False
 
 
 @dataclass

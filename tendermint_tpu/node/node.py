@@ -208,6 +208,11 @@ class Node:
         self.router = Router(self.node_key.node_id, transport, logger=self.logger)
         self.p2p_addr: tuple[str, int] | None = None
         self._dialer_task: asyncio.Task | None = None
+        # persistent-peer dial state (reference switch.go reconnectToPeer),
+        # mutated at runtime by add_persistent_peer
+        self._persistent_targets: dict[str, str] = {}
+        self._persistent_backoff: dict[str, float] = {}
+        self._persistent_next_try: dict[str, float] = {}
 
         # -- PEX / address book (reference p2p/pex; node/node.go:820-856)
         self.pex_reactor = None
@@ -226,6 +231,8 @@ class Node:
                 self.router, book, transport,
                 max_outbound=config.p2p.max_num_outbound_peers,
                 seed_mode=config.p2p.seed_mode,
+                private_ids={p.strip().lower() for p in
+                             config.p2p.private_peer_ids.split(",") if p.strip()},
                 logger=self.logger,
             )
 
@@ -339,6 +346,9 @@ class Node:
             event_bus=self.event_bus,
             app_query_conn=self.app_conns.query(),
             router=self.router,
+            transport=self.transport,
+            add_persistent_peer=self.add_persistent_peer,
+            add_private_peer_id=self.add_private_peer_id,
             node_id=self.node_key.node_id,
             moniker=config.base.moniker,
         )
@@ -394,10 +404,22 @@ class Node:
         await self.router.start()
         if self.pex_reactor is not None:
             await self.pex_reactor.start()
-        if isinstance(self.transport, TCPTransport) and self.config.p2p.persistent_peers:
-            self._dialer_task = asyncio.get_running_loop().create_task(
-                self._dial_persistent_peers()
-            )
+        if isinstance(self.transport, TCPTransport):
+            for addr in self.config.p2p.persistent_peers.split(","):
+                addr = addr.strip()
+                if not addr:
+                    continue
+                try:
+                    self.add_persistent_peer(addr)
+                except ValueError as e:
+                    self.logger.error("bad persistent peer address",
+                                      addr=addr, err=str(e))
+            # run when there's work now (configured persistent peers) or
+            # when work can arrive later (unsafe dial_peers RPC enabled)
+            if self._persistent_targets or self.config.rpc.unsafe:
+                self._dialer_task = asyncio.get_running_loop().create_task(
+                    self._dial_persistent_peers()
+                )
         await self.statesync_reactor.start()
 
         if self.config.statesync.enable and self.statesync_reactor.syncer.state_provider:
@@ -425,20 +447,29 @@ class Node:
             await self.blocksync_reactor.start(sync=False)
             await self._start_consensus(self.initial_state)
 
+    def add_persistent_peer(self, addr: str) -> str:
+        """Register an id@host:port address for keep-connected dialing
+        (reference sw.AddPersistentPeers); callable at runtime via the
+        unsafe dial_peers RPC.  Returns the peer id."""
+        pid = self.transport.add_peer_address(addr)
+        if pid not in self._persistent_targets:
+            self._persistent_targets[pid] = addr
+            self._persistent_backoff[pid] = 0.5
+            self._persistent_next_try[pid] = 0.0
+        return pid
+
+    def add_private_peer_id(self, pid: str) -> None:
+        """Exclude a peer id from PEX gossip (reference
+        sw.AddPrivatePeerIDs).  Lowercased: every NodeID produced by
+        parse_net_address is lowercase hex."""
+        if self.pex_reactor is not None:
+            self.pex_reactor.private_ids.add(pid.strip().lower())
+
     async def _dial_persistent_peers(self) -> None:
         """Keep persistent peers connected, with per-peer exponential
         backoff (reference p2p/switch.go reconnectToPeer)."""
-        targets: dict[str, str] = {}
-        for addr in self.config.p2p.persistent_peers.split(","):
-            addr = addr.strip()
-            if not addr:
-                continue
-            try:
-                targets[self.transport.add_peer_address(addr)] = addr
-            except ValueError as e:
-                self.logger.error("bad persistent peer address", addr=addr, err=str(e))
-        backoff = dict.fromkeys(targets, 0.5)
-        next_try = dict.fromkeys(targets, 0.0)
+        backoff = self._persistent_backoff
+        next_try = self._persistent_next_try
 
         async def try_dial(pid: str) -> None:
             try:
@@ -451,7 +482,7 @@ class Node:
 
         while True:
             now = asyncio.get_running_loop().time()
-            due = [pid for pid in targets
+            due = [pid for pid in self._persistent_targets
                    if pid not in self.router.peers and now >= next_try[pid]]
             if due:
                 # concurrently: one unreachable peer must not stall the rest

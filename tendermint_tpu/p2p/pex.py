@@ -223,12 +223,16 @@ class PexReactor:
 
     def __init__(self, router, book: AddrBook, transport,
                  max_outbound: int = 10, seed_mode: bool = False,
+                 private_ids: set[NodeID] | None = None,
                  logger: Logger | None = None):
         self.router = router
         self.book = book
         self.transport = transport  # TCPTransport (address registration)
         self.max_outbound = max_outbound
         self.seed_mode = seed_mode
+        # never gossiped to other peers (reference sw.AddPrivatePeerIDs /
+        # config.p2p.private_peer_ids)
+        self.private_ids: set[NodeID] = set(private_ids or ())
         self.logger = logger or nop_logger()
         self.ch = router.open_channel(ChannelDescriptor(
             channel_id=PEX_CHANNEL, priority=1,
@@ -273,8 +277,10 @@ class PexReactor:
                     continue
                 self._flood_strikes.pop(env.from_, None)
                 self._last_request[env.from_] = now
+                addrs = [a for a in self.book.sample()
+                         if a.split("@", 1)[0] not in self.private_ids]
                 await self.ch.send(Envelope(
-                    to=env.from_, message=PexResponse(self.book.sample())
+                    to=env.from_, message=PexResponse(addrs)
                 ))
                 if self.seed_mode:
                     # seed: serve addresses then hang up to stay available

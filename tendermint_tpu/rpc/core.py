@@ -40,6 +40,9 @@ class Environment:
         event_bus=None,
         app_query_conn=None,
         router=None,
+        transport=None,
+        add_persistent_peer=None,
+        add_private_peer_id=None,
         node_id: str = "",
         moniker: str = "tpu-node",
         version: str = "0.1.0",
@@ -55,6 +58,9 @@ class Environment:
         self.event_bus = event_bus
         self.app_query_conn = app_query_conn
         self.router = router
+        self.transport = transport
+        self.add_persistent_peer = add_persistent_peer
+        self.add_private_peer_id = add_private_peer_id
         self.node_id = node_id
         self.moniker = moniker
         self.version = version
@@ -408,6 +414,17 @@ def num_unconfirmed_txs(env: Environment) -> dict:
     }
 
 
+def check_tx(env: Environment, tx=None) -> dict:  # noqa: A002
+    """Run a tx through the app's CheckTx WITHOUT adding it to the mempool
+    (reference rpc/core/mempool.go:161-167: goes straight to the mempool
+    proxy connection, bypassing the cache and the pool)."""
+    data = _bytes_param(tx)
+    res = env.mempool.app.check_tx_sync(
+        abci.RequestCheckTx(tx=data, type=abci.CheckTxType.NEW)
+    )
+    return enc.deliver_tx_json(res)
+
+
 def tx(env: Environment, hash=None, prove=None) -> dict:  # noqa: A002
     if not hash:
         raise RPCError(INVALID_PARAMS, "hash is required")
@@ -512,6 +529,83 @@ def broadcast_evidence(env: Environment, evidence=None) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# unsafe control routes (reference rpc/core/routes.go:50-56, net.go:37-77,
+# mempool.go UnsafeFlushMempool) — registered only when config.rpc.unsafe
+# ---------------------------------------------------------------------------
+
+def _addr_list(v) -> list[str]:
+    """Coerce a peers/seeds param to a list of address strings: URI GET
+    delivers one comma-separated string, JSON POST a real array."""
+    if isinstance(v, str):
+        return [a.strip() for a in v.split(",") if a.strip()]
+    if isinstance(v, (list, tuple)):
+        return [str(a).strip() for a in v if str(a).strip()]
+    raise RPCError(INVALID_PARAMS, f"expected address list or string, got {v!r}")
+
+
+def _validated_addrs(env: Environment, addrs: list[str]) -> list[tuple[str, str]]:
+    """Parse every id@host:port address BEFORE any side effect (the
+    reference validates the whole list via NewNetAddressStrings first);
+    returns [(peer_id, addr)]."""
+    from tendermint_tpu.p2p.tcp import parse_net_address
+
+    if env.router is None or env.transport is None or not hasattr(
+        env.transport, "add_peer_address"
+    ):
+        raise RPCError(INTERNAL_ERROR, "p2p layer unavailable")
+    out = []
+    for addr in addrs:
+        try:
+            pid, _, _ = parse_net_address(addr)
+        except ValueError as e:
+            raise RPCError(INVALID_PARAMS, f"bad peer address {addr!r}: {e}") from e
+        out.append((pid, addr))
+    return out
+
+
+def _dial_addrs(env: Environment, pairs: list[tuple[str, str]]) -> None:
+    """Register pre-validated addresses and kick off background dials
+    (reference DialPeersAsync); outcome is observable via /net_info."""
+    loop = asyncio.get_running_loop()
+    for pid, addr in pairs:
+        env.transport.add_peer_address(addr)
+        if pid not in env.router.peers:
+            task = loop.create_task(env.router.dial(pid))
+            task.add_done_callback(lambda t: t.exception())
+
+
+async def dial_seeds(env: Environment, seeds=None) -> dict:
+    if not seeds:
+        raise RPCError(INVALID_PARAMS, "no seeds provided")
+    _dial_addrs(env, _validated_addrs(env, _addr_list(seeds)))
+    return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+
+async def dial_peers(env: Environment, peers=None, persistent=None,
+                     unconditional=None, private=None) -> dict:
+    """Reference UnsafeDialPeers (net.go:50-85): persistent peers get
+    keep-connected backoff dialing, private ids are withheld from PEX
+    gossip.  `unconditional` (peer-count-cap exemption) is accepted but
+    a no-op: this framework does not hard-cap connected peers."""
+    if not peers:
+        raise RPCError(INVALID_PARAMS, "no peers provided")
+    pairs = _validated_addrs(env, _addr_list(peers))
+    if persistent and env.add_persistent_peer is not None:
+        for _, addr in pairs:
+            env.add_persistent_peer(addr)
+    if private and env.add_private_peer_id is not None:
+        for pid, _ in pairs:
+            env.add_private_peer_id(pid)
+    _dial_addrs(env, pairs)
+    return {"log": "Dialing peers in progress. See /net_info for details"}
+
+
+def unsafe_flush_mempool(env: Environment) -> dict:
+    env.mempool.flush()
+    return {}
+
+
+# ---------------------------------------------------------------------------
 # route table (reference rpc/core/routes.go:10-47)
 # ---------------------------------------------------------------------------
 
@@ -525,6 +619,7 @@ ROUTES: dict[str, object] = {
     "block_by_hash": block_by_hash,
     "block_results": block_results,
     "commit": commit,
+    "check_tx": check_tx,
     "validators": validators,
     "consensus_params": consensus_params,
     "consensus_state": consensus_state,
@@ -539,4 +634,12 @@ ROUTES: dict[str, object] = {
     "abci_info": abci_info,
     "abci_query": abci_query,
     "broadcast_evidence": broadcast_evidence,
+}
+
+# merged into the served table when config.rpc.unsafe is set
+# (reference rpc/core/routes.go:50-56 AddUnsafeRoutes)
+UNSAFE_ROUTES: dict[str, object] = {
+    "dial_seeds": dial_seeds,
+    "dial_peers": dial_peers,
+    "unsafe_flush_mempool": unsafe_flush_mempool,
 }

@@ -90,7 +90,12 @@ class RPCServer:
                  cors_allowed_origins: list[str] | None = None,
                  routes: dict | None = None):
         self.env = env
-        self.routes = routes if routes is not None else core.ROUTES
+        if routes is not None:
+            self.routes = routes
+        else:
+            self.routes = dict(core.ROUTES)
+            if getattr(getattr(env.config, "rpc", None), "unsafe", False):
+                self.routes.update(core.UNSAFE_ROUTES)
         self.logger = logger or nop_logger()
         self.max_body_bytes = max_body_bytes
         self.max_open_connections = max_open_connections

@@ -138,3 +138,53 @@ def test_pex_discovery_over_tcp(tmp_path):
             await a.stop()
 
     asyncio.run(run())
+
+
+def test_pex_private_ids_not_gossiped():
+    """Private peer ids are withheld from PexResponse sampling
+    (reference sw.AddPrivatePeerIDs / config.p2p.private_peer_ids).
+    Drives the real request handler: a PexRequest envelope goes through
+    _recv_loop and the emitted PexResponse must exclude private ids."""
+    from tendermint_tpu.p2p.pex import PexReactor
+    from tendermint_tpu.p2p.types import Envelope
+
+    async def run():
+        book = AddrBook(strict=False)
+        book.add_our_id(NID(0xAA))
+        book.add_address(f"{NID(1)}@1.2.3.4:26656")
+        book.add_address(f"{NID(2)}@5.6.7.8:26656")
+
+        inbox: asyncio.Queue = asyncio.Queue()
+        sent: list = []
+
+        class FakeChannel:
+            def __init__(self, desc):
+                self.descriptor = desc
+            async def receive(self):
+                return await inbox.get()
+            async def send(self, env):
+                sent.append(env)
+            async def error(self, peer, msg):
+                pass
+
+        class FakeRouter:
+            node_id = NID(0xAA)
+            def open_channel(self, desc):
+                return FakeChannel(desc)
+            def subscribe_peer_updates(self):
+                return asyncio.Queue()
+
+        r = PexReactor(FakeRouter(), book, transport=None,
+                       private_ids={NID(2)})
+        task = asyncio.get_running_loop().create_task(r._recv_loop())
+        await inbox.put(Envelope(message=PexRequest(), from_=NID(3)))
+        for _ in range(100):
+            if sent:
+                break
+            await asyncio.sleep(0.01)
+        task.cancel()
+        assert sent, "no PexResponse emitted"
+        ids = {a.split("@", 1)[0] for a in sent[0].message.addrs}
+        assert NID(1) in ids and NID(2) not in ids
+
+    asyncio.run(run())

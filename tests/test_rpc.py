@@ -335,3 +335,72 @@ def test_ws_client_eviction_on_slow_consumer(tmp_path):
             await node.stop()
 
     asyncio.run(run())
+
+
+def test_check_tx_and_unsafe_routes(tmp_path):
+    """check_tx runs CheckTx without inserting into the mempool
+    (reference rpc/core/mempool.go:161-167); unsafe routes
+    (unsafe_flush_mempool, dial_seeds) are served only when
+    config.rpc.unsafe is set (reference routes.go:50-56)."""
+
+    async def run():
+        key = priv_key_from_seed(b"\x67" * 32)
+        gen = GenesisDoc(
+            chain_id="unsafe-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.base.fast_sync = False
+        cfg.rpc.unsafe = True
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = key
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        host, port = node.rpc_addr
+        c = HTTPClient(host, port)
+        try:
+            await node.wait_for_height(1, timeout=30)
+
+            # check_tx: app validation only, nothing enters the pool
+            res = await c.call("check_tx", tx=base64.b64encode(b"ck=cv").decode())
+            assert res["code"] == 0
+            assert node.mempool.size() == 0
+
+            # fill the pool, then unsafe_flush_mempool empties it
+            await c.call("broadcast_tx_sync", tx=base64.b64encode(b"fk=fv").decode())
+            assert node.mempool.size() == 1
+            assert await c.call("unsafe_flush_mempool") == {}
+            assert node.mempool.size() == 0
+
+            # dial_seeds validates its input
+            with pytest.raises(RPCError):
+                await c.call("dial_seeds", seeds=[])
+            with pytest.raises(RPCError):
+                await c.call("dial_seeds", seeds=["not-an-address"])
+        finally:
+            await c.close()
+            await node.stop()
+
+        # unsafe off (default): routes are not served
+        gen2 = GenesisDoc(
+            chain_id="safe-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg2 = make_test_config(str(tmp_path / "safe"))
+        cfg2.base.fast_sync = False
+        node2 = Node(cfg2, genesis=gen2)
+        node2.priv_validator.priv_key = key
+        node2.consensus.priv_validator = node2.priv_validator
+        await node2.start()
+        c2 = HTTPClient(*node2.rpc_addr)
+        try:
+            with pytest.raises(RPCError) as ei:
+                await c2.call("unsafe_flush_mempool")
+            assert ei.value.code == -32601  # method not found
+        finally:
+            await c2.close()
+            await node2.stop()
+
+    asyncio.run(run())
