@@ -11,12 +11,13 @@ Pipeline per batch:
   host:   parse sig/pubkey bytes, check s < L (ZIP-215 rule 1), hash
           k = SHA-512(R||A||M) mod L (variable-length messages stay on host);
           ship PACKED 32-byte rows (128 B/signature).
-  device: unpack bytes → bits → 17-bit limbs (elementwise, free next to the
-          curve math), then permissive point decompression for A and R (ZIP-215 rule 2 —
-          y >= p accepted, x=0/sign=1 accepted, small order accepted),
-          W = [s]B + [k](-A) by joint (Shamir) double-and-add with a 4-entry
-          window table, Q = W - R, and the cofactored check
-          [8]Q == identity (ZIP-215 rule 3).
+  device: unpack bytes → bits/nibbles → 17-bit limbs (elementwise, free next
+          to the curve math), then permissive point decompression for A and R
+          (ZIP-215 rule 2 — y >= p accepted, x=0/sign=1 accepted, small order
+          accepted), W = [s]B + [k](-A) with radix-16 fixed-base tables for B
+          (zero doublings) and a 4-bit windowed ladder for A (63 adds + 252
+          doublings at 4S+4M via the dedicated doubling formula), Q = W - R,
+          and the cofactored check [8]Q == identity (ZIP-215 rule 3).
 
 Note: -[k]A is computed as [k](-A), never as [L-k]A — the latter is wrong for
 points with a torsion component (L·A ≠ O), exactly the inputs ZIP-215 admits.
@@ -78,33 +79,97 @@ def decompress(y: jnp.ndarray, sign: jnp.ndarray) -> tuple[Pt, jnp.ndarray]:
     return Pt(x, yr, jnp.broadcast_to(jnp.asarray(fe.ONE), yr.shape), fe.fe_mul(x, yr)), ok
 
 
-def _shamir(s_bits: jnp.ndarray, k_bits: jnp.ndarray, neg_a: Pt) -> Pt:
-    """W = [s]B + [k]negA, joint double-and-add, MSB first.
+NWINDOWS = 64  # 253-bit scalars as 64 little-endian radix-16 digits
 
-    s_bits/k_bits: [..., 253] in {0,1}; neg_a: batch point.
-    """
-    shape = s_bits.shape[:-1]
-    base = fe.pt_base(shape)
-    ident = fe.pt_identity(shape)
-    t3 = fe.pt_add(base, neg_a)  # B + (-A)
+
+def _select16(digit: jnp.ndarray, tbl: list[Pt]) -> Pt:
+    """tbl[digit] per batch element via a 4-level binary select tree
+    (15 pt_selects — elementwise, no gathers).  Entries may be batch
+    points or broadcastable constants."""
+    cur = list(tbl)
+    for b in range(4):
+        bit = (digit >> b) & 1
+        cur = [fe.pt_select(bit, cur[2 * i + 1], cur[2 * i])
+               for i in range(len(cur) // 2)]
+    return cur[0]
+
+
+def _scalarmul_var(digits: jnp.ndarray, neg_a: Pt) -> Pt:
+    """[k](-A) by 4-bit fixed windows: 16-entry per-signature table
+    (14 adds to build), then 63 iterations of 4 doublings + 1 add.
+    vs the bitwise ladder: doublings at 4S+4M instead of unified 9M,
+    and 63 adds instead of 253."""
+    shape = digits.shape[:-1]
+    tbl = [fe.pt_identity(shape), neg_a]
+    for _ in range(14):
+        tbl.append(fe.pt_add(tbl[-1], neg_a))
 
     def body(i, acc: Pt) -> Pt:
-        bit_s = jnp.take(s_bits, SCALAR_BITS - 1 - i, axis=-1)
-        bit_k = jnp.take(k_bits, SCALAR_BITS - 1 - i, axis=-1)
-        acc = fe.pt_add(acc, acc)  # complete formulas: doubling included
-        # 4-way window select: {O, B, -A, B-A}
-        sel_k = fe.pt_select(bit_k, neg_a, ident)
-        sel_k1 = fe.pt_select(bit_k, t3, base)
-        addend = fe.pt_select(bit_s, sel_k1, sel_k)
-        return fe.pt_add(acc, addend)
+        d = jnp.take(digits, NWINDOWS - 1 - i, axis=-1)
+        acc = fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(acc))))
+        return fe.pt_add(acc, _select16(d, tbl))
 
-    return lax.fori_loop(0, SCALAR_BITS, body, ident)
+    # seed with the top digit: saves 4 doublings and keeps 63 adds
+    top = _select16(jnp.take(digits, NWINDOWS - 1, axis=-1), tbl)
+    return lax.fori_loop(1, NWINDOWS, body, top)
+
+
+@functools.cache
+def _fixed_base_tables() -> tuple[jnp.ndarray, ...]:
+    """[j * 16^i]B for i in 0..63, j in 0..15, as four [64, 16, 15] limb
+    tensors (X, Y, Z, T).  ~500KB of constants; [s]B then costs 64 table
+    selects + 63 additions and ZERO doublings (classic fixed-base
+    radix-16, as in ref10's precomputed tables)."""
+    coords = [np.zeros((NWINDOWS, 16, fe.NLIMBS), dtype=np.int64) for _ in range(4)]
+    g = _ref.BASE
+    for i in range(NWINDOWS):
+        for j in range(16):
+            pt = _ref.scalar_mult(j, g)
+            for c in range(4):
+                coords[c][i, j] = fe.limbs_from_int(pt[c])
+        g = _ref.scalar_mult(16, g)
+    # numpy, NOT jnp: device constants created inside one jit trace must
+    # not be cached across traces (UnexpectedTracerError); callers convert
+    # per-trace, which XLA folds into program constants anyway
+    return tuple(coords)
+
+
+def _scalarmul_base(digits: jnp.ndarray) -> Pt:
+    """[s]B from the fixed-base tables (no doublings)."""
+    tx, ty, tz, tt = (jnp.asarray(c) for c in _fixed_base_tables())
+    shape = digits.shape[:-1]
+
+    def body_dyn(i, acc: Pt) -> Pt:
+        # one dynamic slice per coordinate for the whole 16-entry window
+        # (NOT per table entry — 4 gathers instead of 64)
+        rx, ry, rz, rt = (jnp.take(c, i, axis=0) for c in (tx, ty, tz, tt))
+        row = [Pt(rx[j], ry[j], rz[j], rt[j]) for j in range(16)]
+        sel = _select16(jnp.take(digits, i, axis=-1), row)
+        return fe.pt_add(acc, sel)
+
+    acc0 = _select16(jnp.take(digits, 0, axis=-1),
+                     [Pt(tx[0, j], ty[0, j], tz[0, j], tt[0, j]) for j in range(16)])
+    # broadcast the (possibly constant-shaped) window-0 point to batch shape
+    acc0 = Pt(*(jnp.broadcast_to(c, shape + (fe.NLIMBS,)) for c in acc0.astuple()))
+    return lax.fori_loop(1, NWINDOWS, body_dyn, acc0)
+
+
+def _shamir(s_digits: jnp.ndarray, k_digits: jnp.ndarray, neg_a: Pt) -> Pt:
+    """W = [s]B + [k](-A): fixed-base tables for B, windowed ladder for A."""
+    return fe.pt_add(_scalarmul_base(s_digits), _scalarmul_var(k_digits, neg_a))
 
 
 def _bits_of(rows: jnp.ndarray) -> jnp.ndarray:
     """[..., 32] uint8 → [..., 256] bits (LE bit order), on device."""
     b = (rows[..., :, None].astype(jnp.int32) >> jnp.arange(8, dtype=jnp.int32)) & 1
     return b.reshape(rows.shape[:-1] + (256,))
+
+
+def _nibbles_of(rows: jnp.ndarray) -> jnp.ndarray:
+    """[..., 32] uint8 → [..., 64] little-endian radix-16 digits."""
+    lo = (rows & 15).astype(jnp.int32)
+    hi = (rows >> 4).astype(jnp.int32)
+    return jnp.stack([lo, hi], axis=-1).reshape(rows.shape[:-1] + (64,))
 
 
 _LIMB_WEIGHTS = (1 << np.arange(fe.LIMB_BITS, dtype=np.int64))
@@ -126,15 +191,13 @@ def _verify_core(pub_rows, r_rows, s_rows, k_rows, valid):
     r_bits = _bits_of(r_rows)
     y_a, sign_a = _limbs_of(pub_bits[..., :255]), pub_bits[..., 255]
     y_r, sign_r = _limbs_of(r_bits[..., :255]), r_bits[..., 255]
-    s_bits = _bits_of(s_rows)[..., :SCALAR_BITS]
-    k_bits = _bits_of(k_rows)[..., :SCALAR_BITS]
+    s_digits = _nibbles_of(s_rows)
+    k_digits = _nibbles_of(k_rows)
     a_pt, ok_a = decompress(y_a, sign_a)
     r_pt, ok_r = decompress(y_r, sign_r)
-    w = _shamir(s_bits, k_bits, fe.pt_neg(a_pt))
+    w = _shamir(s_digits, k_digits, fe.pt_neg(a_pt))
     q = fe.pt_add(w, fe.pt_neg(r_pt))
-    q2 = fe.pt_add(q, q)
-    q4 = fe.pt_add(q2, q2)
-    q8 = fe.pt_add(q4, q4)
+    q8 = fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(q)))
     return valid & ok_a & ok_r & fe.pt_is_identity(q8)
 
 
@@ -147,32 +210,60 @@ def _compiled(n: int):
 # Host preprocessing
 # ---------------------------------------------------------------------------
 
+_L_WORDS = np.frombuffer(L.to_bytes(32, "little"), dtype="<u8").copy()
+
+
 def prepare_batch(pubs, msgs, sigs):
     """Parse/validate on host; returns packed device inputs
     (pub_rows, r_rows, s_rows, k_rows, valid) — all [N,32] uint8 + bool[N].
 
     Host work is only what must stay on host: the variable-length
-    SHA-512 (hashlib C) and the s < L canonicality test (ZIP-215 rule 1)."""
+    SHA-512 (hashlib C) and the s < L canonicality test (ZIP-215 rule 1)
+    — both vectorized/batched so host prep stays a small fraction of the
+    device call."""
     n = len(pubs)
     valid = np.ones(n, dtype=bool)
-    pub_rows = np.zeros((n, 32), dtype=np.uint8)
-    r_rows = np.zeros((n, 32), dtype=np.uint8)
-    s_rows = np.zeros((n, 32), dtype=np.uint8)
-    k_rows = np.zeros((n, 32), dtype=np.uint8)
-    for i, (pub, msg, sig) in enumerate(zip(pubs, msgs, sigs)):
-        if len(pub) != 32 or len(sig) != 64:
-            valid[i] = False
+
+    well_formed = all(len(p) == 32 for p in pubs) and all(len(s) == 64 for s in sigs)
+    if well_formed:
+        pub_rows = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(n, 32).copy()
+        sig_rows = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+        r_rows = sig_rows[:, :32].copy()
+        s_rows = sig_rows[:, 32:].copy()
+    else:
+        pub_rows = np.zeros((n, 32), dtype=np.uint8)
+        r_rows = np.zeros((n, 32), dtype=np.uint8)
+        s_rows = np.zeros((n, 32), dtype=np.uint8)
+        for i, (pub, sig) in enumerate(zip(pubs, sigs)):
+            if len(pub) != 32 or len(sig) != 64:
+                valid[i] = False
+                continue
+            pub_rows[i] = np.frombuffer(pub, dtype=np.uint8)
+            r_rows[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+            s_rows[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+
+    # ZIP-215 rule 1 (s < L), vectorized: lexicographic compare on the
+    # four little-endian 64-bit words, most significant first
+    sw = s_rows.view("<u8")  # [n, 4]
+    lt = np.zeros(n, dtype=bool)
+    gt = np.zeros(n, dtype=bool)
+    for w in (3, 2, 1, 0):
+        lt = lt | (~gt & (sw[:, w] < _L_WORDS[w]))
+        gt = gt | (~lt & (sw[:, w] > _L_WORDS[w]))
+    valid &= lt  # s == L is also non-canonical
+
+    # k = SHA-512(R || A || M) mod L per row (hashlib C; operate on the
+    # caller's byte objects, not numpy views, and join k bytes once)
+    sha512 = hashlib.sha512
+    from_bytes = int.from_bytes
+    ks = bytearray(32 * n)
+    for i in range(n):
+        if not valid[i]:
             continue
-        r_bytes = sig[:32]
-        s = int.from_bytes(sig[32:], "little")
-        if s >= L:  # ZIP-215 rule 1: s must be canonical
-            valid[i] = False
-            continue
-        pub_rows[i] = np.frombuffer(pub, dtype=np.uint8)
-        r_rows[i] = np.frombuffer(r_bytes, dtype=np.uint8)
-        s_rows[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-        k = int.from_bytes(hashlib.sha512(r_bytes + pub + msg).digest(), "little") % L
-        k_rows[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+        sig, pub = sigs[i], pubs[i]
+        k = from_bytes(sha512(sig[:32] + pub + msgs[i]).digest(), "little") % L
+        ks[32 * i : 32 * (i + 1)] = k.to_bytes(32, "little")
+    k_rows = np.frombuffer(bytes(ks), dtype=np.uint8).reshape(n, 32).copy()
     return pub_rows, r_rows, s_rows, k_rows, valid
 
 

@@ -571,7 +571,7 @@ def _dial_addrs(env: Environment, pairs: list[tuple[str, str]]) -> None:
         env.transport.add_peer_address(addr)
         if pid not in env.router.peers:
             task = loop.create_task(env.router.dial(pid))
-            task.add_done_callback(lambda t: t.exception())
+            task.add_done_callback(lambda t: t.cancelled() or t.exception())
 
 
 async def dial_seeds(env: Environment, seeds=None) -> dict:
