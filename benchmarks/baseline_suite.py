@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""BASELINE benchmark suite: the driver-defined configs beyond bench.py.
+
+BASELINE.md configs (reference yayajacky/tendermint):
+  2. 128-validator Commit.VerifyCommit     (types/validator_set.go:662-712)
+  3. 1000-validator light VerifyAdjacent   (light/verifier.go:102-147)
+  4. fast-sync replay, blocks x 200 vals   (blockchain/v0/reactor.go:517,556)
+
+Each config runs the full framework path (sign-bytes reconstruction,
+batched device verification, ABCI apply for config 4) and, for the
+verification configs, a sequential single-signature CPU loop as the
+stand-in for the reference's per-signature `ed25519consensus.Verify`
+(crypto/ed25519/ed25519.go:149-156 — the fork has no BatchVerifier).
+
+Usage: python benchmarks/baseline_suite.py [--config 2|3|4|all]
+       [--blocks N] [--backend auto|jax|cpu] [--runs N]
+Prints one JSON line per config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)  # runnable from anywhere
+sys.path.insert(0, os.path.join(_ROOT, "tests"))  # shared chain-builder fixtures
+
+
+def _timed(fn, runs: int) -> float:
+    """Median seconds over `runs` calls (after one warmup)."""
+    fn()
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def _emit(metric: str, value: float, unit: str, baseline: float, extra: dict | None = None):
+    doc = {
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": unit,
+        "vs_baseline": round(baseline, 3),
+    }
+    if extra:
+        doc.update(extra)
+    print(json.dumps(doc), flush=True)
+
+
+def _sequential_baseline_per_sig() -> float:
+    """Seconds per signature for the sequential single-sig CPU path
+    (one ed25519 verify per CommitSig, like the reference's loop)."""
+    import secrets
+
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    n = 256
+    ks = [Ed25519PrivateKey.from_private_bytes(secrets.token_bytes(32)) for _ in range(n)]
+    msgs = [b"baseline-%d" % i for i in range(n)]
+    sigs = [k.sign(m) for k, m in zip(ks, msgs)]
+    pubs = [k.public_key() for k in ks]
+    t0 = time.perf_counter()
+    for p, m, s in zip(pubs, msgs, sigs):
+        p.verify(s, m)
+    return (time.perf_counter() - t0) / n
+
+
+def bench_verify_commit(n_vals: int, runs: int) -> None:
+    """Config 2: full VerifyCommit of an n_vals-validator commit."""
+    from helpers import ChainBuilder
+
+    b = ChainBuilder(n_vals=n_vals, chain_id="bench-chain")
+    b.build(1)
+    commit = b.block_store.load_block_commit(1) or b.block_store.load_seen_commit(1)
+    vals = b.state_store.load_validators(1)  # the set that signed h=1
+
+    def run():
+        vals.verify_commit("bench-chain", commit.block_id, 1, commit)
+
+    sec = _timed(run, runs)
+    base = _sequential_baseline_per_sig() * n_vals
+    _emit(
+        f"verify_commit_{n_vals}_validators",
+        sec * 1e3,
+        "ms",
+        base / sec,
+        {"note": "vs_baseline = speedup over sequential per-sig CPU loop"},
+    )
+
+
+def bench_verify_adjacent(n_vals: int, runs: int) -> None:
+    """Config 3: light-client VerifyAdjacent with an n_vals-validator
+    SignedHeader (reference light/verifier.go:102 -> VerifyCommitLight)."""
+    from helpers import ChainBuilder
+
+    from tendermint_tpu.light.verifier import verify_adjacent
+    from tendermint_tpu.types.light import SignedHeader
+
+    b = ChainBuilder(n_vals=n_vals, chain_id="bench-chain")
+    b.build(2)
+    h1, h2 = (b.block_store.load_block_meta(h).header for h in (1, 2))
+    c1 = b.block_store.load_block_commit(1)
+    c2 = b.block_store.load_block_commit(2) or b.block_store.load_seen_commit(2)
+    v2 = b.state_store.load_validators(2)
+    sh1 = SignedHeader(header=h1, commit=c1)
+    sh2 = SignedHeader(header=h2, commit=c2)
+    now_ns = h2.time_ns + 10 * 10**9
+
+    def run():
+        verify_adjacent(sh1, sh2, v2, trusting_period_ns=14 * 86400 * 10**9,
+                        now_ns=now_ns, max_clock_drift_ns=10 * 10**9)
+
+    sec = _timed(run, runs)
+    # light adjacent-verify needs >2/3 power: ~2/3 of sigs on the CPU path
+    base = _sequential_baseline_per_sig() * (n_vals * 2 / 3)
+    _emit(
+        f"light_verify_adjacent_{n_vals}_validators",
+        sec * 1e3,
+        "ms",
+        base / sec,
+        {"note": "vs_baseline = speedup over sequential per-sig CPU loop at 2/3 power"},
+    )
+
+
+def bench_fastsync_replay(n_blocks: int, n_vals: int) -> None:
+    """Config 4: fast-sync replay throughput — verify_commit_light per
+    block + ApplyBlock on kvstore (reference blockchain/v0 poolRoutine)."""
+    from helpers import ChainBuilder
+
+    from tendermint_tpu.abci import AppConns
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
+    from tendermint_tpu.store import BlockStore, MemDB
+
+    build_t0 = time.perf_counter()
+    b = ChainBuilder(n_vals=n_vals, chain_id="bench-chain")
+    b.build(n_blocks, tx_fn=lambda h: [b"k%d=v%d" % (h, h)])
+    build_s = time.perf_counter() - build_t0
+
+    # fresh node state: replay what the builder produced
+    state = make_genesis_state(b.genesis)
+    store = BlockStore(MemDB())
+    state_store = StateStore(MemDB())
+    state_store.save(state)
+    execu = BlockExecutor(state_store, AppConns(KVStoreApplication()).consensus())
+
+    t0 = time.perf_counter()
+    for h in range(1, n_blocks + 1):
+        block = b.block_store.load_block(h)
+        commit = b.block_store.load_block_commit(h) or b.block_store.load_seen_commit(h)
+        # pair verification exactly like the pool routine: current state's
+        # validators attest the commit for this block
+        state.validators.verify_commit_light(
+            state.chain_id, commit.block_id, h, commit
+        )
+        parts = block.make_part_set()
+        store.save_block(block, parts, commit)
+        state, _ = execu.apply_block(state, commit.block_id, block)
+    sec = time.perf_counter() - t0
+    per_block_sig_cost = _sequential_baseline_per_sig() * (n_vals * 2 / 3)
+    base_total = per_block_sig_cost * n_blocks
+    _emit(
+        f"fastsync_replay_{n_blocks}x{n_vals}",
+        n_blocks / sec,
+        "blocks/s",
+        base_total / sec,
+        {
+            "note": "vs_baseline = verify-time speedup over sequential CPU loop "
+                    "(excl. apply); build_s is fixture prep, not measured",
+            "build_s": round(build_s, 1),
+        },
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="all", choices=["2", "3", "4", "all"])
+    ap.add_argument("--blocks", type=int, default=10_000)
+    ap.add_argument("--vals", type=int, default=0, help="override validator count")
+    ap.add_argument("--backend", default="auto", choices=["auto", "jax", "cpu"])
+    ap.add_argument("--runs", type=int, default=5)
+    args = ap.parse_args()
+
+    from tendermint_tpu.crypto.batch import set_default_backend
+
+    set_default_backend(args.backend)
+
+    if args.config in ("2", "all"):
+        bench_verify_commit(args.vals or 128, args.runs)
+    if args.config in ("3", "all"):
+        bench_verify_adjacent(args.vals or 1000, args.runs)
+    if args.config in ("4", "all"):
+        bench_fastsync_replay(args.blocks, args.vals or 200)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Randomized e2e manifest generator.
+
+Parity: reference test/e2e/generator/ — explores the testnet config
+space with a seeded RNG so nightly runs cover combinations no hand-
+written manifest would (validator counts, load rates, perturbation
+schedules, byzantine misbehaviors), while staying reproducible: the
+same seed always yields the same manifest list.
+
+The config space is the subset this framework's runner supports
+(tendermint_tpu/e2e/runner.py manifest schema); each knob cites the
+reference generator's equivalent dimension (test/e2e/generator/
+generate.go: testnetCombinations, nodeVersions/perturbations).
+"""
+
+from __future__ import annotations
+
+import random
+
+PERTURB_OPS = ("kill", "pause", "restart")  # reference perturb.go:29-66
+MISBEHAVIORS = ("double-prevote",)  # reference test/maverick misbehaviors
+
+
+def generate_manifest(rng: random.Random, index: int = 0) -> dict:
+    """One random manifest (reference generate.go Generate)."""
+    n_vals = rng.choice((2, 4, 4, 5))  # weighted toward the canonical 4
+    target = rng.randint(6, 10)
+    manifest: dict = {
+        "chain_id": f"gen-{index}",
+        "validators": n_vals,
+        "target_height": target,
+        "load_rate": rng.choice((0, 5, 10)),
+    }
+
+    # perturbations: up to 2, never on node 0 (the RPC anchor the runner
+    # uses for invariant checks), at heights the net will actually reach
+    perturb = []
+    for _ in range(rng.randint(0, 2)):
+        perturb.append({
+            "node": rng.randrange(1, n_vals),
+            "op": rng.choice(PERTURB_OPS),
+            "at_height": rng.randint(2, max(2, target - 3)),
+        })
+    if perturb:
+        manifest["perturb"] = perturb
+
+    # byzantine: at most one maverick (reference e2e manifests mark a
+    # single misbehaving node per net), only with >= 4 validators so the
+    # honest supermajority keeps the chain live
+    if n_vals >= 4 and rng.random() < 0.5:
+        node = rng.randrange(1, n_vals)
+        height = rng.randint(2, max(2, target - 3))
+        manifest["misbehaviors"] = {str(node): {str(height): rng.choice(MISBEHAVIORS)}}
+
+    return manifest
+
+
+def generate(seed: int, n: int = 8) -> list[dict]:
+    """Reproducible manifest list for a nightly sweep."""
+    rng = random.Random(seed)
+    return [generate_manifest(rng, i) for i in range(n)]
