@@ -52,6 +52,7 @@ class MempoolConfig:
     max_tx_bytes: int = 1024 * 1024
     keep_invalid_txs_in_cache: bool = False
     recheck: bool = True
+    broadcast: bool = True  # gossip txs to peers (reference config.Broadcast)
     wal_dir: str = ""  # optional raw-tx log (recovery aid, reference InitWAL)
 
 

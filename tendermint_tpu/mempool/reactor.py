@@ -34,11 +34,14 @@ def decode_txs(data: bytes) -> list[bytes]:
 
 class MempoolReactor:
     def __init__(self, mempool: Mempool, router, logger: Logger | None = None,
-                 gossip_sleep_ms: int = 100):
+                 gossip_sleep_ms: int = 100, broadcast: bool = True):
         self.mempool = mempool
         self.router = router
         self.logger = logger or nop_logger()
         self.gossip_sleep = gossip_sleep_ms / 1000.0
+        # reference config.Mempool.Broadcast: false = accept txs but never
+        # gossip them (reactor.go:129 "Tx broadcasting is disabled")
+        self.broadcast = broadcast
         self.ch = router.open_channel(
             ChannelDescriptor(
                 channel_id=MEMPOOL_CHANNEL,
@@ -67,7 +70,7 @@ class MempoolReactor:
         while True:
             update = await self.peer_updates.get()
             if update.status == PeerStatus.UP:
-                if update.node_id not in self._peer_tasks:
+                if self.broadcast and update.node_id not in self._peer_tasks:
                     self._peer_tasks[update.node_id] = asyncio.get_running_loop().create_task(
                         self._gossip(update.node_id)
                     )

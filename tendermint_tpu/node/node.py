@@ -293,7 +293,10 @@ class Node:
             self.consensus.broadcast_vote = lambda v: self.consensus_reactor.vote_ch.try_send(
                 Envelope(message=VoteMessage(v), broadcast=True)
             )
-        self.mempool_reactor = MempoolReactor(self.mempool, self.router, logger=self.logger)
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, self.router, logger=self.logger,
+            broadcast=config.mempool.broadcast,
+        )
         self.evidence_reactor = EvidenceReactor(
             self.evidence_pool, self.router, logger=self.logger
         )
@@ -353,6 +356,8 @@ class Node:
             moniker=config.base.moniker,
         )
         self.grpc_server = None
+        self.pprof_server = None
+        self.pprof_addr = None
         self.rpc_server = RPCServer(
             self.rpc_env,
             logger=self.logger,
@@ -397,6 +402,12 @@ class Node:
                                       default_port=26660)
             addr = await self.metrics.start(host, port)
             self.logger.info("prometheus metrics listening", addr=f"{addr[0]}:{addr[1]}")
+        if self.config.rpc.pprof_laddr:
+            from tendermint_tpu.node.pprof import PprofServer
+
+            self.pprof_server = PprofServer(logger=self.logger)
+            host, port = _parse_laddr(self.config.rpc.pprof_laddr, default_port=6060)
+            self.pprof_addr = await self.pprof_server.start(host, port)
         if isinstance(self.transport, TCPTransport):
             # advertise the channels the reactors registered (compat check)
             self.transport.channels = bytes(self.router.channels.keys())
@@ -555,6 +566,8 @@ class Node:
             await self.grpc_server.stop()
         if self.metrics is not None:
             await self.metrics.stop()
+        if self.pprof_server is not None:
+            await self.pprof_server.stop()
         if self._pv_remote:
             await asyncio.to_thread(self.priv_validator.close)
         await self.indexer_service.stop()

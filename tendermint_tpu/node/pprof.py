@@ -1,0 +1,82 @@
+"""Diagnostics HTTP listener — the pprof analog.
+
+Parity: reference node/node.go:858-863 serves net/http/pprof when
+config.RPC.PprofListenAddress is set; `tendermint debug` scrapes it.
+The Python equivalents of goroutine/heap profiles:
+
+    GET /debug/pprof/          index
+    GET /debug/pprof/goroutine all thread stacks + live asyncio tasks
+    GET /debug/pprof/heap      gc object counts by type (top 50)
+
+Plain text responses, stdlib only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import sys
+import traceback
+from collections import Counter
+
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+
+def _goroutine_dump() -> str:
+    out = []
+    out.append("== threads ==")
+    for tid, frame in sys._current_frames().items():
+        out.append(f"\n-- thread {tid} --")
+        out.extend(ln.rstrip() for ln in traceback.format_stack(frame))
+    out.append("\n== asyncio tasks ==")
+    try:
+        for task in asyncio.all_tasks():
+            out.append(f"\n-- {task.get_name()} ({'done' if task.done() else 'live'}) --")
+            stack = task.get_stack(limit=8)
+            for frame in stack:
+                out.extend(
+                    ln.rstrip()
+                    for ln in traceback.format_stack(frame)[-1:]
+                )
+    except RuntimeError:
+        out.append("(no running loop)")
+    return "\n".join(out) + "\n"
+
+
+def _heap_dump(top: int = 50) -> str:
+    counts = Counter(type(o).__name__ for o in gc.get_objects())
+    lines = [f"{n:>10}  {name}" for name, n in counts.most_common(top)]
+    return f"gc objects by type (top {top}):\n" + "\n".join(lines) + "\n"
+
+
+class PprofServer:
+    """Diagnostics listener on the shared TextHTTPServer (independent of
+    the RPC server: must answer when the RPC stack is wedged)."""
+
+    def __init__(self, logger: Logger | None = None):
+        from tendermint_tpu.utils.httpserv import TextHTTPServer
+
+        self.logger = logger or nop_logger()
+        self._http = TextHTTPServer(self._route)
+
+    async def start(self, host: str, port: int) -> tuple[str, int]:
+        addr = await self._http.start(host, port)
+        self.logger.info("pprof listener up", addr=f"{addr[0]}:{addr[1]}")
+        return addr
+
+    async def stop(self) -> None:
+        await self._http.stop()
+
+    async def _route(self, path: str):
+        if path.startswith("/debug/pprof/goroutine"):
+            body = _goroutine_dump()
+        elif path.startswith("/debug/pprof/heap"):
+            # off the event loop: walking the gc heap can take seconds on
+            # a loaded node, exactly when this endpoint gets scraped
+            body = await asyncio.to_thread(_heap_dump)
+        elif path.startswith("/debug/pprof"):
+            body = ("pprof analog endpoints:\n"
+                    "/debug/pprof/goroutine\n/debug/pprof/heap\n")
+        else:
+            return None
+        return 200, "text/plain", body.encode()

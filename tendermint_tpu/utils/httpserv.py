@@ -1,0 +1,64 @@
+"""Minimal plaintext-HTTP listener shared by the single-purpose
+diagnostic endpoints (Prometheus /metrics, the pprof analog).
+
+Deliberately not the JSON-RPC server: these listeners must stay up and
+dependency-free even when the RPC stack is wedged — one request per
+connection, GET only, text responses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+# handler(path) -> (status, content_type, body) or None for 404
+Handler = Callable[[str], Awaitable[tuple[int, str, bytes] | None]]
+
+_STATUS = {200: b"200 OK", 404: b"404 Not Found"}
+
+
+class TextHTTPServer:
+    def __init__(self, handler: Handler):
+        self.handler = handler
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self, host: str, port: int) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        return self._server.sockets[0].getsockname()[:2]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), 5.0)
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.decode("latin1").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            result = await self.handler(path)
+            if result is None:
+                status, ctype, body = 404, "text/plain", b"not found\n"
+            else:
+                status, ctype, body = result
+            writer.write(
+                b"HTTP/1.1 " + _STATUS.get(status, _STATUS[404]) + b"\r\n"
+                + f"Content-Type: {ctype}\r\nContent-Length: {len(body)}\r\n"
+                  f"Connection: close\r\n\r\n".encode()
+                + body
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass

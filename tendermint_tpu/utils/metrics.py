@@ -15,7 +15,6 @@ peer count).
 
 from __future__ import annotations
 
-import asyncio
 import time
 from typing import Callable
 
@@ -176,44 +175,21 @@ class MetricsServer:
     """GET /metrics on the instrumentation address."""
 
     def __init__(self, registry: Registry):
+        from tendermint_tpu.utils.httpserv import TextHTTPServer
+
         self.registry = registry
-        self._server: asyncio.AbstractServer | None = None
+        self._http = TextHTTPServer(self._route)
 
     async def start(self, host: str, port: int) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._handle, host, port)
-        return self._server.sockets[0].getsockname()[:2]
+        return await self._http.start(host, port)
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            self._server = None
+        await self._http.stop()
 
-    async def _handle(self, reader, writer) -> None:
-        try:
-            line = await asyncio.wait_for(reader.readline(), 5.0)
-            while True:
-                h = await asyncio.wait_for(reader.readline(), 5.0)
-                if h in (b"\r\n", b"\n", b""):
-                    break
-            body = self.registry.expose().encode()
-            target = line.split()[1] if len(line.split()) > 1 else b"/"
-            if target.startswith(b"/metrics"):
-                head = (b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; "
-                        b"version=0.0.4\r\n")
-            else:
-                body = b"see /metrics\n"
-                head = b"HTTP/1.1 404 Not Found\r\nContent-Type: text/plain\r\n"
-            writer.write(head + b"Content-Length: %d\r\nConnection: close\r\n\r\n"
-                         % len(body) + body)
-            await writer.drain()
-        except (asyncio.TimeoutError, ConnectionError, OSError, IndexError):
-            pass
-        finally:
-            try:
-                writer.close()
-                await writer.wait_closed()
-            except Exception:
-                pass
+    async def _route(self, path: str):
+        if path.startswith("/metrics"):
+            return 200, "text/plain; version=0.0.4", self.registry.expose().encode()
+        return 404, "text/plain", b"see /metrics\n"
 
 
 def timer() -> float:

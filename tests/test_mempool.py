@@ -137,3 +137,37 @@ def test_txs_available_notification():
         assert mp.txs_available().is_set()  # b=2 still pending
 
     asyncio.run(run())
+
+
+def test_reactor_broadcast_disabled():
+    """config.mempool.broadcast=False: txs are accepted but never
+    gossiped (reference reactor.go:129 'Tx broadcasting is disabled')."""
+    import asyncio
+
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+    from tendermint_tpu.p2p.types import PeerStatus, PeerUpdate
+
+    class FakeChannel:
+        def __init__(self, desc):
+            self.descriptor = desc
+        async def receive(self):
+            await asyncio.Event().wait()  # block forever, like an idle net
+
+    class FakeRouter:
+        def open_channel(self, desc):
+            return FakeChannel(desc)
+        def subscribe_peer_updates(self):
+            self.q = asyncio.Queue()
+            return self.q
+
+    async def run():
+        router = FakeRouter()
+        mp = make_mempool()
+        r = MempoolReactor(mp, router, broadcast=False)
+        await r.start()
+        await router.q.put(PeerUpdate(node_id="aa" * 20, status=PeerStatus.UP))
+        await asyncio.sleep(0.05)
+        assert r._peer_tasks == {}  # no gossip task spawned
+        await r.stop()
+
+    asyncio.run(run())

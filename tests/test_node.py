@@ -345,3 +345,33 @@ def test_blocksync_reset_pool_reanchors():
         assert r.state.last_block_height == 5000
 
     asyncio.run(run())
+
+
+def test_pprof_listener(tmp_path):
+    """config.rpc.pprof_laddr serves the diagnostics endpoints
+    (reference node.go:858-863 net/http/pprof)."""
+    import urllib.request
+
+    async def run():
+        keys, gen = _genesis()
+        cfg = _node_config(tmp_path)
+        cfg.rpc.pprof_laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = keys[0]
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        try:
+            host, port = node.pprof_addr
+            def get(path):
+                with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=5) as r:
+                    return r.read().decode()
+            idx = await asyncio.to_thread(get, "/debug/pprof")
+            assert "goroutine" in idx
+            g = await asyncio.to_thread(get, "/debug/pprof/goroutine")
+            assert "asyncio tasks" in g and "thread" in g
+            h = await asyncio.to_thread(get, "/debug/pprof/heap")
+            assert "gc objects" in h
+        finally:
+            await node.stop()
+
+    asyncio.run(run())

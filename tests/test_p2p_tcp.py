@@ -221,3 +221,95 @@ def test_two_node_consensus_over_tcp(tmp_path):
             await n1.stop()
 
     asyncio.run(run())
+
+
+def test_evil_handshakes_rejected():
+    """Malicious handshake parity (reference
+    p2p/conn/evil_secret_connection_test.go): low-order ephemeral point,
+    garbage bytes instead of an encrypted auth frame, and a forged
+    challenge signature must all be rejected — never a hang or a
+    half-authenticated connection."""
+    import asyncio
+
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+    from tendermint_tpu.p2p.secret_connection import HandshakeError, SecretConnection
+
+    honest_key = priv_key_from_seed(b"\x21" * 32)
+
+    async def run_case(evil):
+        async def honest(reader, writer):
+            try:
+                await SecretConnection.handshake(reader, writer, honest_key,
+                                                 timeout=3.0)
+                return "accepted"
+            except (HandshakeError, ConnectionError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError, OSError) as e:
+                return f"rejected:{type(e).__name__}"
+            finally:
+                writer.close()
+
+        result = {}
+        async def server_cb(reader, writer):
+            result["verdict"] = await honest(reader, writer)
+            result["done"].set()
+
+        result["done"] = asyncio.Event()
+        server = await asyncio.start_server(server_cb, "127.0.0.1", 0)
+        host, port = server.sockets[0].getsockname()[:2]
+        r, w = await asyncio.open_connection(host, port)
+        try:
+            await evil(r, w)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            w.close()
+        await asyncio.wait_for(result["done"].wait(), 10)
+        server.close()
+        await server.wait_closed()
+        return result["verdict"]
+
+    async def main():
+        # 1. low-order ephemeral point (all zeros): X25519 all-zero shared
+        #    secret must be refused (reference secret_connection.go:44)
+        async def low_order(r, w):
+            w.write(b"\x00" * 32)
+            await w.drain()
+            await asyncio.sleep(0.2)
+        v = await run_case(low_order)
+        assert v.startswith("rejected"), v
+
+        # 2. valid ephemeral key, then plaintext garbage instead of an
+        #    encrypted auth frame: AEAD open fails
+        async def garbage_auth(r, w):
+            from cryptography.hazmat.primitives.asymmetric.x25519 import (
+                X25519PrivateKey,
+            )
+            eph = X25519PrivateKey.generate()
+            w.write(eph.public_key().public_bytes_raw())
+            await w.drain()
+            await r.readexactly(32)  # server's ephemeral
+            w.write(b"\xff" * 512)   # not a valid sealed frame
+            await w.drain()
+            await asyncio.sleep(0.2)
+        v = await run_case(garbage_auth)
+        assert v.startswith("rejected"), v
+
+        # 3. full protocol but the challenge signature is from a DIFFERENT
+        #    key than the advertised pubkey: authentication must fail
+        async def forged_sig(r, w):
+            evil_key = priv_key_from_seed(b"\x22" * 32)
+            other_key = priv_key_from_seed(b"\x23" * 32)
+
+            class LyingKey:
+                def sign(self, msg):
+                    return other_key.sign(msg)  # signature won't match
+                def pub_key(self):
+                    return evil_key.pub_key()
+            try:
+                await SecretConnection.handshake(r, w, LyingKey(), timeout=3.0)
+            except HandshakeError:
+                pass
+        v = await run_case(forged_sig)
+        assert v == "rejected:HandshakeError", v
+
+    asyncio.run(main())
