@@ -183,8 +183,30 @@ class ConsensusState:
                         fail_point()  # reference state.go:747 (own msg fsynced)
                         self.handle_msg(item)
                     else:
-                        self.wal.write(item)
-                        self.handle_msg(item)
+                        # drain everything else that arrived this tick and
+                        # batch-verify all vote signatures in it as one
+                        # device call (SURVEY §7 stage 6); each message is
+                        # then processed in arrival order exactly as the
+                        # sequential path would
+                        batch = [item]
+                        while len(batch) < 256:
+                            try:
+                                batch.append(self.peer_msg_queue.get_nowait())
+                            except asyncio.QueueEmpty:
+                                break
+                        if len(batch) > 1:
+                            self._precheck_vote_sigs(batch)
+                        for mi in batch:
+                            try:
+                                self.wal.write(mi)
+                                self.handle_msg(mi)
+                            except (ConsensusFailureError, OSError):
+                                raise
+                            except Exception as e:
+                                # one bad peer message must not drop the
+                                # rest of the tick's batch
+                                self.logger.error("consensus msg error",
+                                                  err=repr(e))
                 except (ConsensusFailureError, OSError):
                     # safety failures (broken commit path, WAL/disk errors)
                     # halt the node — continuing could double-sign or fork
@@ -195,6 +217,51 @@ class ConsensusState:
                 except Exception as e:
                     # bad peer input must not kill consensus: log and go on
                     self.logger.error("consensus msg error", err=repr(e))
+
+    def _precheck_vote_sigs(self, batch: list[MsgInfo]) -> None:
+        """Verify the signatures of every vote in this tick's peer
+        messages as ONE batched call (SURVEY §7 stage 6: amortize device
+        dispatch across the scheduler tick).  Valid signatures are marked
+        on the vote so the per-vote verify in VoteSet.add_vote
+        short-circuits; invalid ones are NOT marked and fail identically
+        in the sequential path.  Pure crypto — no consensus state is
+        touched, so WAL-before-act ordering is unaffected.  Never raises:
+        any backend failure just means no markers, and every message
+        still flows through the per-vote path."""
+        from tendermint_tpu.types.vote import batch_verify_votes
+
+        rs = self.rs
+        jobs = []
+        for mi in batch:
+            m = mi.msg
+            if not isinstance(m, VoteMessage):
+                continue
+            v = m.vote
+            if v.height == rs.height:
+                vals = rs.validators
+            elif v.height + 1 == rs.height and v.type == SignedMsgType.PRECOMMIT:
+                vals = rs.last_validators  # late precommits for H-1
+            else:
+                continue
+            if vals is None or not (0 <= v.validator_index < vals.size()):
+                continue
+            val = vals.get_by_index(v.validator_index)
+            if val is None or val.address != v.validator_address:
+                continue
+            jobs.append((v, val.pub_key))
+        if len(jobs) < 2:
+            return  # nothing to amortize
+        chain_id = self.state.chain_id
+        try:
+            oks = batch_verify_votes(chain_id, jobs)
+            for (v, pk), ok in zip(jobs, oks):
+                if ok:
+                    v.mark_sig_verified(chain_id, pk)
+        except Exception as e:
+            # a transient backend failure (device OOM, tunnel hiccup) must
+            # not drop the drained tick: without markers every vote simply
+            # re-verifies individually
+            self.logger.error("vote precheck batch failed", err=repr(e))
 
     def handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id

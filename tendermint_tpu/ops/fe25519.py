@@ -69,16 +69,22 @@ SQRT_M1_CONST = limbs_from_int(_ref.SQRT_M1)
 # Field ops  (all take/return [..., 15] int64)
 # ---------------------------------------------------------------------------
 
-def fe_carry(c: jnp.ndarray) -> jnp.ndarray:
+def fe_carry(c: jnp.ndarray, rounds: int = 4) -> jnp.ndarray:
     """Carry-propagate columns (each < 2^57) to reduced form (< 2^17.3).
 
     Vectorized relaxation instead of a sequential 15-step ripple: each
     round moves every limb's overflow one limb up simultaneously (the
-    2^255-weight top overflow re-enters limb 0 as ×19).  Bound: columns
-    C shrink to ≲ 20·C/2^17 + 2^17 per round, so 4 rounds take 2^57 →
+    2^255-weight top overflow re-enters limb 0 as ×19).  Bound: limbs
+    shrink to ≤ 2^17 + 19·C/2^17 per round, so 4 rounds take 2^57 →
     2^44.4 → 2^31.7 → 2^19.2 → < 2^17.3.  ~4 fused elementwise steps
-    with a 4-deep dependency chain, vs 15 sequential carry steps."""
-    for _ in range(4):
+    with a 4-deep dependency chain, vs 15 sequential carry steps.
+
+    rounds=3 is sound for C ≤ 2^52.6, which is exactly _fold_cols'
+    output bound: each round maps max limb C → 2^17 + 19·(C/2^17), so
+    2^52.6 → ≤ 2^40.0 → ≤ 2^27.2 → ≤ 2^17 + 19·2^10.2 ≈ 153k, under
+    the 2^17.3 (≈161k) reduced-form invariant.  Verified empirically at
+    the worst-case input bound (tests/test_ed25519_jax.py carry stress)."""
+    for _ in range(rounds):
         hi = c >> LIMB_BITS
         lo = c & MASK
         c = lo + jnp.concatenate(
@@ -88,11 +94,15 @@ def fe_carry(c: jnp.ndarray) -> jnp.ndarray:
 
 
 def _fold_cols(cols: jnp.ndarray) -> jnp.ndarray:
-    """Fold product columns [..., 29] at the 2^255 wrap (x19) and carry."""
+    """Fold product columns [..., 29] at the 2^255 wrap (x19) and carry.
+
+    Post-fold limb bound: schoolbook columns ≤ 281·2^40 < 2^48.2 (inputs
+    < 2^20 incl. the 19-fold inside fe_mul's analysis), so lo + 19·hi
+    < 2^48.2·20 < 2^52.6 — the rounds=3 carry regime."""
     lo = cols[..., :NLIMBS]
     hi = cols[..., NLIMBS:]
     lo = lo.at[..., : NLIMBS - 1].add(19 * hi)
-    return fe_carry(lo)
+    return fe_carry(lo, rounds=3)
 
 
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

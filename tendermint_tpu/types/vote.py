@@ -40,12 +40,32 @@ class Vote:
             chain_id, self.type, self.height, self.round, self.block_id, self.timestamp_ns
         )
 
+    def _precheck_digest(self, chain_id: str, pub_key: PubKey) -> bytes:
+        from tendermint_tpu.crypto import tmhash
+
+        return tmhash.sum_sha256(
+            chain_id.encode() + b"\x00" + pub_key.bytes_()
+            + self.sign_bytes(chain_id) + self.signature
+        )
+
     def verify(self, chain_id: str, pub_key: PubKey) -> None:
         """Address check + signature check (reference vote.go:147-156)."""
         if pub_key.address() != self.validator_address:
             raise ValueError("invalid validator address")
+        marker = getattr(self, "_sig_prechecked", None)
+        if marker is not None and marker == self._precheck_digest(chain_id, pub_key):
+            return  # this exact content+signature was batch-verified
         if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
             raise ValueError("invalid signature")
+
+    def mark_sig_verified(self, chain_id: str, pub_key: PubKey) -> None:
+        """Record that a batched precheck verified the signature
+        (consensus tick batching, SURVEY §7 stage 6) — verify() then
+        skips the redundant per-vote device/CPU call.  The marker binds
+        the FULL verified content (chain, key, sign-bytes, signature), so
+        mutating the vote after marking can never validate unchecked
+        bytes — it just falls back to a real verification."""
+        self._sig_prechecked = self._precheck_digest(chain_id, pub_key)
 
     def is_nil(self) -> bool:
         return self.block_id.is_zero()
@@ -128,3 +148,18 @@ class Vote:
             validator_index=to_int64(get(7, 0)),
             signature=get(8, b""),
         )
+
+
+def batch_verify_votes(chain_id: str, pairs: list[tuple["Vote", PubKey]]) -> list[bool]:
+    """ONE batched signature verification over (vote, pub_key) pairs;
+    returns a verdict per pair.  The single shared crypto path for every
+    vote-slice verifier: VoteSet.add_votes and the consensus tick
+    precheck (state._precheck_vote_sigs) — admission rules differ per
+    caller, the batched crypto must not."""
+    from tendermint_tpu.crypto import new_batch_verifier
+
+    bv = new_batch_verifier()
+    for v, pk in pairs:
+        bv.add(pk, v.sign_bytes(chain_id), v.signature)
+    _, oks = bv.verify()
+    return oks

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from tendermint_tpu.crypto import new_batch_verifier
 
 from .basic import BlockID, SignedMsgType
 from .commit import Commit, CommitSig
@@ -90,9 +89,11 @@ class VoteSet:
         Per-vote outcome: True (added), False (duplicate), or the exception
         that vote raised (invalid sig, conflict, ...).  State mutation is
         in input order, matching a sequential add_vote loop."""
+        from tendermint_tpu.types.vote import batch_verify_votes
+
         outcomes: list[bool | Exception] = [None] * len(votes)  # type: ignore[list-item]
         to_verify: list[int] = []
-        bv = new_batch_verifier()
+        pairs = []
         for i, vote in enumerate(votes):
             try:
                 self._validate(vote)
@@ -100,9 +101,9 @@ class VoteSet:
                 outcomes[i] = e
                 continue
             val = self.val_set.get_by_index(vote.validator_index)
-            bv.add(val.pub_key, vote.sign_bytes(self.chain_id), vote.signature)
+            pairs.append((vote, val.pub_key))
             to_verify.append(i)
-        _, oks = bv.verify()
+        oks = batch_verify_votes(self.chain_id, pairs)
         for ok, i in zip(oks, to_verify):
             vote = votes[i]
             if not ok:

@@ -199,3 +199,36 @@ def test_jax_batch_verifier_interface():
     assert not ok
     assert oks == [True, True, True, False, True]
     assert bv.count() == 0
+
+
+def test_carry_stress_at_worst_case_bounds():
+    """The rounds=3 carry regime for multiply outputs, exercised at the
+    worst representable inputs: all limbs at the pt_add/pt_dbl headroom
+    ceiling (fe_sub outputs ~2^19.5).  Any under-carry shows up as a
+    non-reduced limb or a wrong canonical value vs big-int math."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tendermint_tpu.ops import fe25519 as fe
+
+    rng = np.random.default_rng(7)
+    # worst case: limbs near 722k (the F-bound in pt_dbl) and mixed
+    # random values, squared and multiplied repeatedly
+    worst = np.full((4, fe.NLIMBS), 722_000, dtype=np.int64)
+    rand = rng.integers(0, 1 << 19, size=(4, fe.NLIMBS), dtype=np.int64)
+    for a in (worst, rand):
+        for b in (worst, rand):
+            got = np.asarray(fe.fe_mul(jnp.asarray(a), jnp.asarray(b)))
+            assert got.max() < (1 << 18), f"limb not reduced: {got.max()}"
+            for row_a, row_b, row_g in zip(a, b, got):
+                va = fe.int_from_limbs(row_a)
+                vb = fe.int_from_limbs(row_b)
+                vg = fe.int_from_limbs(
+                    np.asarray(fe.fe_canonical(jnp.asarray(row_g))))
+                assert vg == (va * vb) % fe.P
+        got = np.asarray(fe.fe_sq(jnp.asarray(a)))
+        assert got.max() < (1 << 18)
+        for row_a, row_g in zip(a, got):
+            va = fe.int_from_limbs(row_a)
+            vg = fe.int_from_limbs(np.asarray(fe.fe_canonical(jnp.asarray(row_g))))
+            assert vg == (va * va) % fe.P
