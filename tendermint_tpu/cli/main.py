@@ -295,17 +295,11 @@ def cmd_signer(args) -> int:
     return 0
 
 
-def cmd_debug(args) -> int:
-    """Snapshot a running node's observable state over RPC into a
-    directory (reference cmd/tendermint/commands/debug: dump.go collects
-    status, consensus state, net info; SIGABRT profiles don't apply)."""
+def _debug_snapshot(out: str, base: str, pprof_base: str, home: str) -> list[str]:
+    """One archive of a running node's observable state."""
     import urllib.request
 
-    out = args.output_dir
     os.makedirs(out, exist_ok=True)
-    base = args.rpc_laddr or "http://127.0.0.1:26657"
-    if base.startswith("tcp://"):
-        base = "http://" + base[len("tcp://"):]
     collected = []
     for route in ("status", "consensus_state", "dump_consensus_state",
                   "net_info", "num_unconfirmed_txs", "genesis"):
@@ -317,16 +311,62 @@ def cmd_debug(args) -> int:
             collected.append(route)
         except Exception as e:
             print(f"skip {route}: {e}", file=sys.stderr)
-    # include the node's config for context
-    home = _home(args)
+    if pprof_base:
+        # goroutine/heap analogs (reference dump.go profile collection)
+        for ep in ("goroutine", "heap"):
+            try:
+                with urllib.request.urlopen(
+                    f"{pprof_base}/debug/pprof/{ep}", timeout=10
+                ) as r:
+                    with open(os.path.join(out, f"pprof_{ep}.txt"), "wb") as fh:
+                        fh.write(r.read())
+                collected.append(f"pprof_{ep}")
+            except Exception as e:
+                print(f"skip pprof {ep}: {e}", file=sys.stderr)
     cfg_path = os.path.join(home, "config", "config.toml")
     if os.path.exists(cfg_path):
         import shutil as _sh
 
         _sh.copy(cfg_path, os.path.join(out, "config.toml"))
         collected.append("config.toml")
-    print(f"wrote {len(collected)} artifacts to {out}: {', '.join(collected)}")
-    return 0 if collected else 1
+    return collected
+
+
+def cmd_debug(args) -> int:
+    """Snapshot a running node's observable state over RPC into a
+    directory (reference cmd/tendermint/commands/debug: dump.go —
+    one-shot, or periodic archives with --interval)."""
+    import time as _time
+
+    base = args.rpc_laddr or "http://127.0.0.1:26657"
+    if base.startswith("tcp://"):
+        base = "http://" + base[len("tcp://"):]
+    pprof_base = args.pprof_laddr or ""
+    if pprof_base.startswith("tcp://"):
+        pprof_base = "http://" + pprof_base[len("tcp://"):]
+    home = _home(args)
+
+    if not args.interval:
+        collected = _debug_snapshot(args.output_dir, base, pprof_base, home)
+        print(f"wrote {len(collected)} artifacts to {args.output_dir}: "
+              f"{', '.join(collected)}")
+        return 0 if collected else 1
+
+    # periodic mode (reference debug dump --frequency)
+    n = 0
+    try:
+        while args.count == 0 or n < args.count:
+            stamp = _time.strftime("%Y%m%d-%H%M%S")
+            out = os.path.join(args.output_dir, stamp)
+            collected = _debug_snapshot(out, base, pprof_base, home)
+            n += 1
+            print(f"[{stamp}] archive {n}: {len(collected)} artifacts")
+            if args.count and n >= args.count:
+                break
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def cmd_replay(args) -> int:
@@ -577,7 +617,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("debug", help="snapshot a running node's state over RPC")
     sp.add_argument("--rpc-laddr", dest="rpc_laddr", default="http://127.0.0.1:26657")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr", default="",
+                    help="also scrape /debug/pprof from this address")
     sp.add_argument("--output-dir", dest="output_dir", default="./debug-dump")
+    sp.add_argument("--interval", type=int, default=0,
+                    help="seconds between periodic archives (0 = one-shot)")
+    sp.add_argument("--count", type=int, default=0,
+                    help="number of periodic archives (0 = until interrupted)")
     sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("replay", help="replay block store + WAL through the app")

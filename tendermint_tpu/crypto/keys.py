@@ -50,7 +50,9 @@ class PubKey:
         return tmhash.sum_truncated(self._bytes)
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
-        return _ed.verify(self._bytes, msg, sig)
+        # libcrypto fast path with pure-ZIP-215 fallback on rejection —
+        # verdicts bit-identical to _ed.verify (see verify_fast)
+        return _ed.verify_fast(self._bytes, msg, sig)
 
     def type(self) -> str:
         return KEY_TYPE

@@ -34,7 +34,8 @@ def decode_txs(data: bytes) -> list[bytes]:
 
 class MempoolReactor:
     def __init__(self, mempool: Mempool, router, logger: Logger | None = None,
-                 gossip_sleep_ms: int = 100, broadcast: bool = True):
+                 gossip_sleep_ms: int = 100, broadcast: bool = True,
+                 peer_height=None):
         self.mempool = mempool
         self.router = router
         self.logger = logger or nop_logger()
@@ -42,6 +43,10 @@ class MempoolReactor:
         # reference config.Mempool.Broadcast: false = accept txs but never
         # gossip them (reactor.go:129 "Tx broadcasting is disabled")
         self.broadcast = broadcast
+        # optional callable(node_id) -> int | None: the peer's consensus
+        # height (reference reactor.go:232-260 peer-height gating — don't
+        # push txs a syncing peer can't process yet)
+        self.peer_height = peer_height
         self.ch = router.open_channel(
             ChannelDescriptor(
                 channel_id=MEMPOOL_CHANNEL,
@@ -101,6 +106,15 @@ class MempoolReactor:
                     key = sum_sha256(memtx.tx)
                     if key in sent:
                         continue
+                    if self.peer_height is not None:
+                        h = self.peer_height(node_id)
+                        # reference reactor.go:246-252: hold gossip until
+                        # the peer is within one height of this tx.  An
+                        # unknown/zero height means the peer is still
+                        # syncing (no NewRoundStep yet) — exactly the case
+                        # to hold for; the outer sleep paces the retry.
+                        if not h or h < memtx.height - 1:
+                            break
                     sent.add(key)
                     advanced = True
                     if node_id in memtx.senders:

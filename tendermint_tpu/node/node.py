@@ -293,9 +293,14 @@ class Node:
             self.consensus.broadcast_vote = lambda v: self.consensus_reactor.vote_ch.try_send(
                 Envelope(message=VoteMessage(v), broadcast=True)
             )
+        def _peer_consensus_height(node_id: str):
+            ps = self.consensus_reactor.peers.get(node_id)
+            return ps.prs.height if ps is not None else None
+
         self.mempool_reactor = MempoolReactor(
             self.mempool, self.router, logger=self.logger,
             broadcast=config.mempool.broadcast,
+            peer_height=_peer_consensus_height,
         )
         self.evidence_reactor = EvidenceReactor(
             self.evidence_pool, self.router, logger=self.logger

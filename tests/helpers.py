@@ -20,7 +20,14 @@ from tendermint_tpu.types.vote import SignedMsgType, vote_sign_bytes_raw
 
 
 def make_keys(n, power=10, chain_id="test-chain", seed_mult=11, seed_add=3):
-    keys = [priv_key_from_seed(bytes([seed_mult * i + seed_add]) * 32) for i in range(n)]
+    # single-byte repeating seeds while they fit (the historical scheme —
+    # existing suites derive fixtures from these); 4-byte little-endian
+    # seeds beyond that (the 200-validator bench overflows bytes([x]))
+    def seed(i):
+        x = seed_mult * i + seed_add
+        return bytes([x]) * 32 if x < 256 else x.to_bytes(4, "little") * 8
+
+    keys = [priv_key_from_seed(seed(i)) for i in range(n)]
     genesis = GenesisDoc(
         chain_id=chain_id,
         genesis_time_ns=1_700_000_000 * 10**9,

@@ -114,3 +114,51 @@ def test_cpu_batch_verifier():
         bv.add(k.pub_key(), m, sig)
     ok, oks = bv.verify()
     assert not ok and oks == [True, True, False, True]
+
+
+def test_verify_fast_bit_identical_to_reference():
+    """The libcrypto fast path must agree with the pure ZIP-215 reference
+    on EVERY adversarial case: small-order points, non-canonical
+    encodings, torsion components, tampered sigs, valid sigs.  (OpenSSL
+    acceptance implies ZIP-215 acceptance; rejections re-check — this
+    test pins that equivalence over the full corpus.)"""
+    import secrets
+
+    # the fast path must actually exist in this environment — without
+    # libcrypto the test would vacuously compare verify to itself
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (  # noqa: F401
+        Ed25519PublicKey,
+    )
+
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    cases = []
+    # honest signatures
+    for i in range(8):
+        seed = secrets.token_bytes(32)
+        pub = ed.pubkey_from_seed(seed)
+        msg = b"fast-path-%d" % i
+        cases.append((pub, msg, ed.sign(seed, msg)))
+        # tampered message + tampered sig
+        sig = ed.sign(seed, msg)
+        cases.append((pub, msg + b"x", sig))
+        cases.append((pub, msg, sig[:-1] + bytes([sig[-1] ^ 1])))
+    # small-order/torsion and non-canonical encodings
+    for pt in ed.eight_torsion_points():
+        enc0 = ed.encode_point(pt)
+        cases.append((enc0, b"m", enc0 + (0).to_bytes(32, "little")))
+        for enc in ed.noncanonical_encodings(pt):
+            cases.append((enc, b"m", enc + (0).to_bytes(32, "little")))
+    # s >= L (non-canonical scalar)
+    seed = secrets.token_bytes(32)
+    pub = ed.pubkey_from_seed(seed)
+    sig = ed.sign(seed, b"m")
+    bad_s = (int.from_bytes(sig[32:], "little") + ed.L).to_bytes(32, "little")
+    cases.append((pub, b"m", sig[:32] + bad_s))
+    # malformed lengths
+    cases.append((pub[:31], b"m", sig))
+    cases.append((pub, b"m", sig[:63]))
+
+    for pub, msg, sig in cases:
+        assert ed.verify_fast(pub, msg, sig) == ed.verify(pub, msg, sig), (
+            pub.hex(), sig.hex())

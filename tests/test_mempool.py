@@ -162,12 +162,59 @@ def test_reactor_broadcast_disabled():
 
     async def run():
         router = FakeRouter()
-        mp = make_mempool()
+        mp, _app = make_mempool()
         r = MempoolReactor(mp, router, broadcast=False)
         await r.start()
         await router.q.put(PeerUpdate(node_id="aa" * 20, status=PeerStatus.UP))
         await asyncio.sleep(0.05)
         assert r._peer_tasks == {}  # no gossip task spawned
         await r.stop()
+
+    asyncio.run(run())
+
+
+def test_reactor_peer_height_gating():
+    """Gossip holds txs from a peer that is syncing more than one height
+    behind the tx (reference reactor.go:246-252), resuming when the peer
+    catches up."""
+    import asyncio
+
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+    from tendermint_tpu.p2p.types import Envelope
+
+    async def run():
+        sent: list[Envelope] = []
+
+        class FakeChannel:
+            def __init__(self, desc):
+                self.descriptor = desc
+            async def receive(self):
+                await asyncio.Event().wait()
+            async def send(self, env):
+                sent.append(env)
+
+        class FakeRouter:
+            def open_channel(self, desc):
+                return FakeChannel(desc)
+            def subscribe_peer_updates(self):
+                return asyncio.Queue()
+
+        mp, _app = make_mempool()
+        mp.height = 10  # txs enter at height 10
+        mp.check_tx(b"gated=tx")
+
+        peer_h = {"v": 3}  # far behind
+        r = MempoolReactor(mp, FakeRouter(), gossip_sleep_ms=10,
+                           peer_height=lambda nid: peer_h["v"])
+        task = asyncio.get_running_loop().create_task(r._gossip("aa" * 20))
+        await asyncio.sleep(0.1)
+        assert sent == []  # held back
+        peer_h["v"] = 9  # within one height of the tx
+        for _ in range(100):
+            if sent:
+                break
+            await asyncio.sleep(0.01)
+        task.cancel()
+        assert len(sent) == 1 and sent[0].message == [b"gated=tx"]
 
     asyncio.run(run())
