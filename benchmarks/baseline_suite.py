@@ -129,15 +129,19 @@ def bench_verify_adjacent(n_vals: int, runs: int) -> None:
     )
 
 
-def bench_fastsync_replay(n_blocks: int, n_vals: int) -> None:
-    """Config 4: fast-sync replay throughput — verify_commit_light per
-    block + ApplyBlock on kvstore (reference blockchain/v0 poolRoutine)."""
+def bench_fastsync_replay(n_blocks: int, n_vals: int, window: int = 64) -> None:
+    """Config 4: fast-sync replay — the framework's ACTUAL pipeline shape:
+    whole windows of LastCommits verified as one batched device call
+    (blocksync reactor / types.batch_verify_commits), then ApplyBlock on
+    kvstore per block (reference blockchain/v0 poolRoutine does one
+    sequential verify + apply per block)."""
     from helpers import ChainBuilder
 
     from tendermint_tpu.abci import AppConns
     from tendermint_tpu.abci.kvstore import KVStoreApplication
     from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
     from tendermint_tpu.store import BlockStore, MemDB
+    from tendermint_tpu.types.validator import CommitVerifyJob, batch_verify_commits
 
     build_t0 = time.perf_counter()
     b = ChainBuilder(n_vals=n_vals, chain_id="bench-chain")
@@ -151,29 +155,47 @@ def bench_fastsync_replay(n_blocks: int, n_vals: int) -> None:
     state_store.save(state)
     execu = BlockExecutor(state_store, AppConns(KVStoreApplication()).consensus())
 
+    verify_s = 0.0
     t0 = time.perf_counter()
-    for h in range(1, n_blocks + 1):
-        block = b.block_store.load_block(h)
-        commit = b.block_store.load_block_commit(h) or b.block_store.load_seen_commit(h)
-        # pair verification exactly like the pool routine: current state's
-        # validators attest the commit for this block
-        state.validators.verify_commit_light(
-            state.chain_id, commit.block_id, h, commit
-        )
-        parts = block.make_part_set()
-        store.save_block(block, parts, commit)
-        state, _ = execu.apply_block(state, commit.block_id, block)
+    h = 1
+    while h <= n_blocks:
+        hi = min(h + window - 1, n_blocks)
+        blocks, commits, jobs = [], [], []
+        for hh in range(h, hi + 1):
+            block = b.block_store.load_block(hh)
+            commit = (b.block_store.load_block_commit(hh)
+                      or b.block_store.load_seen_commit(hh))
+            blocks.append(block)
+            commits.append(commit)
+            # validator set is static in this fixture, so the whole
+            # window shares one set — exactly the blocksync window case
+            jobs.append(CommitVerifyJob(
+                val_set=state.validators, chain_id=state.chain_id,
+                block_id=commit.block_id, height=hh, commit=commit,
+                mode="light",
+            ))
+        v0 = time.perf_counter()
+        batch_verify_commits(jobs)
+        verify_s += time.perf_counter() - v0
+        for block, commit in zip(blocks, commits):
+            parts = block.make_part_set()
+            store.save_block(block, parts, commit)
+            state, _ = execu.apply_block(state, commit.block_id, block)
+        h = hi + 1
     sec = time.perf_counter() - t0
     per_block_sig_cost = _sequential_baseline_per_sig() * (n_vals * 2 / 3)
-    base_total = per_block_sig_cost * n_blocks
+    base_verify_total = per_block_sig_cost * n_blocks
     _emit(
         f"fastsync_replay_{n_blocks}x{n_vals}",
         n_blocks / sec,
         "blocks/s",
-        base_total / sec,
+        base_verify_total / verify_s if verify_s else 0.0,
         {
-            "note": "vs_baseline = verify-time speedup over sequential CPU loop "
-                    "(excl. apply); build_s is fixture prep, not measured",
+            "note": "vs_baseline = commit-verification speedup vs sequential "
+                    "CPU loop (batched windows of %d); verify_s/total_s split "
+                    "shows where time goes" % window,
+            "verify_s": round(verify_s, 2),
+            "total_s": round(sec, 2),
             "build_s": round(build_s, 1),
         },
     )

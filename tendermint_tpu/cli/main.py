@@ -238,15 +238,28 @@ def cmd_testnet(args) -> int:
         validators=[GenesisValidator(pub_key=pv.get_pub_key(), power=1)
                     for pv in pvs],
     )
-    peers = ",".join(
-        f"{nks[i].node_id}@{args.hostname}:{args.starting_port + 2 * i}"
-        for i in range(n)
-    )
+    if args.per_host:
+        # one node per host (docker-compose / real deployments): every
+        # node uses the standard ports, peers resolve by hostname
+        # (reference testnet.go --hostname-prefix)
+        peers = ",".join(
+            f"{nks[i].node_id}@{args.node_dir_prefix}{i}:26656"
+            for i in range(n)
+        )
+    else:
+        peers = ",".join(
+            f"{nks[i].node_id}@{args.hostname}:{args.starting_port + 2 * i}"
+            for i in range(n)
+        )
     for i, home in enumerate(homes):
         cfg = default_config(home)
         cfg.base.moniker = f"node{i}"
-        cfg.p2p.laddr = f"tcp://0.0.0.0:{args.starting_port + 2 * i}"
-        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
+        if args.per_host:
+            cfg.p2p.laddr = "tcp://0.0.0.0:26656"
+            cfg.rpc.laddr = "tcp://0.0.0.0:26657"
+        else:
+            cfg.p2p.laddr = f"tcp://0.0.0.0:{args.starting_port + 2 * i}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
         cfg.p2p.persistent_peers = ",".join(
             p for j, p in enumerate(peers.split(",")) if j != i
         )
@@ -255,6 +268,107 @@ def cmd_testnet(args) -> int:
             fh.write(gen.to_json())
     print(f"wrote {n} node homes under {out} (chain {chain_id})")
     return 0
+
+
+def cmd_signer_harness(args) -> int:
+    """Conformance-test a remote signer (reference
+    tools/tm-signer-harness/internal/test_harness.go): listen like a
+    node, wait for the signer to dial in, then check (1) the public key
+    matches this home's validator key, (2) proposal signing verifies,
+    (3) prevote/precommit signing verifies, (4) the signer refuses a
+    conflicting sign request at the same height/round/step."""
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.crypto import tmhash
+    from tendermint_tpu.privval.file_pv import load_or_gen_file_pv
+    from tendermint_tpu.privval.socket_pv import SignerClient
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+    from tendermint_tpu.types.proposal import Proposal
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.utils.log import new_logger
+
+    logger = new_logger(level="info")
+    cfg = load_config(_home(args))
+    chain_id = args.chain_id
+    host, port = args.addr.rsplit(":", 1)
+
+    client = SignerClient(host=host.replace("tcp://", ""), port=int(port),
+                          logger=logger)
+    addr = client.start()
+    logger.info("harness listening; start the signer now",
+                addr=f"{addr[0]}:{addr[1]}")
+    failures = 0
+    try:
+        client.wait_for_signer(timeout=args.accept_timeout)
+
+        # 1. public key (test_harness.go TestPublicKey)
+        remote = client.get_pub_key()
+        local_pv = load_or_gen_file_pv(cfg.priv_validator_key_file,
+                                       cfg.priv_validator_state_file)
+        local = local_pv.get_pub_key()
+        if remote.bytes_() == local.bytes_():
+            logger.info("PASS public key matches", key=remote.bytes_().hex()[:16])
+        else:
+            logger.error("FAIL public key mismatch",
+                         local=local.bytes_().hex()[:16],
+                         remote=remote.bytes_().hex()[:16])
+            failures += 1
+
+        h = tmhash.sum_sha256(b"hash")
+        bid = BlockID(hash=h, part_set_header=PartSetHeader(total=100, hash=h))
+
+        # 2. proposal signing (TestSignProposal)
+        prop = Proposal(height=100, round=0, pol_round=-1, block_id=bid,
+                        timestamp_ns=1_700_000_000 * 10**9)
+        try:
+            client.sign_proposal(chain_id, prop)
+            if remote.verify_signature(prop.sign_bytes(chain_id), prop.signature):
+                logger.info("PASS proposal signature verifies")
+            else:
+                logger.error("FAIL proposal signature invalid")
+                failures += 1
+        except Exception as e:
+            logger.error("FAIL proposal signing", err=str(e))
+            failures += 1
+
+        # 3. votes (TestSignVote: prevote + precommit)
+        for vt in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):
+            v = Vote(type=vt, height=100, round=0, block_id=bid,
+                     timestamp_ns=1_700_000_000 * 10**9,
+                     validator_address=remote.address(), validator_index=0)
+            try:
+                client.sign_vote(chain_id, v)
+                if remote.verify_signature(v.sign_bytes(chain_id), v.signature):
+                    logger.info("PASS vote signature verifies", type=vt.name)
+                else:
+                    logger.error("FAIL vote signature invalid", type=vt.name)
+                    failures += 1
+            except Exception as e:
+                logger.error("FAIL vote signing", err=str(e), type=vt.name)
+                failures += 1
+
+        # 4. double-sign refusal: same HRS, different block
+        h2 = tmhash.sum_sha256(b"other")
+        conflicting = Vote(
+            type=SignedMsgType.PRECOMMIT, height=100, round=0,
+            block_id=BlockID(hash=h2,
+                             part_set_header=PartSetHeader(total=100, hash=h2)),
+            timestamp_ns=1_700_000_001 * 10**9,
+            validator_address=remote.address(), validator_index=0,
+        )
+        try:
+            client.sign_vote(chain_id, conflicting)
+            logger.error("FAIL signer double-signed a conflicting precommit")
+            failures += 1
+        except Exception:
+            logger.info("PASS signer refused the conflicting precommit")
+    except Exception as e:
+        logger.error("harness aborted", err=str(e))
+        failures += 1
+    finally:
+        client.close()
+    print(f"signer-harness: {4 - min(failures, 4)}/4 checks passed"
+          if failures <= 4 else f"signer-harness: failures={failures}")
+    return 1 if failures else 0
 
 
 def cmd_signer(args) -> int:
@@ -613,6 +727,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--node-dir-prefix", default="node")
     sp.add_argument("--hostname", default="127.0.0.1")
     sp.add_argument("--starting-port", type=int, default=26656)
+    sp.add_argument("--per-host", dest="per_host", action="store_true",
+                    help="one node per host: standard ports, hostname peers "
+                         "(docker-compose layout)")
     sp.set_defaults(fn=cmd_testnet)
 
     sp = sub.add_parser("debug", help="snapshot a running node's state over RPC")
@@ -661,6 +778,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sp.add_argument("--log-level", dest="log_level", default="info")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("signer-harness",
+                        help="conformance-test a remote signer")
+    sp.add_argument("chain_id")
+    sp.add_argument("--addr", default="127.0.0.1:0",
+                    help="host:port to listen on for the signer")
+    sp.add_argument("--accept-timeout", dest="accept_timeout", type=float,
+                    default=60.0)
+    sp.set_defaults(fn=cmd_signer_harness)
 
     sp = sub.add_parser("signer", help="run a remote signer")
     sp.add_argument("--addr", required=True,

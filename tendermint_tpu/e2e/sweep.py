@@ -1,0 +1,71 @@
+"""Nightly-style e2e sweep: run a batch of generated manifests.
+
+Parity: reference .github/workflows/e2e-nightly.yml + test/e2e/generator
+— the randomized-config testnet sweep.  Each manifest gets a fresh
+temp dir; results are printed per manifest and the exit code is the
+failure count.
+
+    python -m tendermint_tpu.e2e.sweep --seed 7 --n 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import shutil
+import sys
+import tempfile
+import traceback
+
+from tendermint_tpu.e2e.generator import generate
+from tendermint_tpu.e2e.runner import Testnet
+
+
+async def run_manifest(manifest: dict, root: str, timeout: float = 300.0) -> None:
+    net = Testnet(manifest, root)
+    net.setup()
+    net.start()
+    try:
+        target = manifest["target_height"]
+        await net.wait_for_height(target, timeout=timeout)
+        if manifest.get("load_rate"):
+            await net.load(total_txs=min(10, manifest["load_rate"] * 2),
+                           rate=manifest["load_rate"])
+        net.check_blocks_identical(target)
+        net.check_app_hashes_agree()
+    finally:
+        net.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--keep", action="store_true", help="keep testnet dirs")
+    args = ap.parse_args(argv)
+
+    manifests = generate(args.seed, args.n)
+    failures = 0
+    for i, m in enumerate(manifests):
+        root = tempfile.mkdtemp(prefix=f"tmtpu-sweep-{i}-")
+        label = (f"[{i + 1}/{len(manifests)}] {m['chain_id']}: "
+                 f"{m['validators']} vals, target {m['target_height']}, "
+                 f"perturb={len(m.get('perturb', []))}, "
+                 f"byzantine={'yes' if m.get('misbehaviors') else 'no'}")
+        try:
+            asyncio.run(run_manifest(m, root, timeout=args.timeout))
+            print(f"PASS {label}")
+        except Exception:
+            failures += 1
+            print(f"FAIL {label}")
+            traceback.print_exc()
+        finally:
+            if not args.keep:
+                shutil.rmtree(root, ignore_errors=True)
+    print(f"{len(manifests) - failures}/{len(manifests)} manifests passed")
+    return failures
+
+
+if __name__ == "__main__":
+    sys.exit(main())

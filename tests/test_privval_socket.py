@@ -231,3 +231,48 @@ def test_node_with_remote_signer_produces_blocks(tmp_path):
             signer.stop()
 
     asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_signer_harness_cli(tmp_path):
+    """The signer-harness CLI passes all checks against the real signer
+    subprocess (reference tools/tm-signer-harness)."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TM_TPU_CRYPTO_BACKEND="cpu")
+    home = str(tmp_path / "h")
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home,
+         "init", "--chain-id", "hc"],
+        env=env, check=True, capture_output=True, timeout=60,
+    )
+    harness = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home,
+         "signer-harness", "hc", "--addr", "127.0.0.1:0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # scrape the ephemeral listen port from the harness log line
+    addr = None
+    deadline = time.time() + 30
+    lines = []
+    while time.time() < deadline and addr is None:
+        line = harness.stdout.readline()
+        lines.append(line)
+        if "harness listening" in line:
+            addr = line.rsplit("addr=", 1)[1].strip()
+    assert addr, lines
+    signer = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cli", "--home", home,
+         "signer", "--addr", addr],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        out, _ = harness.communicate(timeout=60)
+        assert harness.returncode == 0, out
+        assert "4/4 checks passed" in (("".join(lines)) + out)
+    finally:
+        signer.kill()
+        harness.kill()
