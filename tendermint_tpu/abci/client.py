@@ -48,6 +48,15 @@ class LocalClient:
         with self._lock:
             return self._app.deliver_tx(req)
 
+    def deliver_tx_batch(self, txs: list[bytes]) -> list[abci.ResponseDeliverTx]:
+        """Part of the client interface (reference pipelines DeliverTxAsync,
+        execution.go:276-328).  In-process there is no round trip to hide:
+        one lock hold for the whole block keeps order and atomicity."""
+        with self._lock:
+            return [
+                self._app.deliver_tx(abci.RequestDeliverTx(tx=tx)) for tx in txs
+            ]
+
     def end_block_sync(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
         with self._lock:
             return self._app.end_block(req)

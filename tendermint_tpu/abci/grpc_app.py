@@ -154,6 +154,17 @@ class GRPCAppClient:
     def begin_block_sync(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
         return self._call(wire.BEGIN_BLOCK, req)
 
+    def deliver_tx_batch(self, txs: list[bytes]) -> list[abci.ResponseDeliverTx]:
+        """Part of the client interface.  gRPC stays per-call sequential —
+        matching the reference's gRPC client ("async is emulated",
+        grpc_client.go): concurrent unary calls over one channel carry NO
+        server-side ordering guarantee, and DeliverTx order is
+        state-machine-deterministic.  The pipelined wire transport is the
+        socket client; use it when DeliverTx round-trip latency matters."""
+        return [
+            self.deliver_tx_sync(abci.RequestDeliverTx(tx=tx)) for tx in txs
+        ]
+
     def deliver_tx_sync(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
         return self._call(wire.DELIVER_TX, req)
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from tendermint_tpu.types.block import Block
-from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict, to_int64
+from tendermint_tpu.wire.proto import guard_decode, ProtoWriter, fields_to_dict, to_int64
 
 
 @dataclass
@@ -102,6 +102,7 @@ def encode_blocksync_message(msg) -> bytes:
     return ProtoWriter().message(fld, msg.encode(), always=True).bytes_out()
 
 
+@guard_decode
 def decode_blocksync_message(data: bytes):
     f = fields_to_dict(data)
     for t, fld in _FIELD.items():

@@ -19,7 +19,7 @@ from tendermint_tpu.types import BlockID, Proposal, Vote
 from tendermint_tpu.types.basic import PartSetHeader, SignedMsgType
 from tendermint_tpu.types.part_set import Part
 from tendermint_tpu.utils.bits import BitArray
-from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict, to_int64
+from tendermint_tpu.wire.proto import guard_decode, ProtoWriter, fields_to_dict, to_int64
 
 
 # ---------------------------------------------------------------------------
@@ -279,6 +279,7 @@ def encode_consensus_message(msg) -> bytes:
     return ProtoWriter().message(fld, msg.encode(), always=True).bytes_out()
 
 
+@guard_decode
 def decode_consensus_message(data: bytes):
     f = fields_to_dict(data)
     for t, fld in _GOSSIP_FIELD.items():
@@ -396,6 +397,7 @@ def encode_wal_message(msg) -> bytes:
     return ProtoWriter().message(fld, msg.encode(), always=True).bytes_out()
 
 
+@guard_decode
 def decode_wal_message(data: bytes):
     f = fields_to_dict(data)
     for fld, t in _WAL_TYPES.items():

@@ -821,6 +821,16 @@ class ConsensusState:
         if rs.proposal_block_parts is None:
             return False
         added = rs.proposal_block_parts.add_part(part)
+        if added and rs.proposal_block_parts.byte_size > (
+            self.state.consensus_params.block.max_bytes
+        ):
+            # oversized proposal: drop it entirely so the round times out
+            # and we prevote nil (reference state.go addProposalBlockPart's
+            # ByteSize > MaxBytes error path)
+            rs.proposal_block_parts = None
+            raise ValueError(
+                "total size of proposal block parts exceeds block.max_bytes"
+            )
         if not added or not rs.proposal_block_parts.is_complete():
             return added
 

@@ -69,9 +69,18 @@ def decode_records(data: bytes):
         payload = data[pos + 8 : pos + 8 + length]
         if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
             raise DataCorruptionError("CRC mismatch")
-        f = fields_to_dict(payload)
-        time_ns = to_int64(f.get(1, [0])[0])
-        msg = decode_wal_message(f[2][0])
+        try:
+            # framing can be intact while the payload is not a WAL
+            # message (CRC-valid garbage); that is corruption too, not a
+            # KeyError/TypeError to leak to replay (fuzz contract,
+            # tests/test_fuzz_decoders.py)
+            f = fields_to_dict(payload)
+            time_ns = to_int64(f.get(1, [0])[0])
+            msg = decode_wal_message(f[2][0])
+        except DataCorruptionError:
+            raise
+        except Exception as e:
+            raise DataCorruptionError(f"undecodable WAL payload: {e!r}") from e
         yield TimedWALMessage(time_ns, msg)
         pos += 8 + length
 

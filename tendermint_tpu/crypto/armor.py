@@ -71,8 +71,12 @@ def decode_armor(armor_str: str) -> tuple[str, dict[str, str], bytes]:
         data = base64.b64decode("".join(body_lines), validate=True)
     except Exception as e:
         raise ValueError(f"invalid armor body: {e}") from e
-    if checksum is not None:
-        want = base64.b64encode(_crc24(data).to_bytes(3, "big")).decode()
-        if checksum != want:
-            raise ValueError("invalid armor: CRC mismatch")
+    # the checksum line is mandatory: key-at-rest material with a deleted
+    # or mangled '=' line must not decode (matches the reference's
+    # openpgp/armor decoder strictness)
+    if checksum is None:
+        raise ValueError("invalid armor: missing CRC-24 checksum line")
+    want = base64.b64encode(_crc24(data).to_bytes(3, "big")).decode()
+    if checksum != want:
+        raise ValueError("invalid armor: CRC mismatch")
     return block_type, headers, data

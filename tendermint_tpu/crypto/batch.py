@@ -78,13 +78,19 @@ class JAXBatchVerifier(_BaseBatch):
     host→device round trip dwarfs a handful of verifies, and consensus
     liveness depends on small vote batches staying sub-millisecond
     (SURVEY §7 hard part 2 — deadline flush with CPU fallback for
-    singletons)."""
+    singletons).
+
+    On a multi-device mesh the SAME production path shards the batch axis
+    across all devices (tendermint_tpu.parallel.sharding) — this is what
+    `dryrun_multichip` exercises and what a pod deployment runs; a 10k-sig
+    commit splits across ICI with zero collectives."""
 
     def __init__(self, cpu_threshold: int | None = None) -> None:
         super().__init__()
         from tendermint_tpu.ops import ed25519_jax  # lazy: jax import
 
         self._impl = ed25519_jax
+        self._n_devices: int | None = None  # resolved on first device call
         if cpu_threshold is None:
             # breakeven = device round-trip latency / host per-sig cost.
             # 64 fits a directly-attached chip (~2-5ms dispatch, ~45us/sig
@@ -102,6 +108,13 @@ class JAXBatchVerifier(_BaseBatch):
                 cpu_threshold = 64
         self.cpu_threshold = cpu_threshold
 
+    def _device_count(self) -> int:
+        if self._n_devices is None:
+            import jax
+
+            self._n_devices = len(jax.devices())
+        return self._n_devices
+
     def verify(self) -> tuple[bool, list[bool]]:
         pubs, msgs, sigs = self._take()
         if not pubs:
@@ -109,7 +122,12 @@ class JAXBatchVerifier(_BaseBatch):
         if len(pubs) < self.cpu_threshold:
             oks = _ed.verify_batch_fast(pubs, msgs, sigs)
             return all(oks) if oks else False, oks
-        oks = self._impl.verify_batch(pubs, msgs, sigs)
+        if self._device_count() > 1:
+            from tendermint_tpu.parallel import sharding
+
+            oks = sharding.verify_batch_sharded(pubs, msgs, sigs)
+        else:
+            oks = self._impl.verify_batch(pubs, msgs, sigs)
         return bool(all(oks)), [bool(v) for v in oks]
 
 

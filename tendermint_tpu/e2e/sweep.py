@@ -27,7 +27,20 @@ async def run_manifest(manifest: dict, root: str, timeout: float = 300.0) -> Non
     net.start()
     try:
         target = manifest["target_height"]
-        await net.wait_for_height(target, timeout=timeout)
+        # perturbations fire at their scheduled heights while the net
+        # climbs toward the target (reference runner: Perturb between
+        # Load and Test) — run them concurrently with the height wait
+        perturb_task = asyncio.ensure_future(net.run_perturbations(timeout=timeout))
+        try:
+            await net.wait_for_height(target, timeout=timeout)
+            await asyncio.wait_for(perturb_task, timeout=timeout)
+        finally:
+            if not perturb_task.done():
+                perturb_task.cancel()
+                try:
+                    await perturb_task
+                except asyncio.CancelledError:
+                    pass
         if manifest.get("load_rate"):
             await net.load(total_txs=min(10, manifest["load_rate"] * 2),
                            rate=manifest["load_rate"])

@@ -12,7 +12,7 @@ import asyncio
 from tendermint_tpu.p2p import ChannelDescriptor, Envelope, PeerStatus
 from tendermint_tpu.types.evidence import decode_evidence
 from tendermint_tpu.utils.log import Logger, nop_logger
-from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+from tendermint_tpu.wire.proto import guard_decode, ProtoWriter, fields_to_dict
 
 from .pool import EvidencePool
 
@@ -26,6 +26,7 @@ def encode_evidence_list(evs: list) -> bytes:
     return w.bytes_out()
 
 
+@guard_decode
 def decode_evidence_list(data: bytes) -> list:
     return [decode_evidence(raw) for raw in fields_to_dict(data).get(1, [])]
 

@@ -14,7 +14,7 @@ import asyncio
 from tendermint_tpu.crypto.tmhash import sum_sha256
 from tendermint_tpu.p2p import ChannelDescriptor, Envelope, PeerStatus
 from tendermint_tpu.utils.log import Logger, nop_logger
-from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+from tendermint_tpu.wire.proto import guard_decode, ProtoWriter, fields_to_dict
 
 from .mempool import Mempool, TxInCacheError
 
@@ -28,6 +28,7 @@ def encode_txs(txs: list[bytes]) -> bytes:
     return w.bytes_out()
 
 
+@guard_decode
 def decode_txs(data: bytes) -> list[bytes]:
     return fields_to_dict(data).get(1, [])
 
@@ -99,6 +100,7 @@ class MempoolReactor:
         """Walk the pool forever, sending each tx the peer hasn't sent us
         (reference broadcastTxRoutine, reactor.go:199-260)."""
         sent: set[bytes] = set()
+        held_since: float | None = None
         try:
             while True:
                 advanced = False
@@ -114,7 +116,20 @@ class MempoolReactor:
                         # syncing (no NewRoundStep yet) — exactly the case
                         # to hold for; the outer sleep paces the retry.
                         if not h or h < memtx.height - 1:
+                            # surface a long-held peer so a stalled gossip
+                            # stream is diagnosable (ADVICE round 1)
+                            now = asyncio.get_running_loop().time()
+                            if held_since is None:
+                                held_since = now
+                            elif now - held_since > 10.0:
+                                self.logger.debug(
+                                    "mempool gossip held: peer height lag",
+                                    peer=node_id, peer_height=h,
+                                    tx_height=memtx.height,
+                                )
+                                held_since = now
                             break
+                    held_since = None
                     sent.add(key)
                     advanced = True
                     if node_id in memtx.senders:

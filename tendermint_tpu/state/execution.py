@@ -172,12 +172,13 @@ class BlockExecutor:
                 byzantine_validators=byz,
             )
         )
-        if hasattr(self.app, "deliver_tx_batch"):
-            # socket transport: pipeline the whole tx stream before reading
-            # responses (reference DeliverTxAsync, execution.go:276-328)
-            deliver_txs = self.app.deliver_tx_batch(
-                [bytes(tx) for tx in block.data.txs]
-            )
+        # deliver_tx_batch is part of the client interface (local: one
+        # lock hold; socket: pipelined write-all-then-read-all; gRPC:
+        # per-call, as in the reference).  The getattr fallback only
+        # covers hand-rolled test doubles that predate the interface.
+        batch_fn = getattr(self.app, "deliver_tx_batch", None)
+        if batch_fn is not None:
+            deliver_txs = batch_fn([bytes(tx) for tx in block.data.txs])
         else:
             deliver_txs = [
                 self.app.deliver_tx_sync(abci.RequestDeliverTx(tx=tx))

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict, to_int64
+from tendermint_tpu.wire.proto import guard_decode, ProtoWriter, fields_to_dict, to_int64
 
 
 @dataclass
@@ -129,6 +129,7 @@ def encode_snapshot_message(msg) -> bytes:
     return _encode(msg, _SNAPSHOT_TYPES)
 
 
+@guard_decode
 def decode_snapshot_message(data: bytes):
     return _decode(data, _SNAPSHOT_TYPES)
 
@@ -137,5 +138,6 @@ def encode_chunk_message(msg) -> bytes:
     return _encode(msg, _CHUNK_TYPES)
 
 
+@guard_decode
 def decode_chunk_message(data: bytes):
     return _decode(data, _CHUNK_TYPES)

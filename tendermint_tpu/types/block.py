@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from tendermint_tpu.crypto import merkle
-from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+from tendermint_tpu.wire.proto import guard_decode, ProtoWriter, fields_to_dict
 
 from .basic import (
     BlockID,
@@ -223,6 +223,7 @@ class Block:
         return w.bytes_out()
 
     @classmethod
+    @guard_decode
     def decode(cls, data: bytes) -> "Block":
         from .evidence import decode_evidence  # local: avoid import cycle
 

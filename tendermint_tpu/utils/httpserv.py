@@ -14,7 +14,11 @@ from typing import Awaitable, Callable
 # handler(path) -> (status, content_type, body) or None for 404
 Handler = Callable[[str], Awaitable[tuple[int, str, bytes] | None]]
 
-_STATUS = {200: b"200 OK", 404: b"404 Not Found"}
+_STATUS = {
+    200: b"200 OK",
+    404: b"404 Not Found",
+    500: b"500 Internal Server Error",
+}
 
 
 class TextHTTPServer:
@@ -48,7 +52,7 @@ class TextHTTPServer:
             else:
                 status, ctype, body = result
             writer.write(
-                b"HTTP/1.1 " + _STATUS.get(status, _STATUS[404]) + b"\r\n"
+                b"HTTP/1.1 " + _STATUS.get(status, _STATUS[500]) + b"\r\n"
                 + f"Content-Type: {ctype}\r\nContent-Length: {len(body)}\r\n"
                   f"Connection: close\r\n\r\n".encode()
                 + body

@@ -14,6 +14,7 @@ Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
 
 from __future__ import annotations
 
+import functools
 import struct
 
 WT_VARINT = 0
@@ -22,6 +23,28 @@ WT_BYTES = 2
 WT_FIXED32 = 5
 
 _U64_MASK = (1 << 64) - 1
+
+
+def guard_decode(fn):
+    """Network-ingress decode guard: adversarial bytes exercise type
+    confusion inside field decoders (a varint where a sub-message was
+    expected → TypeError, a missing field → KeyError/IndexError, a
+    mis-sized fixed field → struct.error).  Every decoder that consumes
+    bytes from a peer wraps in this so callers only ever handle
+    ValueError.  (Contract established by tests/test_fuzz_decoders.py,
+    mirroring the reference's go-fuzz WAL/wire entry points.)"""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except ValueError:
+            raise
+        except (TypeError, KeyError, IndexError, AttributeError,
+                OverflowError, UnicodeDecodeError, struct.error) as e:
+            raise ValueError(f"malformed wire message: {e!r}") from e
+
+    return wrapper
 
 
 def encode_uvarint(n: int) -> bytes:

@@ -1,0 +1,242 @@
+"""Deterministic consensus-FSM scenario harness: one real ConsensusState
+(validator 0) among scripted validators whose proposals and votes the
+test forges.
+
+Models the reference's consensus test fixtures (consensus/common_test.go
+randConsensusNet / forged vote helpers); the scenario suites built on it
+port the reference's state_test.go tables as behaviors, not line-by-line.
+
+Proposer order is controlled by key seeds: with equal powers the
+weighted-round-robin rotation is a pure function of the sorted addresses,
+so picking seeds pins who proposes at each (height, round).  The three
+exported seed tuples give: us-first (round 0), us-third (round 2),
+us-last (round 3) at height 1.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.abci import AppConns
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus.config import ConsensusConfig
+from tendermint_tpu.consensus.messages import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from tendermint_tpu.consensus.round_state import Step
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import NopWAL
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.mempool.mempool import MempoolConfig
+from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
+from tendermint_tpu.store import BlockStore, MemDB
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, Proposal, Vote
+from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.commit import Commit
+from tendermint_tpu.types.params import ConsensusParams
+
+CHAIN = "fsm-chain"
+
+# Proposer rotation at height 1 (computed from the address sort + equal
+# powers; rounds 0..3):
+SEEDS_WE_FIRST = (0x11, 0x12, 0x13, 0x14)  # [0, 2, 3, 1] — we propose R0
+SEEDS_WE_THIRD = (0x91, 0x92, 0x93, 0x94)  # [1, 2, 0, 3] — we propose R2
+SEEDS_WE_LAST = (0x17, 0x18, 0x19, 0x1A)   # [2, 1, 3, 0] — we propose R3
+
+
+class _PV:
+    def __init__(self, key):
+        self.key = key
+
+    def get_pub_key(self):
+        return self.key.pub_key()
+
+    def sign_vote(self, chain_id, vote):
+        vote.signature = self.key.sign(vote.sign_bytes(chain_id))
+
+    def sign_proposal(self, chain_id, proposal):
+        proposal.signature = self.key.sign(proposal.sign_bytes(chain_id))
+
+
+class _EvidenceCapture:
+    """Stands in for the evidence pool: records conflicting-vote reports
+    (reference: evpool.ReportConflictingVotes)."""
+
+    def __init__(self) -> None:
+        self.reports: list[tuple[Vote, Vote]] = []
+
+    def report_conflicting_votes(self, a: Vote, b: Vote) -> None:
+        self.reports.append((a, b))
+
+
+class Harness:
+    """One real cs (validator 0) + three scripted validators (1..3)."""
+
+    def __init__(
+        self,
+        timeouts_ms: int = 150,
+        seeds: tuple[int, ...] = SEEDS_WE_THIRD,
+        with_privval: bool = True,
+        consensus_params: ConsensusParams | None = None,
+        skip_timeout_commit: bool = True,
+        timeout_commit_ms: int = 50,
+    ):
+        self.keys = [priv_key_from_seed(bytes([s]) * 32) for s in seeds]
+        gen = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=k.pub_key(), power=10)
+                        for k in self.keys],
+        )
+        if consensus_params is not None:
+            gen.consensus_params = consensus_params
+        self.state_store = StateStore(MemDB())
+        self.block_store = BlockStore(MemDB())
+        state = make_genesis_state(gen)
+        self.state_store.save(state)
+        self.genesis_state = state
+        conns = AppConns(KVStoreApplication())
+        self.mempool = Mempool(MempoolConfig(), conns.mempool())
+        self.executor = BlockExecutor(self.state_store, conns.consensus(),
+                                      mempool=self.mempool)
+        cfg = ConsensusConfig.test_config()
+        cfg.timeout_propose_ms = timeouts_ms
+        cfg.timeout_prevote_ms = timeouts_ms
+        cfg.timeout_precommit_ms = timeouts_ms
+        cfg.timeout_commit_ms = timeout_commit_ms
+        cfg.skip_timeout_commit = skip_timeout_commit
+        cfg.create_empty_blocks = True
+        self.config = cfg
+        self.evidence = _EvidenceCapture()
+        self.cs = ConsensusState(
+            cfg, state, self.executor, self.block_store,
+            wal=NopWAL(),
+            priv_validator=_PV(self.keys[0]) if with_privval else None,
+            evidence_pool=self.evidence,
+        )
+        self.our_votes: list[Vote] = []
+        self.events: list[tuple[str, object]] = []
+        self.cs.on_event = self._capture
+
+    def _capture(self, name, payload):
+        self.events.append((name, payload))
+        if name == "vote" and payload.validator_address == self.addr(0):
+            self.our_votes.append(payload)
+
+    # -- identities ------------------------------------------------------
+    def addr(self, i: int) -> bytes:
+        return self.keys[i].pub_key().address()
+
+    def val_index(self, i: int) -> int:
+        idx, _ = self.genesis_state.validators.get_by_address(self.addr(i))
+        return idx
+
+    def proposer_index(self, height: int, round_: int) -> int:
+        vals = self.cs.rs.validators.copy()
+        if round_ > 0:
+            vals.increment_proposer_priority(round_)
+        prop = vals.get_proposer()
+        for i, k in enumerate(self.keys):
+            if k.pub_key().address() == prop.address:
+                return i
+        raise AssertionError("proposer not among harness keys")
+
+    # -- forging ---------------------------------------------------------
+    def make_block(self, txs=(), proposer_i: int | None = None):
+        state = self.cs.state
+        if (self.cs.rs.last_commit is not None
+                and self.cs.rs.last_commit.has_two_thirds_majority()):
+            commit = self.cs.rs.last_commit.make_commit()
+        else:
+            commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+        for tx in txs:
+            try:
+                self.mempool.check_tx(tx)
+            except Exception:
+                pass
+        proposer = (self.addr(proposer_i) if proposer_i is not None
+                    else self.cs.rs.validators.get_proposer().address)
+        # the real executor builds a block that passes validate_block
+        # (correct time rules, data cap, evidence wiring)
+        block = self.executor.create_proposal_block(
+            self.cs.rs.height, state, commit, proposer)
+        return block, block.make_part_set()
+
+    async def inject_proposal(self, proposer_i: int, block, parts,
+                              round_: int, pol_round: int = -1,
+                              send_parts: bool = True):
+        bid = BlockID(hash=block.hash(), part_set_header=parts.header())
+        prop = Proposal(height=block.header.height, round=round_,
+                        pol_round=pol_round, block_id=bid,
+                        timestamp_ns=1_700_000_050 * 10**9)
+        prop.signature = self.keys[proposer_i].sign(prop.sign_bytes(CHAIN))
+        await self.cs.add_peer_message(ProposalMessage(prop), "peer")
+        if send_parts:
+            await self.send_parts(block, parts, round_)
+        return bid
+
+    async def send_parts(self, block, parts, round_: int):
+        for p in range(parts.total):
+            await self.cs.add_peer_message(
+                BlockPartMessage(block.header.height, round_, parts.get_part(p)),
+                "peer",
+            )
+
+    def vote(self, i: int, type_, height, round_, bid: BlockID | None,
+             time_ns: int | None = None) -> Vote:
+        if time_ns is None:
+            # advance with (height, round) so weighted-median block times
+            # stay strictly monotonic across committed heights
+            time_ns = (1_700_000_060 + height) * 10**9 + round_ * 10**8
+        v = Vote(
+            type=type_, height=height, round=round_,
+            block_id=bid if bid is not None else BlockID(),
+            timestamp_ns=time_ns,
+            validator_address=self.addr(i), validator_index=self.val_index(i),
+        )
+        v.signature = self.keys[i].sign(v.sign_bytes(CHAIN))
+        return v
+
+    async def inject_votes(self, type_, height, round_, bid, voters):
+        for i in voters:
+            await self.cs.add_peer_message(
+                VoteMessage(self.vote(i, type_, height, round_, bid)), "peer")
+
+    # -- waiting ---------------------------------------------------------
+    async def wait_step(self, height, round_, step, timeout=10.0):
+        async def poll():
+            rs = self.cs.rs
+            while not (rs.height == height and rs.round >= round_
+                       and (rs.round > round_ or rs.step >= step)):
+                await asyncio.sleep(0.01)
+                rs = self.cs.rs
+
+        await asyncio.wait_for(poll(), timeout)
+
+    async def wait_height(self, height, timeout=10.0):
+        async def poll():
+            while self.block_store.height() < height:
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(poll(), timeout)
+
+    async def wait_our_vote(self, type_, height, round_, timeout=10.0) -> Vote:
+        async def poll():
+            while True:
+                for v in self.our_votes:
+                    if (v.type == type_ and v.height == height
+                            and v.round == round_):
+                        return v
+                await asyncio.sleep(0.01)
+
+        return await asyncio.wait_for(poll(), timeout)
+
+    async def wait_cond(self, fn, timeout=10.0):
+        async def poll():
+            while not fn():
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(poll(), timeout)

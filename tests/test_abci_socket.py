@@ -165,6 +165,30 @@ def test_socket_client_against_subprocess_server():
 
         q = c.query_sync(abci.RequestQuery(data=b"b", path="/key"))
         assert q.value == b"2"
+
+        # pipelining proof: the whole batch goes out as ONE socket write
+        # before any response is read (reference DeliverTxAsync stream,
+        # execution.go:276-328) — no per-tx round-trip serialization
+        writes = []
+        real_sock = c._sock
+
+        class _CountingSock:
+            def sendall(self, b):
+                writes.append(len(b))
+                return real_sock.sendall(b)
+
+            def __getattr__(self, name):
+                return getattr(real_sock, name)
+
+        c._sock = _CountingSock()
+        c.begin_block_sync(abci.RequestBeginBlock(hash=b"", header=None))
+        writes.clear()
+        rs = c.deliver_tx_batch([b"p%d=%d" % (i, i) for i in range(50)])
+        assert [r.code for r in rs] == [0] * 50
+        assert len(writes) == 1, f"batch used {len(writes)} writes; want 1"
+        c._sock = real_sock
+        c.end_block_sync(abci.RequestEndBlock(height=2))
+        c.commit_sync()
         c.close()
     finally:
         proc.terminate()

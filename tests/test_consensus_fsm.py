@@ -12,28 +12,17 @@ import asyncio
 
 import pytest
 
-from tendermint_tpu.abci import AppConns
-from tendermint_tpu.abci.kvstore import KVStoreApplication
-from tendermint_tpu.consensus.config import ConsensusConfig
 from tendermint_tpu.consensus.messages import (
     BlockPartMessage,
     ProposalMessage,
     VoteMessage,
 )
-from tendermint_tpu.consensus.state import ConsensusState
-from tendermint_tpu.consensus.wal import NopWAL
 from tendermint_tpu.crypto.batch import set_default_backend
-from tendermint_tpu.crypto.keys import priv_key_from_seed
-from tendermint_tpu.mempool import Mempool
-from tendermint_tpu.mempool.mempool import MempoolConfig
-from tendermint_tpu.state import BlockExecutor, StateStore, make_genesis_state
-from tendermint_tpu.store import BlockStore, MemDB
-from tendermint_tpu.types import GenesisDoc, GenesisValidator, Proposal, Vote
-from tendermint_tpu.types.commit import Commit
-from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types import Proposal
+from tendermint_tpu.types.basic import BlockID, SignedMsgType
 from tendermint_tpu.consensus.round_state import Step
 
-CHAIN = "fsm-chain"
+from fsm_harness import CHAIN, Harness
 
 
 @pytest.fixture(autouse=True)
@@ -41,149 +30,6 @@ def cpu_backend():
     set_default_backend("cpu")
     yield
     set_default_backend("auto")
-
-
-class _PV:
-    def __init__(self, key):
-        self.key = key
-
-    def get_pub_key(self):
-        return self.key.pub_key()
-
-    def sign_vote(self, chain_id, vote):
-        vote.signature = self.key.sign(vote.sign_bytes(chain_id))
-
-    def sign_proposal(self, chain_id, proposal):
-        proposal.signature = self.key.sign(proposal.sign_bytes(chain_id))
-
-
-class Harness:
-    """One real cs (validator 0) + three scripted validators (1..3)."""
-
-    def __init__(self, timeouts_ms: int = 150):
-        self.keys = [priv_key_from_seed(bytes([0x91 + i]) * 32) for i in range(4)]
-        gen = GenesisDoc(
-            chain_id=CHAIN,
-            genesis_time_ns=1_700_000_000 * 10**9,
-            validators=[GenesisValidator(pub_key=k.pub_key(), power=10)
-                        for k in self.keys],
-        )
-        self.state_store = StateStore(MemDB())
-        self.block_store = BlockStore(MemDB())
-        state = make_genesis_state(gen)
-        self.state_store.save(state)
-        self.genesis_state = state
-        conns = AppConns(KVStoreApplication())
-        self.mempool = Mempool(MempoolConfig(), conns.mempool())
-        self.executor = BlockExecutor(self.state_store, conns.consensus(),
-                                      mempool=self.mempool)
-        cfg = ConsensusConfig.test_config()
-        cfg.timeout_propose_ms = timeouts_ms
-        cfg.timeout_prevote_ms = timeouts_ms
-        cfg.timeout_precommit_ms = timeouts_ms
-        cfg.timeout_commit_ms = 50
-        cfg.create_empty_blocks = True
-        self.cs = ConsensusState(
-            cfg, state, self.executor, self.block_store,
-            wal=NopWAL(), priv_validator=_PV(self.keys[0]),
-        )
-        self.our_votes: list[Vote] = []
-        self.cs.on_event = self._capture
-
-    def _capture(self, name, payload):
-        if name == "vote":
-            self.our_votes.append(payload)
-
-    # -- identities ------------------------------------------------------
-    def addr(self, i: int) -> bytes:
-        return self.keys[i].pub_key().address()
-
-    def val_index(self, i: int) -> int:
-        idx, _ = self.genesis_state.validators.get_by_address(self.addr(i))
-        return idx
-
-    def proposer_index(self, height: int, round_: int) -> int:
-        vals = self.cs.rs.validators.copy()
-        if round_ > 0:
-            vals.increment_proposer_priority(round_)
-        prop = vals.get_proposer()
-        for i, k in enumerate(self.keys):
-            if k.pub_key().address() == prop.address:
-                return i
-        raise AssertionError("proposer not among harness keys")
-
-    # -- forging ---------------------------------------------------------
-    def make_block(self, txs=(), proposer_i: int | None = None):
-        state = self.cs.state
-        if (self.cs.rs.last_commit is not None
-                and self.cs.rs.last_commit.has_two_thirds_majority()):
-            commit = self.cs.rs.last_commit.make_commit()
-        else:
-            commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
-        for tx in txs:
-            try:
-                self.mempool.check_tx(tx)
-            except Exception:
-                pass
-        proposer = (self.addr(proposer_i) if proposer_i is not None
-                    else self.cs.rs.validators.get_proposer().address)
-        # the real executor builds a block that passes validate_block
-        # (correct time rules, data cap, evidence wiring)
-        block = self.executor.create_proposal_block(
-            self.cs.rs.height, state, commit, proposer)
-        return block, block.make_part_set()
-
-    async def inject_proposal(self, proposer_i: int, block, parts,
-                              round_: int, pol_round: int = -1):
-        bid = BlockID(hash=block.hash(), part_set_header=parts.header())
-        prop = Proposal(height=block.header.height, round=round_,
-                        pol_round=pol_round, block_id=bid,
-                        timestamp_ns=1_700_000_050 * 10**9)
-        prop.signature = self.keys[proposer_i].sign(prop.sign_bytes(CHAIN))
-        await self.cs.add_peer_message(ProposalMessage(prop), "peer")
-        for p in range(parts.total):
-            await self.cs.add_peer_message(
-                BlockPartMessage(block.header.height, round_, parts.get_part(p)),
-                "peer",
-            )
-        return bid
-
-    def vote(self, i: int, type_, height, round_, bid: BlockID | None) -> Vote:
-        v = Vote(
-            type=type_, height=height, round=round_,
-            block_id=bid if bid is not None else BlockID(),
-            timestamp_ns=1_700_000_060 * 10**9,
-            validator_address=self.addr(i), validator_index=self.val_index(i),
-        )
-        v.signature = self.keys[i].sign(v.sign_bytes(CHAIN))
-        return v
-
-    async def inject_votes(self, type_, height, round_, bid, voters):
-        for i in voters:
-            await self.cs.add_peer_message(
-                VoteMessage(self.vote(i, type_, height, round_, bid)), "peer")
-
-    # -- waiting ---------------------------------------------------------
-    async def wait_step(self, height, round_, step, timeout=10.0):
-        async def poll():
-            rs = self.cs.rs
-            while not (rs.height == height and rs.round >= round_
-                       and (rs.round > round_ or rs.step >= step)):
-                await asyncio.sleep(0.01)
-                rs = self.cs.rs
-
-        await asyncio.wait_for(poll(), timeout)
-
-    async def wait_our_vote(self, type_, height, round_, timeout=10.0) -> Vote:
-        async def poll():
-            while True:
-                for v in self.our_votes:
-                    if (v.type == type_ and v.height == height
-                            and v.round == round_):
-                        return v
-                await asyncio.sleep(0.01)
-
-        return await asyncio.wait_for(poll(), timeout)
 
 
 def test_full_round_commit_with_peer_proposal():

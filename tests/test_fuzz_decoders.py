@@ -1,0 +1,227 @@
+"""Randomized corruption fuzzing for the WAL and wire decoders.
+
+The reference fuzzes its WAL decoder via go-fuzz (consensus/wal_fuzz.go)
+and replays evil handshakes; here hypothesis drives the same contracts
+(VERDICT round-1 item 7):
+
+* WAL: any byte stream → `decode_records` yields a prefix of valid
+  records, stops silently at a torn tail, or raises DataCorruptionError.
+  NO other exception type may escape, and no fabricated records.
+* Wire: `parse_message` / `decode_uvarint` / `decode_delimited` on
+  arbitrary bytes raise ValueError at worst.
+* Types: `Block.decode` / `Vote` field parsing on mutated valid
+  encodings raise ValueError at worst (these bytes arrive from the
+  network via block parts).
+"""
+
+import struct
+import zlib
+
+import hypothesis.strategies as st
+import pytest
+from hypothesis import example, given, settings
+
+from tendermint_tpu.consensus.messages import MsgInfo, VoteMessage
+from tendermint_tpu.consensus.wal import (
+    DataCorruptionError,
+    EndHeightMessage,
+    decode_records,
+    encode_record,
+)
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.types import Vote
+from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.wire.proto import (
+    decode_delimited,
+    decode_uvarint,
+    parse_message,
+)
+
+# --------------------------------------------------------------------------
+# corpus: a real WAL stream
+# --------------------------------------------------------------------------
+
+
+def _vote(i: int) -> Vote:
+    k = priv_key_from_seed(bytes([i + 1]) * 32)
+    v = Vote(
+        type=SignedMsgType.PREVOTE,
+        height=i + 1,
+        round=0,
+        block_id=BlockID(hash=bytes([i]) * 32,
+                         part_set_header=PartSetHeader(total=1, hash=b"\x01" * 32)),
+        timestamp_ns=1_700_000_000 * 10**9 + i,
+        validator_address=k.pub_key().address(),
+        validator_index=0,
+    )
+    v.signature = k.sign(v.sign_bytes("fuzz-chain"))
+    return v
+
+
+def _wal_stream() -> tuple[bytes, list[bytes]]:
+    records = []
+    for i in range(6):
+        records.append(
+            encode_record(10**9 * i, MsgInfo(VoteMessage(_vote(i)), "peer-1"))
+        )
+        records.append(encode_record(10**9 * i + 1, EndHeightMessage(i)))
+    return b"".join(records), records
+
+
+_STREAM, _RECORDS = _wal_stream()
+
+
+def _decode_all(data: bytes):
+    return list(decode_records(data))
+
+
+# --------------------------------------------------------------------------
+# WAL fuzz
+# --------------------------------------------------------------------------
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(max_size=512))
+def test_wal_decode_arbitrary_bytes(data):
+    """Garbage in → empty/partial out or DataCorruptionError; nothing else."""
+    try:
+        msgs = _decode_all(data)
+    except DataCorruptionError:
+        return
+    assert isinstance(msgs, list)
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.integers(min_value=0, max_value=len(_STREAM) - 1),
+    st.integers(min_value=0, max_value=255),
+)
+def test_wal_decode_single_byte_corruption(pos, newbyte):
+    """Flip one byte anywhere in a valid stream: decode yields a prefix of
+    the original records or raises DataCorruptionError — never a wrong
+    record, never a foreign exception."""
+    mutated = _STREAM[:pos] + bytes([newbyte]) + _STREAM[pos + 1 :]
+    try:
+        msgs = _decode_all(mutated)
+    except DataCorruptionError:
+        return
+    # whatever decoded must re-encode into a prefix-aligned record
+    good = []
+    for tm in msgs:
+        good.append(encode_record(tm.time_ns, tm.msg))
+    joined = b"".join(good)
+    if mutated == _STREAM:
+        assert joined == _STREAM
+    else:
+        # records before the mutation point must match byte-for-byte
+        assert joined == _STREAM[: len(joined)] or joined == mutated[: len(joined)]
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.integers(min_value=0, max_value=len(_STREAM)))
+def test_wal_decode_truncation(cut):
+    """Truncation at ANY offset is a torn tail: silently yields the intact
+    prefix (crash-mid-write must never brick replay)."""
+    msgs = _decode_all(_STREAM[:cut])
+    assert len(msgs) <= len(_RECORDS)
+    rebuilt = b"".join(encode_record(t.time_ns, t.msg) for t in msgs)
+    assert _STREAM.startswith(rebuilt)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(min_size=0, max_size=64))
+def test_wal_decode_crc_valid_garbage_payload(payload):
+    """A record whose CRC is VALID but whose payload is not a WAL message
+    must raise DataCorruptionError — not KeyError/AttributeError.  This is
+    the interesting corpus: framing intact, semantics broken."""
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    framed = struct.pack(">II", crc, len(payload)) + payload
+    try:
+        msgs = _decode_all(framed)
+    except DataCorruptionError:
+        return
+    # only a payload that happens to BE a valid WAL message may decode
+    for tm in msgs:
+        assert encode_record(tm.time_ns, tm.msg)
+
+
+# --------------------------------------------------------------------------
+# wire proto fuzz
+# --------------------------------------------------------------------------
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.binary(max_size=256))
+@example(b"\xff" * 11)  # unbounded varint
+@example(b"\x08")  # truncated varint field
+def test_parse_message_arbitrary_bytes(data):
+    try:
+        fields = parse_message(data)
+    except ValueError:
+        return
+    assert isinstance(fields, list)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(max_size=32))
+def test_decode_uvarint_arbitrary(data):
+    try:
+        v, pos = decode_uvarint(data, 0)
+    except ValueError:
+        return
+    assert v >= 0 and 0 < pos <= len(data)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(max_size=128))
+def test_decode_delimited_arbitrary(data):
+    try:
+        body, pos = decode_delimited(data, 0)
+    except ValueError:
+        return
+    assert pos <= len(data) and len(body) <= len(data)
+
+
+# --------------------------------------------------------------------------
+# Block.decode fuzz — these bytes assemble from gossiped parts
+# --------------------------------------------------------------------------
+
+
+def _block_bytes() -> bytes:
+    import sys
+
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from helpers import ChainBuilder
+
+    cb = ChainBuilder(n_vals=2).build(1)
+    return cb.block_store.load_block(1).encode()
+
+
+_BLOCK = _block_bytes()
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    st.integers(min_value=0, max_value=len(_BLOCK) - 1),
+    st.integers(min_value=0, max_value=255),
+)
+def test_block_decode_single_byte_corruption(pos, newbyte):
+    from tendermint_tpu.types import Block
+
+    mutated = _BLOCK[:pos] + bytes([newbyte]) + _BLOCK[pos + 1 :]
+    try:
+        b = Block.decode(mutated)
+    except ValueError:
+        return
+    b.hash()  # decoded blocks must at least be hashable
+
+
+@settings(max_examples=150, deadline=None)
+@given(st.binary(max_size=256))
+def test_block_decode_arbitrary_bytes(data):
+    from tendermint_tpu.types import Block
+
+    try:
+        Block.decode(data)
+    except ValueError:
+        return

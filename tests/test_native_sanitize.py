@@ -1,0 +1,144 @@
+"""Sanitizer + concurrency suite for the C++ KV engine.
+
+The reference runs its whole test matrix under the Go race detector
+(SURVEY §5.2, coverage.yml -race).  The equivalent for this framework's
+native boundary: build src/native/tmdb.cpp with ASan+UBSan
+(`make asan`), run a multi-threaded stress through the real ctypes
+binding in a subprocess (LD_PRELOAD'd libasan), and fail on any
+sanitizer report.  ctypes releases the GIL during C calls, so the
+threads genuinely race inside the engine — its internal mutex is what
+is under test.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import threading
+
+import pytest
+
+SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "src", "native")
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "tendermint_tpu", "native")
+
+STRESS = r"""
+import os, sys, threading
+import tendermint_tpu.store.native_db as ndb
+ndb._LIB_NAME = "libtmdb_asan.so"
+from tendermint_tpu.store.native_db import NativeDB
+
+path = sys.argv[1]
+db = NativeDB(path)
+errors = []
+
+def worker(wid):
+    try:
+        for i in range(300):
+            k = b"w%d-k%d" % (wid, i % 40)
+            db.set(k, b"v" * (i % 97 + 1))
+            db.get(k)
+            if i % 7 == 0:
+                db.delete(k)
+            if i % 23 == 0:
+                db.write_batch([(b"b%d" % wid, b"x" * 64)], [b"w%d-k0" % wid])
+            if i % 31 == 0:
+                list(db.iterate(b"w"))
+            if i % 53 == 0:
+                db.compact()
+    except Exception as e:  # noqa: BLE001
+        errors.append(repr(e))
+
+threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+[t.start() for t in threads]
+[t.join() for t in threads]
+db.sync(); db.close()
+
+# crash-recovery under sanitizer: reopen and read back
+db2 = NativeDB(path)
+n = sum(1 for _ in db2.iterate(b""))
+db2.close()
+assert not errors, errors
+print("STRESS-OK", n)
+"""
+
+
+def _libasan() -> str | None:
+    gxx = shutil.which("g++")
+    if gxx is None:
+        return None
+    out = subprocess.run([gxx, "-print-file-name=libasan.so"],
+                         capture_output=True, text=True)
+    p = out.stdout.strip()
+    return p if p and os.path.sep in p and os.path.exists(p) else None
+
+
+@pytest.mark.slow
+def test_native_engine_under_asan_concurrent_stress(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    asan = _libasan()
+    if asan is None:
+        pytest.skip("libasan not found")
+    build = subprocess.run(["make", "-C", SRC, "asan"],
+                           capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr
+
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = asan
+    # leak detection off: the host python interpreter is not ASan-clean
+    env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=1"
+    env["JAX_PLATFORMS"] = "cpu"  # never touch the TPU tunnel in this child
+    proc = subprocess.run(
+        [sys.executable, "-c", STRESS, str(tmp_path / "kv.db")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=os.path.dirname(SRC.rstrip(os.sep).rsplit(os.sep, 1)[0]),
+    )
+    assert proc.returncode == 0, f"stderr:\n{proc.stderr[-3000:]}"
+    assert "STRESS-OK" in proc.stdout
+    for marker in ("ERROR: AddressSanitizer", "runtime error:"):
+        assert marker not in proc.stderr, proc.stderr[-3000:]
+
+
+def test_native_engine_concurrent_stress_plain(tmp_path):
+    """The same concurrency stress on the regular build — always runs
+    (no sanitizer dependency), catching crashes/data races that
+    manifest as corruption."""
+    from tendermint_tpu.store.native_db import NativeDB
+
+    db = NativeDB(str(tmp_path / "kv.db"))
+    errors: list[str] = []
+
+    def worker(wid: int):
+        try:
+            for i in range(200):
+                k = b"w%d-k%d" % (wid, i % 40)
+                db.set(k, b"v" * (i % 97 + 1))
+                db.get(k)
+                if i % 7 == 0:
+                    db.delete(k)
+                if i % 31 == 0:
+                    list(db.iterate(b"w"))
+                if i % 53 == 0:
+                    db.compact()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    db.sync()
+    db.close()
+    assert not errors, errors
+
+    db2 = NativeDB(str(tmp_path / "kv.db"))
+    assert db2.size() >= 0
+    for k, v in db2.iterate(b""):
+        assert k and v
+    db2.close()

@@ -37,3 +37,44 @@ def test_10k_commit_batch_sharded_mesh():
     good_mask = np.ones(n, dtype=bool)
     good_mask[sorted(bad)] = False
     assert ok[good_mask].all()
+
+
+def test_sharded_unsharded_agree_at_bucket_boundary():
+    """The production JAXBatchVerifier routes through the sharded path on
+    a multi-device mesh (crypto/batch.py); its verdicts must agree with
+    the single-device path bit-for-bit on mixed-validity batches sized
+    exactly at / around a power-of-two bucket boundary (VERDICT round-1
+    weak #4)."""
+    import jax
+
+    from tendermint_tpu.crypto.batch import JAXBatchVerifier
+    from tendermint_tpu.ops import ed25519_jax as dev
+    from tendermint_tpu.parallel.sharding import make_mesh, verify_batch_sharded
+
+    assert len(jax.devices()) > 1, "conftest must provide the virtual mesh"
+
+    keys = [priv_key_from_seed(bytes([i + 1]) * 32) for i in range(8)]
+    for n in (63, 64, 65):  # around the 64 bucket
+        pubs, msgs, sigs, pub_objs = [], [], [], []
+        for i in range(n):
+            k = keys[i % len(keys)]
+            msg = b"boundary-%d-%d" % (n, i)
+            pubs.append(k.pub_key().bytes_())
+            msgs.append(msg)
+            sigs.append(k.sign(msg))
+            pub_objs.append(k.pub_key())
+        bad = {0, n // 2, n - 1}
+        for i in bad:
+            sigs[i] = sigs[i][:-1] + bytes([sigs[i][-1] ^ 1])
+
+        single = dev.verify_batch(pubs, msgs, sigs)
+        sharded = verify_batch_sharded(pubs, msgs, sigs, mesh=make_mesh())
+        assert (np.asarray(single) == np.asarray(sharded)).all(), n
+
+        # and through the production verifier (multi-device ⇒ sharded)
+        bv = JAXBatchVerifier(cpu_threshold=0)
+        for p, m, s in zip(pub_objs, msgs, sigs):
+            bv.add(p, m, s)
+        all_ok, oks = bv.verify()
+        assert not all_ok
+        assert oks == [bool(v) for v in single], n
