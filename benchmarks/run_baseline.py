@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Run all BASELINE.md configs and commit the results.
+
+Configs 2-4 come from baseline_suite.py (subprocess, one JSON line per
+config); config 5 is the 4-node localnet with a 500-validator genesis
+under sustained tx load, driven through the real e2e runner (multi-node,
+multi-process, RPC load, invariant checks).  Results land in
+BENCH_BASELINE.json at the repo root with environment metadata, so every
+number records the backend it was measured on.
+
+    python benchmarks/run_baseline.py [--backend auto|jax|cpu]
+        [--blocks 200] [--out BENCH_BASELINE.json]
+        [--load-rate 50] [--load-seconds 30] [--genesis-vals 500]
+
+Config-5 genesis: 500 validators where the 4 live nodes carry power
+1000 each and 496 offline validators carry power 1 (4000/4496 > 2/3, so
+the live nodes hold quorum) — commits then carry 500 CommitSig slots,
+the reference's shape for "500-validator genesis" with a 4-node net.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def run_configs_2_to_4(backend: str, blocks: int, runs: int) -> list[dict]:
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_ROOT, "benchmarks", "baseline_suite.py"),
+            "--config", "all",
+            "--blocks", str(blocks),
+            "--backend", backend,
+            "--runs", str(runs),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=3600,
+    )
+    results = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                results.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    if out.returncode != 0:
+        results.append({
+            "metric": "baseline_suite_error",
+            "error": (out.stderr or "")[-1500:],
+        })
+    return results
+
+
+def _widen_genesis(root: str, n_nodes: int, total_vals: int) -> None:
+    """Rewrite every node's genesis: live nodes get power 1000, plus
+    (total_vals - n_nodes) offline validators at power 1."""
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+
+    g0_path = os.path.join(root, "node0", "config", "genesis.json")
+    g = json.load(open(g0_path))
+    for v in g["validators"]:
+        v["power"] = "1000"
+    for i in range(total_vals - n_nodes):
+        k = priv_key_from_seed((0x5000 + i).to_bytes(4, "little") * 8)
+        pub = k.pub_key()
+        g["validators"].append({
+            "address": pub.address().hex().upper(),
+            "name": f"offline-{i}",
+            "power": "1",
+            "pub_key": {
+                "type": "tendermint/PubKeyEd25519",
+                "value": pub.bytes_().hex(),
+            },
+        })
+    raw = json.dumps(g, indent=1, sort_keys=True)
+    for i in range(n_nodes):
+        with open(os.path.join(root, f"node{i}", "config", "genesis.json"), "w") as f:
+            f.write(raw)
+
+
+async def run_config_5(genesis_vals: int, load_rate: float,
+                       load_seconds: float) -> dict:
+    from tendermint_tpu.e2e.runner import Testnet
+
+    root = tempfile.mkdtemp(prefix="tmtpu-baseline5-")
+    manifest = {
+        "chain_id": "baseline-5",
+        "validators": 4,
+        "base_port": 29800,
+    }
+    net = Testnet(manifest, root)
+    try:
+        net.setup()
+        _widen_genesis(root, 4, genesis_vals)
+        net.start()
+        await net.wait_for_height(2, timeout=240.0)
+
+        t0 = time.monotonic()
+        h0 = max(n.height() for n in net.nodes)
+        total = int(load_rate * load_seconds)
+        accepted = await net.load(total_txs=total, rate=load_rate)
+        elapsed = time.monotonic() - t0
+        # let the tail of the load commit
+        await asyncio.sleep(3.0)
+        h1 = max(n.height() for n in net.nodes)
+        await net.wait_for_height(h1, timeout=60.0)  # all nodes caught up
+        net.check_blocks_identical(min(n.height() for n in net.nodes))
+        net.check_app_hashes_agree()
+
+        blocks = h1 - h0
+        return {
+            "metric": f"localnet_4nodes_{genesis_vals}val_genesis",
+            "value": round(accepted / elapsed, 2),
+            "unit": "accepted_tx/s",
+            "vs_baseline": 0.0,
+            "note": "config 5: 4 live nodes, %d-slot commits, RPC tx load; "
+                    "no reference number exists to compare against "
+                    "(BASELINE.md: reference publishes none)" % genesis_vals,
+            "blocks_committed": blocks,
+            "block_interval_s": round(elapsed / blocks, 3) if blocks else None,
+            "txs_submitted": total,
+            "txs_accepted": accepted,
+            "load_rate_target": load_rate,
+        }
+    finally:
+        try:
+            net.stop()
+        except Exception:
+            pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="cpu", choices=["auto", "jax", "cpu"])
+    ap.add_argument("--blocks", type=int, default=200,
+                    help="config-4 replay length (10k in BASELINE.md; "
+                         "smaller default keeps CI-class machines honest)")
+    ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--out", default=os.path.join(_ROOT, "BENCH_BASELINE.json"))
+    ap.add_argument("--load-rate", type=float, default=50.0)
+    ap.add_argument("--load-seconds", type=float, default=20.0)
+    ap.add_argument("--genesis-vals", type=int, default=500)
+    ap.add_argument("--skip-localnet", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    doc = {
+        "generated_unix": int(time.time()),
+        "backend_requested": args.backend,
+        "jax_default_backend": jax.default_backend()
+        if args.backend != "cpu" else "cpu (forced)",
+        "config4_blocks": args.blocks,
+        "results": [],
+    }
+    doc["results"] += run_configs_2_to_4(args.backend, args.blocks, args.runs)
+    if not args.skip_localnet:
+        doc["results"].append(
+            asyncio.run(
+                run_config_5(args.genesis_vals, args.load_rate, args.load_seconds)
+            )
+        )
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {args.out} with {len(doc['results'])} results")
+
+
+if __name__ == "__main__":
+    main()

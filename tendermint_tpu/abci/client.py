@@ -50,12 +50,12 @@ class LocalClient:
 
     def deliver_tx_batch(self, txs: list[bytes]) -> list[abci.ResponseDeliverTx]:
         """Part of the client interface (reference pipelines DeliverTxAsync,
-        execution.go:276-328).  In-process there is no round trip to hide:
-        one lock hold for the whole block keeps order and atomicity."""
-        with self._lock:
-            return [
-                self._app.deliver_tx(abci.RequestDeliverTx(tx=tx)) for tx in txs
-            ]
+        execution.go:276-328).  In-process there is no round trip to hide.
+        The lock is taken per call — as the reference's local client does —
+        so mempool CheckTx and RPC queries on the same app can interleave
+        between txs instead of stalling for the whole block; ordering is
+        safe because the block executor is the only deliver_tx caller."""
+        return [self.deliver_tx_sync(abci.RequestDeliverTx(tx=tx)) for tx in txs]
 
     def end_block_sync(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
         with self._lock:

@@ -87,10 +87,14 @@ class JAXBatchVerifier(_BaseBatch):
 
     def __init__(self, cpu_threshold: int | None = None) -> None:
         super().__init__()
-        from tendermint_tpu.ops import ed25519_jax  # lazy: jax import
+        from tendermint_tpu.ops import ed25519_jax, host_prep  # lazy: jax import
 
         self._impl = ed25519_jax
         self._n_devices: int | None = None  # resolved on first device call
+        # build/load the native host-prep kernel NOW (node startup), not
+        # inside the first vote-batch verification — a lazy `make` there
+        # would stall the consensus receive loop for seconds
+        host_prep.load_lib()
         if cpu_threshold is None:
             # breakeven = device round-trip latency / host per-sig cost.
             # 64 fits a directly-attached chip (~2-5ms dispatch, ~45us/sig

@@ -41,6 +41,11 @@ async def run_manifest(manifest: dict, root: str, timeout: float = 300.0) -> Non
                     await perturb_task
                 except asyncio.CancelledError:
                     pass
+            elif not perturb_task.cancelled() and perturb_task.exception():
+                # a perturbation failure is the root cause — don't let a
+                # later height-wait timeout shadow it (and don't leave an
+                # unretrieved task exception)
+                raise perturb_task.exception()
         if manifest.get("load_rate"):
             await net.load(total_txs=min(10, manifest["load_rate"] * 2),
                            rate=manifest["load_rate"])

@@ -252,18 +252,25 @@ def prepare_batch(pubs, msgs, sigs):
         gt = gt | (~lt & (sw[:, w] > _L_WORDS[w]))
     valid &= lt  # s == L is also non-canonical
 
-    # k = SHA-512(R || A || M) mod L per row (hashlib C; operate on the
-    # caller's byte objects, not numpy views, and join k bytes once)
-    sha512 = hashlib.sha512
-    from_bytes = int.from_bytes
-    ks = bytearray(32 * n)
-    for i in range(n):
-        if not valid[i]:
-            continue
-        sig, pub = sigs[i], pubs[i]
-        k = from_bytes(sha512(sig[:32] + pub + msgs[i]).digest(), "little") % L
-        ks[32 * i : 32 * (i + 1)] = k.to_bytes(32, "little")
-    k_rows = np.frombuffer(bytes(ks), dtype=np.uint8).reshape(n, 32).copy()
+    # k = SHA-512(R || A || M) mod L per row.  The native kernel
+    # (src/native/edhost.cpp via ops.host_prep) does the whole batch in
+    # one threaded C call (~1us/row); the hashlib+bigint loop below is
+    # the fallback (~4.7us/row — 50ms for a 10k commit, which alone
+    # would blow the 2ms BASELINE target).
+    from . import host_prep
+
+    k_rows = host_prep.batch_k_native(r_rows, pub_rows, msgs)
+    if k_rows is None:
+        sha512 = hashlib.sha512
+        from_bytes = int.from_bytes
+        ks = bytearray(32 * n)
+        for i in range(n):
+            if not valid[i]:
+                continue
+            sig, pub = sigs[i], pubs[i]
+            k = from_bytes(sha512(sig[:32] + pub + msgs[i]).digest(), "little") % L
+            ks[32 * i : 32 * (i + 1)] = k.to_bytes(32, "little")
+        k_rows = np.frombuffer(bytes(ks), dtype=np.uint8).reshape(n, 32).copy()
     return pub_rows, r_rows, s_rows, k_rows, valid
 
 

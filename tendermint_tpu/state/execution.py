@@ -94,14 +94,21 @@ class BlockExecutor:
         evidence = self.evpool.pending_evidence(
             state.consensus_params.evidence.max_bytes
         )
-        # leave generous room for header/commit/evidence (reference
-        # types.MaxDataBytes is exact and panics when negative; a negative
-        # cap must never reach the mempool, where it means "unlimited")
-        data_cap = max_data_bytes_no_evidence(max_bytes, len(last_commit.signatures))
+        # the tx budget must subtract the ACTUAL evidence bytes going
+        # into this block (reference types.MaxDataBytes takes
+        # evidenceBytes) — otherwise a full mempool plus pending
+        # evidence builds a block every receiver rejects as oversized,
+        # and since neither drains without a commit the chain halts
+        evidence_bytes = sum(len(ev.encode()) for ev in evidence)
+        data_cap = (
+            max_data_bytes_no_evidence(max_bytes, len(last_commit.signatures))
+            - evidence_bytes
+        )
         if data_cap < 0:
             raise ValueError(
                 f"block.max_bytes {max_bytes} too small for "
-                f"{len(last_commit.signatures)} commit signatures"
+                f"{len(last_commit.signatures)} commit signatures + "
+                f"{evidence_bytes} evidence bytes"
             )
         txs = self.mempool.reap_max_bytes_max_gas(data_cap, max_gas)
         if height == state.initial_height:

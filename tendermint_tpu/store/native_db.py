@@ -41,7 +41,7 @@ def _load_lib():
             src = _src_dir()
             if os.path.isdir(src):
                 try:
-                    subprocess.run(["make", "-C", src], check=True,
+                    subprocess.run(["make", "-C", src, "tmdb"], check=True,
                                    capture_output=True, timeout=120)
                 except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
                         FileNotFoundError) as e:

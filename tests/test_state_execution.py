@@ -187,3 +187,83 @@ def test_block_store_prune():
     assert driver.block_store.base() == 3
     assert driver.block_store.load_block(2) is None
     assert driver.block_store.load_block(3) is not None
+
+
+def test_proposal_budget_subtracts_evidence_bytes():
+    """A full mempool plus pending evidence must still produce a block
+    within block.max_bytes — otherwise every receiver rejects the
+    proposer's own honest block and the chain halts (the tx budget has
+    to subtract actual evidence bytes, reference types.MaxDataBytes)."""
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+    from tendermint_tpu.types.params import BlockParams, ConsensusParams
+    from tendermint_tpu.types.vote import Vote
+
+    genesis, state, key_by_addr = make_chain_fixture()
+    max_bytes = 100_000
+    state.consensus_params = ConsensusParams(block=BlockParams(max_bytes=max_bytes))
+
+    # forge sizeable duplicate-vote evidence from validator 0
+    val0 = state.validators.validators[0]
+    k0 = key_by_addr[val0.address]
+
+    def mkvote(tag):
+        v = Vote(
+            type=SignedMsgType.PREVOTE, height=1, round=0,
+            block_id=BlockID(hash=bytes([tag]) * 32),
+            timestamp_ns=1_700_000_001 * 10**9,
+            validator_address=val0.address, validator_index=0,
+        )
+        v.signature = k0.sign(v.sign_bytes("exec-chain"))
+        return v
+
+    evs = [
+        DuplicateVoteEvidence(
+            vote_a=mkvote(2 * i + 1), vote_b=mkvote(2 * i + 2),
+            total_voting_power=40, validator_power=10,
+            timestamp_ns=1_700_000_001 * 10**9,
+        )
+        for i in range(40)
+    ]
+
+    class _EvPool:
+        def pending_evidence(self, max_bytes_):
+            return evs
+
+        def update(self, state_, evidence):
+            pass
+
+        def check_evidence(self, state_, evidence):
+            pass
+
+    class _FatMempool:
+        def reap_max_bytes_max_gas(self, cap, max_gas):
+            # behave like a saturated mempool: fill exactly the budget
+            assert cap >= 0
+            tx = b"x" * 1000
+            return [tx] * (cap // (len(tx) + 8))
+
+        def lock(self):
+            pass
+
+        def unlock(self):
+            pass
+
+        def update(self, *a, **k):
+            pass
+
+    store = StateStore(MemDB())
+    store.save(state)
+    execu = BlockExecutor(
+        store,
+        AppConns(KVStoreApplication()).consensus(),
+        mempool=_FatMempool(),
+        evidence_pool=_EvPool(),
+    )
+    commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+    block = execu.create_proposal_block(1, state, commit, val0.address)
+    encoded = len(block.encode())
+    assert len(block.evidence) == 40
+    assert len(block.data.txs) > 0, "evidence must not starve txs entirely here"
+    assert encoded <= max_bytes, (
+        f"proposal {encoded}B exceeds block.max_bytes {max_bytes}"
+    )

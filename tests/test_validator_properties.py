@@ -1,0 +1,271 @@
+"""Validator-set conformance: proposer-priority arithmetic, update
+semantics, and commit-verification thresholds.
+
+Ports the behavioral content of the reference's types/validator_set_test.go
+(1,711 lines: averaging/centering, rescale bounds, update order
+independence, new-entrant priority, duplicate/overflow/empty rejection,
+VerifyCommit strictness vs VerifyCommitLight early-exit vs trusting
+threshold) as properties over this framework's ValidatorSet.
+"""
+
+import hypothesis.strategies as st
+import pytest
+from hypothesis import given, settings
+
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.commit import BlockIDFlag, Commit, CommitSig
+from tendermint_tpu.types.validator import (
+    MAX_TOTAL_VOTING_POWER,
+    PRIORITY_WINDOW_SIZE_FACTOR,
+    Validator,
+    ValidatorSet,
+)
+from tendermint_tpu.types.vote import vote_sign_bytes_raw
+from fractions import Fraction
+
+CHAIN = "valprops-chain"
+
+
+def _key(i: int):
+    return priv_key_from_seed((i + 1).to_bytes(4, "little") * 8)
+
+
+def _val(i: int, power: int) -> Validator:
+    pub = _key(i).pub_key()
+    return Validator(address=pub.address(), pub_key=pub, voting_power=power)
+
+
+def _vset(powers) -> ValidatorSet:
+    return ValidatorSet([_val(i, p) for i, p in enumerate(powers)])
+
+
+# ---------------------------------------------------------------------------
+# proposer-priority arithmetic
+# ---------------------------------------------------------------------------
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.integers(min_value=1, max_value=10**6), min_size=1, max_size=10),
+       st.integers(min_value=1, max_value=50))
+def test_priorities_centered_after_increment(powers, times):
+    """reference TestAveragingInIncrementProposerPriority: priorities are
+    shifted so their average stays near zero (|avg| < 1 after shift)."""
+    vs = _vset(powers)
+    vs.increment_proposer_priority(times)
+    prios = [v.proposer_priority for v in vs.validators]
+    avg = sum(prios) / len(prios)
+    assert abs(avg) < 1.0, prios
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.integers(min_value=1, max_value=10**6), min_size=2, max_size=10),
+       st.integers(min_value=1, max_value=200))
+def test_priority_spread_bounded(powers, times):
+    """reference IncrementProposerPriority rescale: the spread never
+    exceeds 2 * total voting power."""
+    vs = _vset(powers)
+    vs.increment_proposer_priority(times)
+    prios = [v.proposer_priority for v in vs.validators]
+    assert max(prios) - min(prios) <= (
+        PRIORITY_WINDOW_SIZE_FACTOR * vs.total_voting_power()
+    )
+
+
+def test_increment_requires_positive_times():
+    vs = _vset([10, 20])
+    with pytest.raises(Exception):
+        vs.increment_proposer_priority(0)
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.lists(st.integers(min_value=1, max_value=1000), min_size=1, max_size=8))
+def test_proposer_rotation_exactly_proportional_over_full_cycle(powers):
+    """reference TestProposerSelection1/2: over total_power consecutive
+    rounds every validator proposes exactly voting_power times."""
+    vs = _vset(powers)
+    total = vs.total_voting_power()
+    counts = {v.address: 0 for v in vs.validators}
+    for _ in range(total):
+        counts[vs.get_proposer().address] += 1
+        vs.increment_proposer_priority(1)
+    for i, p in enumerate(powers):
+        assert counts[_val(i, p).address] == p
+
+
+def test_extreme_priorities_clip_not_overflow():
+    """reference TestSafeAddClip/TestSafeSubClip via the increment path:
+    pre-set extreme priorities must clip, not raise."""
+    vs = _vset([10, 20, 30])
+    vs.validators[0].proposer_priority = (1 << 63) - 2
+    vs.validators[1].proposer_priority = -(1 << 63) + 2
+    vs.increment_proposer_priority(3)  # must not raise
+    prios = [v.proposer_priority for v in vs.validators]
+    assert max(prios) - min(prios) <= PRIORITY_WINDOW_SIZE_FACTOR * vs.total_voting_power()
+
+
+# ---------------------------------------------------------------------------
+# update semantics
+# ---------------------------------------------------------------------------
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.permutations(list(range(6))))
+def test_update_order_independence(order):
+    """reference TestValSetUpdatesOrderIndependenceTestsExecute: the same
+    change set applied in any order yields the same set (same hash)."""
+    base_powers = [10, 20, 30, 40]
+    changes = [
+        _val(0, 15),      # power change
+        _val(1, 0),       # removal
+        _val(4, 25),      # addition
+        _val(5, 5),       # addition
+        _val(2, 30),      # no-op power restated
+        _val(3, 44),      # power change
+    ]
+    vs = _vset(base_powers)
+    vs.update_with_change_set([changes[i] for i in order])
+    ref = _vset(base_powers)
+    ref.update_with_change_set(changes)
+    assert vs.hash() == ref.hash()
+    assert [(v.address, v.voting_power) for v in vs.validators] == [
+        (v.address, v.voting_power) for v in ref.validators
+    ]
+
+
+def test_new_entrant_gets_lowest_priority():
+    """reference updateWithChangeSet: a new validator starts at
+    -(total + total/8), i.e. strictly the lowest priority in the set."""
+    vs = _vset([100, 200, 300])
+    vs.increment_proposer_priority(7)
+    vs.update_with_change_set([_val(9, 150)])
+    new_addr = _val(9, 150).address
+    new_v = next(v for v in vs.validators if v.address == new_addr)
+    assert new_v.proposer_priority == min(v.proposer_priority for v in vs.validators)
+
+
+def test_update_rejects_duplicates():
+    vs = _vset([10, 20])
+    with pytest.raises(ValueError):
+        vs.update_with_change_set([_val(0, 5), _val(0, 7)])
+
+
+def test_update_rejects_unknown_removal():
+    vs = _vset([10, 20])
+    with pytest.raises(ValueError):
+        vs.update_with_change_set([_val(7, 0)])
+
+
+def test_update_rejects_emptying_set():
+    vs = _vset([10, 20])
+    with pytest.raises(ValueError):
+        vs.update_with_change_set([_val(0, 0), _val(1, 0)])
+
+
+def test_update_rejects_total_power_overflow():
+    """reference TestValSetUpdatesOverflows."""
+    vs = _vset([10, 20])
+    with pytest.raises(ValueError):
+        vs.update_with_change_set([_val(2, MAX_TOTAL_VOTING_POWER)])
+
+
+def test_total_voting_power_overflow_rejected_on_construction():
+    """reference TestValidatorSetTotalVotingPowerPanicsOnOverflow (here a
+    ValueError, not a panic)."""
+    with pytest.raises(ValueError):
+        _vset([MAX_TOTAL_VOTING_POWER, 1])
+
+
+def test_remove_then_readd_resets_priority():
+    """A validator removed and re-added is a NEW entrant: its accumulated
+    priority must not survive the round trip."""
+    vs = _vset([100, 100, 100])
+    target = vs.validators[0].address
+    vs.increment_proposer_priority(5)
+    vs.update_with_change_set([Validator(address=target,
+                                         pub_key=vs.validators[0].pub_key,
+                                         voting_power=0)])
+    assert not vs.has_address(target)
+    re_add = next(_val(i, 100) for i in range(3) if _val(i, 100).address == target)
+    vs.update_with_change_set([re_add])
+    v = next(v for v in vs.validators if v.address == target)
+    assert v.proposer_priority == min(x.proposer_priority for x in vs.validators)
+
+
+# ---------------------------------------------------------------------------
+# commit-verification thresholds (strict vs light vs trusting)
+# ---------------------------------------------------------------------------
+
+
+def _commit(vs: ValidatorSet, height: int, signers: set[int],
+            corrupt: set[int] = frozenset()) -> tuple[BlockID, Commit]:
+    bid = BlockID(hash=b"\xbb" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\xcc" * 32))
+    t = 1_700_000_123 * 10**9
+    sigs = []
+    for idx, v in enumerate(vs.validators):
+        if idx not in signers:
+            sigs.append(CommitSig.absent_sig())
+            continue
+        ki = next(i for i in range(64) if _key(i).pub_key().address() == v.address)
+        sb = vote_sign_bytes_raw(CHAIN, SignedMsgType.PRECOMMIT, height, 0, bid, t)
+        sig = _key(ki).sign(sb)
+        if idx in corrupt:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        sigs.append(CommitSig(block_id_flag=BlockIDFlag.COMMIT,
+                              validator_address=v.address,
+                              timestamp_ns=t, signature=sig))
+    return bid, Commit(height=height, round=0, block_id=bid, signatures=sigs)
+
+
+def test_verify_commit_checks_every_signature():
+    """reference TestValidatorSet_VerifyCommit_CheckAllSignatures: strict
+    verify fails on ANY bad signature, even beyond the 2/3 threshold."""
+    vs = _vset([10] * 6)
+    bid, commit = _commit(vs, 3, signers=set(range(6)), corrupt={5})
+    with pytest.raises(ValueError):
+        vs.verify_commit(CHAIN, bid, 3, commit)
+
+
+def test_verify_commit_light_ignores_sigs_beyond_two_thirds():
+    """reference TestValidatorSet_VerifyCommitLight_ReturnsAsSoonAs...:
+    the light path stops counting once >2/3 power is proven, so a bad
+    signature in the tail does not fail it."""
+    vs = _vset([10] * 6)
+    bid, commit = _commit(vs, 3, signers=set(range(6)), corrupt={5})
+    vs.verify_commit_light(CHAIN, bid, 3, commit)  # must NOT raise
+
+
+def test_verify_commit_light_fails_below_two_thirds():
+    vs = _vset([10] * 6)
+    bid, commit = _commit(vs, 3, signers={0, 1, 2, 3})  # 40/60 = 2/3, not >
+    with pytest.raises(ValueError):
+        vs.verify_commit_light(CHAIN, bid, 3, commit)
+
+
+def test_verify_commit_light_trusting_threshold():
+    """reference TestValidatorSet_VerifyCommitLightTrusting: 1/3 trust
+    level passes with ~40% power signed; fails when signed power is at or
+    below 1/3."""
+    vs = _vset([10] * 5)
+    bid, commit = _commit(vs, 3, signers={0, 1})  # 20/50 = 40% > 1/3
+    vs.verify_commit_light_trusting(CHAIN, commit, Fraction(1, 3))
+    bid2, commit2 = _commit(vs, 3, signers={0})  # 10/50 = 20% < 1/3
+    with pytest.raises(ValueError):
+        vs.verify_commit_light_trusting(CHAIN, commit2, Fraction(1, 3))
+
+
+def test_verify_commit_rejects_wrong_block_id():
+    vs = _vset([10] * 4)
+    bid, commit = _commit(vs, 3, signers=set(range(4)))
+    other = BlockID(hash=b"\xee" * 32,
+                    part_set_header=PartSetHeader(total=1, hash=b"\xcc" * 32))
+    with pytest.raises(ValueError):
+        vs.verify_commit(CHAIN, other, 3, commit)
+
+
+def test_verify_commit_rejects_wrong_height():
+    vs = _vset([10] * 4)
+    bid, commit = _commit(vs, 3, signers=set(range(4)))
+    with pytest.raises(ValueError):
+        vs.verify_commit(CHAIN, bid, 4, commit)
