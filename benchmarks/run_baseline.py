@@ -112,10 +112,12 @@ async def run_config_5(genesis_vals: int, load_rate: float,
         h0 = max(n.height() for n in net.nodes)
         total = int(load_rate * load_seconds)
         accepted = await net.load(total_txs=total, rate=load_rate)
-        elapsed = time.monotonic() - t0
-        # let the tail of the load commit
+        load_elapsed = time.monotonic() - t0
+        # let the tail of the load commit, then measure blocks over the
+        # SAME window the height delta covers (t0 → now)
         await asyncio.sleep(3.0)
         h1 = max(n.height() for n in net.nodes)
+        block_window = time.monotonic() - t0
         await net.wait_for_height(h1, timeout=60.0)  # all nodes caught up
         net.check_blocks_identical(min(n.height() for n in net.nodes))
         net.check_app_hashes_agree()
@@ -123,14 +125,14 @@ async def run_config_5(genesis_vals: int, load_rate: float,
         blocks = h1 - h0
         return {
             "metric": f"localnet_4nodes_{genesis_vals}val_genesis",
-            "value": round(accepted / elapsed, 2),
+            "value": round(accepted / load_elapsed, 2),
             "unit": "accepted_tx/s",
             "vs_baseline": 0.0,
             "note": "config 5: 4 live nodes, %d-slot commits, RPC tx load; "
                     "no reference number exists to compare against "
                     "(BASELINE.md: reference publishes none)" % genesis_vals,
             "blocks_committed": blocks,
-            "block_interval_s": round(elapsed / blocks, 3) if blocks else None,
+            "block_interval_s": round(block_window / blocks, 3) if blocks else None,
             "txs_submitted": total,
             "txs_accepted": accepted,
             "load_rate_target": load_rate,

@@ -12,28 +12,16 @@ pattern as store/native_db.py).
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 
 import numpy as np
+
+from tendermint_tpu.utils.native_loader import load_native_lib
 
 _LIB_NAME = "libedhost.so"
 _lib = None
 _lib_failed = False
 _lib_lock = threading.Lock()
-
-
-def _native_dir() -> str:
-    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
-
-
-def _src_dir() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-        "src",
-        "native",
-    )
 
 
 def load_lib():
@@ -43,34 +31,20 @@ def load_lib():
     with _lib_lock:
         if _lib is not None or _lib_failed:
             return _lib
-        path = os.path.join(_native_dir(), _LIB_NAME)
-        if not os.path.exists(path):
-            src = _src_dir()
-            try:
-                subprocess.run(
-                    ["make", "-C", src, "edhost"],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception:
-                _lib_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(path)
-            lib.tmed_batch_k.argtypes = [
-                ctypes.c_uint64,
-                ctypes.c_char_p,
-                ctypes.c_char_p,
-                ctypes.c_char_p,
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_int,
-            ]
-            lib.tmed_batch_k.restype = None
-        except OSError:
+        lib = load_native_lib(_LIB_NAME, "edhost", required=False)
+        if lib is None:
             _lib_failed = True
             return None
+        lib.tmed_batch_k.argtypes = [
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+        ]
+        lib.tmed_batch_k.restype = None
         _lib = lib
         return _lib
 

@@ -12,23 +12,14 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
 import threading
 from typing import Iterator
+
+from tendermint_tpu.utils.native_loader import load_native_lib
 
 _LIB_NAME = "libtmdb.so"
 _lib = None
 _lib_lock = threading.Lock()
-
-
-def _native_dir() -> str:
-    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
-
-
-def _src_dir() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "src", "native"
-    )
 
 
 def _load_lib():
@@ -36,20 +27,9 @@ def _load_lib():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        path = os.path.join(_native_dir(), _LIB_NAME)
-        if not os.path.exists(path):
-            src = _src_dir()
-            if os.path.isdir(src):
-                try:
-                    subprocess.run(["make", "-C", src, "tmdb"], check=True,
-                                   capture_output=True, timeout=120)
-                except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
-                        FileNotFoundError) as e:
-                    raise RuntimeError(
-                        f"native KV engine not built and build failed: {e}; "
-                        f"run `make -C {src}`"
-                    ) from None
-        lib = ctypes.CDLL(path)
+        # _LIB_NAME is read here (not at import) so the sanitizer suite
+        # can point this binding at libtmdb_asan.so
+        lib = load_native_lib(_LIB_NAME, "tmdb", required=True)
         lib.tmdb_open.restype = ctypes.c_void_p
         lib.tmdb_open.argtypes = [ctypes.c_char_p]
         lib.tmdb_close.argtypes = [ctypes.c_void_p]

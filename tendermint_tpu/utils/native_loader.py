@@ -1,0 +1,70 @@
+"""Shared loader for the in-tree C++ libraries.
+
+Both native boundaries (the KV engine, store/native_db.py, and the
+crypto host-prep kernel, ops/host_prep.py) follow the same pattern:
+the .so lives in tendermint_tpu/native/, is built from src/native/ by a
+named make target on first use, and is bound via ctypes.  This helper
+owns that pattern so diagnostics and build behavior can't drift between
+the two (they already had once).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+
+def native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+
+
+def src_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "src",
+        "native",
+    )
+
+
+def load_native_lib(lib_name: str, make_target: str, required: bool):
+    """Load tendermint_tpu/native/<lib_name>, building `make_target` in
+    src/native/ first when missing.
+
+    required=True: raise RuntimeError with the build diagnostic on any
+    failure (the KV engine — the caller asked for db_backend=native).
+    required=False: return None on any failure (optional fast-path
+    kernels fall back to pure Python)."""
+    path = os.path.join(native_dir(), lib_name)
+    if not os.path.exists(path):
+        src = src_dir()
+        if not os.path.isdir(src):
+            if required:
+                raise RuntimeError(
+                    f"{lib_name} missing and source tree {src} not present"
+                )
+            return None
+        try:
+            subprocess.run(
+                ["make", "-C", src, make_target],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                FileNotFoundError) as e:
+            if required:
+                detail = ""
+                if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+                    detail = ": " + e.stderr.decode(errors="replace")[-500:]
+                raise RuntimeError(
+                    f"{lib_name} not built and build failed: {e}{detail}; "
+                    f"run `make -C {src} {make_target}`"
+                ) from None
+            return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError as e:
+        if required:
+            raise RuntimeError(f"cannot load {path}: {e}") from None
+        return None

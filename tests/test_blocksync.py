@@ -431,3 +431,109 @@ def test_silent_peer_cannot_wedge_caught_up():
         assert pool.is_caught_up()
 
     asyncio.run(run())
+
+
+# -- table-driven pool scheduling scenarios (the behavioral content of the
+# reference's blockchain/v2 scheduler_test.go tables, expressed against
+# this framework's single pool) ------------------------------------------
+
+
+def _mkblock(builder_blocks, h):
+    return builder_blocks[h]
+
+
+def test_pool_scenarios_table():
+    """Each scenario is (setup events, action, expected observable)."""
+    from tendermint_tpu.blocksync.pool import BlockPool
+
+    def fresh():
+        p = BlockPool(start_height=1, startup_grace_s=0.0)
+        p.add_peer("a")
+        p.set_peer_range("a", 1, 10)
+        p.add_peer("b")
+        p.set_peer_range("b", 1, 10)
+        return p
+
+    class FakeBlock:
+        def __init__(self, h):
+            self.header = type("H", (), {"height": h})()
+
+    # 1. unsolicited block (never requested height) is refused
+    p = fresh()
+    assert p.add_block("a", FakeBlock(99)) is False
+
+    # 2. block from the WRONG peer for a requested height is refused
+    p = fresh()
+    assigned = {h: r.peer_id for h, r in p.requesters.items()}
+    h0 = min(assigned)
+    wrong = "b" if assigned[h0] == "a" else "a"
+    assert p.add_block(wrong, FakeBlock(h0)) is False
+    assert p.add_block(assigned[h0], FakeBlock(h0)) is True
+
+    # 3. duplicate delivery for the same height is refused
+    assert p.add_block(assigned[h0], FakeBlock(h0)) is False
+
+    # 4. no_block shrinks the advertised range and reassigns to the other peer
+    p = fresh()
+    assigned = {h: r.peer_id for h, r in p.requesters.items()}
+    h0 = min(assigned)
+    pid = assigned[h0]
+    p.no_block(pid, h0)
+    assert p.peers[pid].height == h0 - 1
+    r = p.requesters.get(h0)
+    assert r is not None and r.peer_id != pid, "height must be reassigned"
+
+    # 5. removing a peer reassigns its undelivered requests
+    p = fresh()
+    before = {h for h, r in p.requesters.items() if r.peer_id == "a"}
+    assert before
+    p.remove_peer("a")
+    for h in before:
+        r = p.requesters.get(h)
+        assert r is None or r.peer_id == "b"
+
+    # 6. ban evicts delivered blocks from the banned peer (suspect data)
+    p = fresh()
+    assigned = {h: r.peer_id for h, r in p.requesters.items()}
+    h_a = min(h for h, pid in assigned.items() if pid == "a")
+    assert p.add_block("a", FakeBlock(h_a))
+    p.ban_peer("a")
+    r = p.requesters.get(h_a)
+    assert r is None or r.peer_id != "a", "banned peer's block must be evicted"
+    assert "a" in p.take_banned()
+    # banned peer cannot re-admit itself via a status broadcast
+    p.set_peer_range("a", 1, 20)
+    assert "a" not in p.peers
+
+    # 7. redo bans BOTH the block's provider and its successor's provider
+    p = fresh()
+    assigned = {h: r.peer_id for h, r in p.requesters.items()}
+    providers = {assigned[1], assigned[2]}
+    p.redo(1)
+    assert p.banned >= providers
+
+    # 8. window returns the longest consecutive run from the apply point
+    p = fresh()
+    assigned = {h: r.peer_id for h, r in p.requesters.items()}
+    for h in (1, 2, 4):  # gap at 3
+        p.add_block(assigned[h], FakeBlock(h))
+    win = [b.header.height for b in p.window()]
+    assert win == [1, 2]
+
+    # 9. pop advances the apply point and re-arms scheduling beyond the top
+    p = fresh()
+    assigned = {h: r.peer_id for h, r in p.requesters.items()}
+    p.add_block(assigned[1], FakeBlock(1))
+    p.pop(1)
+    assert p.height == 2
+    assert 1 not in p.requesters
+
+    # 10. caught-up: within one block of the best advertised height,
+    # after grace, with all peers reported
+    p = BlockPool(start_height=10, startup_grace_s=0.0)
+    p.add_peer("a")
+    p.set_peer_range("a", 1, 10)
+    assert p.is_caught_up()
+    # a higher advertisement revokes it
+    p.set_peer_range("a", 1, 50)
+    assert not p.is_caught_up()

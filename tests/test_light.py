@@ -472,3 +472,65 @@ def test_promoted_primary_is_dropped_from_rotation(chain):
     c.verify_light_block_at_height(12, now_at(12))
     assert c.primary is witness
     assert dead not in c.witnesses
+
+
+# -- restore from trusted store (reference TestClientRestoresTrustedHeader
+# AfterStartup1/2/3 + TestClient_NewClientFromTrustedStore + TestClient_Update)
+
+
+def test_client_restores_trusted_state_from_store(chain):
+    """A restarted client with a populated trusted store resumes from it
+    without re-fetching the root of trust."""
+    store = LightBlockStore()
+    c1 = _client(chain, store=store)
+    c1.verify_light_block_at_height(8, now_at(8))
+    assert store.latest_light_block().height == 8
+
+    # restart: same store, same trust options — must adopt stored state
+    c2 = _client(chain, store=store)
+    assert c2.last_trusted_height() == 8
+    lb = c2.verify_light_block_at_height(12, now_at(12))
+    assert lb.hash() == chain.blocks[12].hash()
+
+
+def test_client_rejects_store_conflicting_with_trust_options(chain):
+    """Startup must fail loudly when the stored header at the trust
+    height disagrees with the user-pinned hash (poisoned store)."""
+    store = LightBlockStore()
+    c1 = _client(chain, store=store)
+    c1.verify_light_block_at_height(5, now_at(5))
+
+    other = LightChain(keys=_keys([31, 32, 33, 34])).extend(2)  # different chain
+    with pytest.raises(LightClientError, match="purge"):
+        Client(
+            CHAIN_ID,
+            TrustOptions(period_ns=PERIOD, height=1, hash=other.blocks[1].hash()),
+            chain.provider(),
+            [],
+            trusted_store=store,
+            now_fn=lambda: now_at(chain.height()),
+        )
+
+
+def test_client_from_store_with_options_height_not_stored(chain):
+    """Trust options pinned at a height the store never saved: existing
+    trusted state wins (reference NewClientFromTrustedStore semantics —
+    no conflict means proceed)."""
+    store = LightBlockStore()
+    c1 = _client(chain, store=store)
+    c1.verify_light_block_at_height(6, now_at(6))
+    store.delete_light_block(1)  # the options height is gone
+
+    c2 = _client(chain, store=store)
+    assert c2.last_trusted_height() == 6
+
+
+def test_client_update_advances_to_primary_head(chain):
+    """update() fetches the primary's latest header and verifies up to it
+    (reference TestClient_Update); a second update with no new header
+    returns None."""
+    c = _client(chain)
+    lb = c.update(now_at(chain.height()))
+    assert lb is not None and lb.height == chain.height()
+    assert c.last_trusted_height() == chain.height()
+    assert c.update(now_at(chain.height())) is None

@@ -218,3 +218,67 @@ def test_reactor_peer_height_gating():
         assert len(sent) == 1 and sent[0].message == [b"gated=tx"]
 
     asyncio.run(run())
+
+
+def test_keep_invalid_txs_in_cache():
+    """reference TestMempool_KeepInvalidTxsInCache: with the flag on, a
+    rejected tx stays cached (resubmission short-circuits at the cache);
+    with it off the tx can be retried through the app."""
+
+    class _Flaky(KVStoreApplication):
+        def __init__(self):
+            super().__init__()
+            self.reject = True
+
+        def check_tx(self, req):
+            if self.reject:
+                return abci.ResponseCheckTx(code=1, log="rejected")
+            return super().check_tx(req)
+
+    # keep=True: second submit fails at the CACHE even after the app heals
+    mp, app = make_mempool(app=_Flaky(), keep_invalid_txs_in_cache=True)
+    res = mp.check_tx(b"x=1")
+    assert res.code == 1
+    app.reject = False
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"x=1")
+    assert mp.size() == 0
+
+    # keep=False (default): rejection evicts, retry reaches the app
+    mp2, app2 = make_mempool(app=_Flaky())
+    assert mp2.check_tx(b"y=1").code == 1
+    app2.reject = False
+    assert mp2.check_tx(b"y=1").code == abci.CodeTypeOK
+    assert mp2.size() == 1
+
+
+def test_total_bytes_accounting_through_update():
+    """reference TestMempoolTxsBytes: tx_bytes tracks inserts, commits,
+    and the post-update rechecked remainder."""
+    mp, _ = make_mempool()
+    txs = [b"k%d=%s" % (i, b"v" * (i + 1)) for i in range(6)]
+    for tx in txs:
+        mp.check_tx(tx)
+    assert mp.tx_bytes() == sum(len(t) for t in txs)
+
+    # commit the first three: bytes drop to the remainder
+    committed = txs[:3]
+    mp.update(1, committed, [abci.ResponseDeliverTx(code=0)] * 3)
+    assert mp.size() == 3
+    assert mp.tx_bytes() == sum(len(t) for t in txs[3:])
+
+    # committing the rest drains the accounting to zero
+    mp.update(2, txs[3:], [abci.ResponseDeliverTx(code=0)] * 3)
+    assert mp.size() == 0
+    assert mp.tx_bytes() == 0
+
+
+def test_committed_tx_cache_blocks_resubmit_but_update_keeps_cache():
+    """reference TestCacheAfterUpdate flavor: a committed tx stays in the
+    cache after update, so replaying it raises at the cache layer."""
+    mp, _ = make_mempool()
+    mp.check_tx(b"c=1")
+    mp.update(1, [b"c=1"], [abci.ResponseDeliverTx(code=0)])
+    assert mp.size() == 0
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"c=1")
