@@ -180,7 +180,13 @@ def bench_fastsync_replay(n_blocks: int, n_vals: int, window: int = 64) -> None:
         for block, commit in zip(blocks, commits):
             parts = block.make_part_set()
             store.save_block(block, parts, commit)
-            state, _ = execu.apply_block(state, commit.block_id, block)
+            # the window batch above IS this block's commit verification;
+            # the real pipeline passes the same flag (blocksync
+            # reactor.py:305-310) — without it every commit is verified
+            # twice and the replay measures crypto, not the pipeline
+            state, _ = execu.apply_block(
+                state, commit.block_id, block, commit_sigs_verified=True
+            )
         h = hi + 1
     sec = time.perf_counter() - t0
     per_block_sig_cost = _sequential_baseline_per_sig() * (n_vals * 2 / 3)

@@ -12,7 +12,12 @@ import enum
 import time as _time
 from dataclasses import dataclass, field
 
-from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
+from tendermint_tpu.wire.proto import (
+    ProtoWriter,
+    encode_uvarint,
+    encode_varint_signed,
+    fields_to_dict,
+)
 
 # Go's zero time (0001-01-01T00:00:00Z) in ns since the Unix epoch.
 GO_ZERO_TIME_SECONDS = -62135596800
@@ -26,9 +31,15 @@ def now_ns() -> int:
 
 def encode_timestamp(ns: int) -> bytes:
     """google.protobuf.Timestamp{seconds=1, nanos=2}; floor division keeps
-    nanos in [0, 1e9) for negative (pre-epoch) times."""
+    nanos in [0, 1e9) for negative (pre-epoch) times.  Hand-rolled,
+    byte-identical to the ProtoWriter form (one call per CommitSig)."""
     seconds, nanos = divmod(ns, NS)
-    return ProtoWriter().varint(1, seconds).varint(2, nanos).bytes_out()
+    out = b""
+    if seconds:
+        out = b"\x08" + encode_varint_signed(seconds)
+    if nanos:
+        out += b"\x10" + encode_uvarint(nanos)
+    return out
 
 
 def decode_timestamp(data: bytes) -> int:

@@ -24,7 +24,11 @@ from fractions import Fraction
 from tendermint_tpu.crypto import new_batch_verifier
 from tendermint_tpu.crypto import merkle
 from tendermint_tpu.crypto.keys import PubKey
-from tendermint_tpu.wire.proto import ProtoWriter
+from tendermint_tpu.wire.proto import (
+    ProtoWriter,
+    encode_uvarint,
+    encode_varint_signed,
+)
 
 from .basic import BlockID
 
@@ -39,9 +43,20 @@ def _clip(v: int) -> int:
     return max(_I64_MIN, min(_I64_MAX, v))
 
 
+_PK_PROTO_CACHE: dict[bytes, bytes] = {}
+
+
 def pub_key_proto_bytes(pub_key: PubKey) -> bytes:
-    """tendermint.crypto.PublicKey{oneof sum: ed25519=1} (keys.proto)."""
-    return ProtoWriter().bytes_(1, pub_key.bytes_(), omit_empty=False).bytes_out()
+    """tendermint.crypto.PublicKey{oneof sum: ed25519=1} (keys.proto).
+    Memoized by key bytes: encoded for every validator row of every
+    state save / wire message, and keys are immutable."""
+    raw = pub_key.bytes_()
+    enc = _PK_PROTO_CACHE.get(raw)
+    if enc is None:
+        enc = ProtoWriter().bytes_(1, raw, omit_empty=False).bytes_out()
+        if len(_PK_PROTO_CACHE) < 65536:  # bound: ~100B/entry
+            _PK_PROTO_CACHE[raw] = enc
+    return enc
 
 
 def simple_validator_bytes(pub_key: PubKey, voting_power: int) -> bytes:
@@ -91,15 +106,17 @@ class Validator:
 
     def encode(self) -> bytes:
         """validator.proto Validator{address=1, pub_key=2, voting_power=3,
-        proposer_priority=4}."""
-        return (
-            ProtoWriter()
-            .bytes_(1, self.address)
-            .message(2, pub_key_proto_bytes(self.pub_key), always=True)
-            .varint(3, self.voting_power)
-            .varint(4, self.proposer_priority)
-            .bytes_out()
-        )
+        proposer_priority=4}.  Hand-rolled (byte-identical to the
+        ProtoWriter form — differential-tested): this runs per validator
+        row per state save, the hottest encoder after CommitSig."""
+        pk = pub_key_proto_bytes(self.pub_key)
+        out = b"\x0a" + encode_uvarint(len(self.address)) + self.address \
+            + b"\x12" + encode_uvarint(len(pk)) + pk
+        if self.voting_power:
+            out += b"\x18" + encode_varint_signed(self.voting_power)
+        if self.proposer_priority:
+            out += b"\x20" + encode_varint_signed(self.proposer_priority)
+        return out
 
     @classmethod
     def decode(cls, data: bytes) -> "Validator":
@@ -137,6 +154,11 @@ class ValidatorSet:
     def _reindex(self) -> None:
         # address → index; keeps get_by_address O(1) at 10k-validator scale
         self._by_address = {v.address: i for i, v in enumerate(self.validators)}
+        # membership/power changed ⇒ the memoized hash is stale.  Priority
+        # churn (increment_proposer_priority) deliberately does NOT come
+        # through here: the hash covers (pub_key, power) only
+        # (simple_validator_bytes), so it survives rotation.
+        self._hash: bytes | None = None
 
     # -- bookkeeping ---------------------------------------------------
     def _update_total_voting_power(self) -> None:
@@ -164,6 +186,7 @@ class ValidatorSet:
         c.validators = [v.copy() for v in self.validators]
         c._total_voting_power = self._total_voting_power
         c._reindex()
+        c._hash = self._hash  # same membership ⇒ same hash
         c.proposer = self.proposer.copy() if self.proposer else None
         return c
 
@@ -244,7 +267,14 @@ class ValidatorSet:
 
     # -- hashing -------------------------------------------------------
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices([v.bytes_() for v in self.validators])
+        """Merkle root over (pub_key, power) rows; memoized — consensus
+        recomputes it for every header validation and the membership
+        changes only at validator-update heights."""
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [v.bytes_() for v in self.validators]
+            )
+        return self._hash
 
     # -- validator-set updates (ABCI EndBlock) -------------------------
     def update_with_change_set(self, changes: list[Validator]) -> None:

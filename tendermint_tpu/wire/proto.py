@@ -47,10 +47,18 @@ def guard_decode(fn):
     return wrapper
 
 
+_SMALL_UVARINT = [bytes([i]) for i in range(0x80)]
+
+
 def encode_uvarint(n: int) -> bytes:
-    """Unsigned LEB128 varint."""
-    if n < 0:
-        raise ValueError("uvarint cannot encode negative values")
+    """Unsigned LEB128 varint.  Single-byte values come from a
+    precomputed table — this is the hottest function of the whole codec
+    (hundreds of thousands of calls per replayed block window), and most
+    values are field tags and small lengths."""
+    if n < 0x80:
+        if n < 0:
+            raise ValueError("uvarint cannot encode negative values")
+        return _SMALL_UVARINT[n]
     out = bytearray()
     while True:
         b = n & 0x7F
@@ -96,6 +104,7 @@ def decode_varint_signed(data: bytes, pos: int = 0) -> tuple[int, int]:
     return v, pos
 
 
+@functools.lru_cache(maxsize=512)
 def _tag(field: int, wire_type: int) -> bytes:
     return encode_uvarint((field << 3) | wire_type)
 

@@ -180,3 +180,67 @@ def test_fe_mul_add_sub_match_bigint(a, b):
     assert val(fe.fe_carry(fe.fe_add(la, lb))) == (a + b) % fe.P
     assert val(fe.fe_carry(fe.fe_sub(la, lb))) == (a - b) % fe.P
     assert val(fe.fe_sq(la)) == (a * a) % fe.P
+
+
+# -- hand-rolled hot encoders must stay byte-identical to ProtoWriter ----
+
+
+@given(st.integers(min_value=0, max_value=3),
+       st.binary(min_size=20, max_size=20),
+       st.integers(min_value=-(2**62), max_value=2**62),
+       st.binary(min_size=0, max_size=64))
+@settings(max_examples=80, deadline=None)
+def test_commit_sig_encode_matches_protowriter(flag, addr, ts, sig):
+    from tendermint_tpu.types.basic import encode_timestamp
+    from tendermint_tpu.types.commit import CommitSig
+    from tendermint_tpu.wire.proto import ProtoWriter
+
+    cs = CommitSig.__new__(CommitSig)
+    cs.block_id_flag = flag
+    cs.validator_address = addr
+    cs.timestamp_ns = ts
+    cs.signature = sig
+    want = (
+        ProtoWriter()
+        .varint(1, int(flag))
+        .bytes_(2, addr)
+        .message(3, encode_timestamp(ts), always=True)
+        .bytes_(4, sig)
+        .bytes_out()
+    )
+    assert cs.encode() == want
+
+
+@given(st.integers(min_value=-(2**62), max_value=2**62))
+@settings(max_examples=120, deadline=None)
+def test_encode_timestamp_matches_protowriter(ns):
+    from tendermint_tpu.types.basic import NS, encode_timestamp
+    from tendermint_tpu.wire.proto import ProtoWriter
+
+    seconds, nanos = divmod(ns, NS)
+    want = ProtoWriter().varint(1, seconds).varint(2, nanos).bytes_out()
+    assert encode_timestamp(ns) == want
+
+
+@given(st.integers(min_value=0, max_value=2**40),
+       st.integers(min_value=-(2**40), max_value=2**40))
+@settings(max_examples=80, deadline=None)
+def test_validator_encode_matches_protowriter(power, priority):
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+    from tendermint_tpu.types.validator import (
+        Validator,
+        pub_key_proto_bytes,
+    )
+    from tendermint_tpu.wire.proto import ProtoWriter
+
+    pub = priv_key_from_seed(b"\x09" * 32).pub_key()
+    v = Validator(pub_key=pub, voting_power=power, proposer_priority=priority)
+    want = (
+        ProtoWriter()
+        .bytes_(1, v.address)
+        .message(2, pub_key_proto_bytes(pub), always=True)
+        .varint(3, power)
+        .varint(4, priority)
+        .bytes_out()
+    )
+    assert v.encode() == want
