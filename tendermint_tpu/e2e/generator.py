@@ -17,7 +17,9 @@ from __future__ import annotations
 import random
 
 PERTURB_OPS = ("kill", "pause", "restart")  # reference perturb.go:29-66
-MISBEHAVIORS = ("double-prevote",)  # reference test/maverick misbehaviors
+# the maverick's full misbehavior menu (e2e/maverick.py); the generator
+# draws equivocations and amnesia — nil-voting is just liveness noise
+MISBEHAVIORS = ("double-prevote", "double-precommit", "amnesia")
 
 
 def generate_manifest(rng: random.Random, index: int = 0) -> dict:

@@ -11,6 +11,10 @@ Misbehaviors:
     for a fabricated block, signed with the raw validator key (bypassing
     the privval double-sign guard — that guard is the node protecting
     itself; a real byzantine actor has the key).
+  * "double-precommit": the same equivocation at the precommit step.
+  * "amnesia": forget the lock when prevoting — vote for the current
+    proposal even while locked on a different block (the amnesia attack;
+    honest peers must stay safe because their own locks hold).
   * "nil-prevote": prevote nil regardless of the proposal.
   * "nil-precommit": precommit nil regardless of the polka.
 """
@@ -22,7 +26,13 @@ from tendermint_tpu.consensus.state import ConsensusState
 from tendermint_tpu.types import Vote
 from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
 
-MISBEHAVIORS = ("double-prevote", "nil-prevote", "nil-precommit")
+MISBEHAVIORS = (
+    "double-prevote",
+    "double-precommit",
+    "amnesia",
+    "nil-prevote",
+    "nil-precommit",
+)
 
 
 class MaverickConsensusState(ConsensusState):
@@ -36,12 +46,31 @@ class MaverickConsensusState(ConsensusState):
         # a node never gossips votes it knows to be equivocating; the
         # reference maverick reactor broadcasts directly too).
         self.broadcast_vote = None
+        self.amnesia_prevotes = 0  # diagnostics: times the lock was ignored
         for h, name in self.misbehaviors.items():
             if name not in MISBEHAVIORS:
                 raise ValueError(f"unknown misbehavior {name!r} at height {h}")
 
     def _active(self) -> str | None:
         return self.misbehaviors.get(self.rs.height)
+
+    def do_prevote(self, height: int, round_: int) -> None:
+        if self._active() == "amnesia" and self.rs.proposal_block is not None:
+            # forget the lock: vote for whatever is proposed NOW
+            if (
+                self.rs.locked_block is not None
+                and self.rs.locked_block.hash() != self.rs.proposal_block.hash()
+            ):
+                self.amnesia_prevotes += 1  # an actual lock contradiction
+            self.sign_add_vote(
+                SignedMsgType.PREVOTE,
+                self.rs.proposal_block.hash(),
+                self.rs.proposal_block_parts.header(),
+            )
+            self.logger.info("maverick: amnesia prevote", height=height,
+                             round=round_)
+            return
+        super().do_prevote(height, round_)
 
     def sign_add_vote(self, msg_type: SignedMsgType, hash_, header) -> Vote | None:
         mis = self._active()
@@ -50,17 +79,16 @@ class MaverickConsensusState(ConsensusState):
         if mis == "nil-precommit" and msg_type == SignedMsgType.PRECOMMIT:
             hash_, header = b"", PartSetHeader(0, b"")
         vote = super().sign_add_vote(msg_type, hash_, header)
-        if (
-            mis == "double-prevote"
-            and msg_type == SignedMsgType.PREVOTE
-            and vote is not None
-            and self.raw_key is not None
-        ):
-            # conflicting prevote for a fabricated block at the same H/R,
+        equivocate = (
+            (mis == "double-prevote" and msg_type == SignedMsgType.PREVOTE)
+            or (mis == "double-precommit" and msg_type == SignedMsgType.PRECOMMIT)
+        )
+        if equivocate and vote is not None and self.raw_key is not None:
+            # conflicting vote for a fabricated block at the same H/R,
             # signed directly with the raw key (reference maverick
-            # double-prevote)
+            # double-prevote, extended to the precommit step)
             evil = Vote(
-                type=SignedMsgType.PREVOTE,
+                type=msg_type,
                 height=vote.height,
                 round=vote.round,
                 block_id=BlockID(hash=b"\xde" * 32,
@@ -72,6 +100,7 @@ class MaverickConsensusState(ConsensusState):
             evil.signature = self.raw_key.sign(evil.sign_bytes(self.state.chain_id))
             if self.broadcast_vote is not None:
                 self.broadcast_vote(evil)
-            self.logger.info("maverick: double prevote emitted",
-                             height=vote.height, round=vote.round)
+            self.logger.info("maverick: equivocating vote emitted",
+                             type=msg_type.name, height=vote.height,
+                             round=vote.round)
         return vote

@@ -200,30 +200,51 @@ def parse_message(data: bytes) -> list[tuple[int, int, object]]:
     """Parse a protobuf message into a list of (field, wire_type, value).
 
     Values: int for varint/fixed; bytes for length-delimited.
+
+    Hot path: tags and small lengths are single-byte varints in practice,
+    so those are decoded inline; multi-byte values fall back to
+    decode_uvarint (which also carries the 10-byte/64-bit strictness).
     """
     fields: list[tuple[int, int, object]] = []
+    append = fields.append
     pos = 0
-    while pos < len(data):
-        key, pos = decode_uvarint(data, pos)
+    n_data = len(data)
+    while pos < n_data:
+        b = data[pos]
+        if b < 0x80:
+            key = b
+            pos += 1
+        else:
+            key, pos = decode_uvarint(data, pos)
         field, wt = key >> 3, key & 7
         if wt == WT_VARINT:
-            v, pos = decode_uvarint(data, pos)
-            fields.append((field, wt, v))
-        elif wt == WT_FIXED64:
-            if pos + 8 > len(data):
-                raise ValueError("truncated fixed64")
-            fields.append((field, wt, struct.unpack_from("<Q", data, pos)[0]))
-            pos += 8
+            b = data[pos] if pos < n_data else None
+            if b is not None and b < 0x80:
+                append((field, 0, b))
+                pos += 1
+            else:
+                v, pos = decode_uvarint(data, pos)
+                append((field, 0, v))
         elif wt == WT_BYTES:
-            n, pos = decode_uvarint(data, pos)
-            if pos + n > len(data):
+            b = data[pos] if pos < n_data else None
+            if b is not None and b < 0x80:
+                ln = b
+                pos += 1
+            else:
+                ln, pos = decode_uvarint(data, pos)
+            if pos + ln > n_data:
                 raise ValueError("truncated bytes field")
-            fields.append((field, wt, data[pos : pos + n]))
-            pos += n
+            append((field, 2, data[pos : pos + ln]))
+            pos += ln
+        elif wt == WT_FIXED64:
+            if pos + 8 > n_data:
+                raise ValueError("truncated fixed64")
+            append((field, wt, struct.unpack_from("<Q", data, pos)[0]))
+            pos += 8
         elif wt == WT_FIXED32:
-            if pos + 4 > len(data):
+            if pos + 4 > n_data:
                 raise ValueError("truncated fixed32")
-            fields.append((field, wt, struct.unpack_from("<I", data, pos)[0]))
+            append((field, wt, struct.unpack_from("<I", data, pos)[0]))
             pos += 4
         else:
             raise ValueError(f"unsupported wire type {wt}")

@@ -842,3 +842,52 @@ def test_validator_set_change_effective_h_plus_2():
     assert st2.validators.has_address(pub.address()), (
         "update must be active (H+2 rule)"
     )
+
+
+# ---------------------------------------------------------------------------
+# maverick amnesia at the FSM level: the misbehavior must actually
+# contradict a held lock (the e2e net test only proves honest-majority
+# safety; this proves the byzantine half)
+# ---------------------------------------------------------------------------
+
+def test_maverick_amnesia_contradicts_lock():
+    from tendermint_tpu.consensus.wal import NopWAL
+    from tendermint_tpu.e2e.maverick import MaverickConsensusState
+
+    async def scenario():
+        h = Harness(seeds=SEEDS_WE_THIRD, timeouts_ms=100)
+        honest = h.cs
+        # swap in a maverick over the same stores/executor, amnesiac at h1
+        h.cs = MaverickConsensusState(
+            honest.config, h.state_store.load(), h.executor, h.block_store,
+            wal=NopWAL(), priv_validator=honest.priv_validator,
+            misbehaviors={1: "amnesia"},
+        )
+        h.cs.on_event = h._capture
+        cs = h.cs
+        await cs.start()
+        try:
+            # R0: lock block0 via polka, peers precommit nil → R1
+            await h.wait_step(1, 0, Step.PROPOSE)
+            block0, parts0 = h.make_block(txs=[b"lock=me"])
+            bid0 = await h.inject_proposal(1, block0, parts0, 0)
+            await h.wait_our_vote(SignedMsgType.PREVOTE, 1, 0)
+            await h.inject_votes(SignedMsgType.PREVOTE, 1, 0, bid0, [1, 2, 3])
+            await h.wait_our_vote(SignedMsgType.PRECOMMIT, 1, 0)
+            assert cs.rs.locked_block is not None
+            await h.inject_votes(SignedMsgType.PRECOMMIT, 1, 0, None, [1, 2, 3])
+            await h.wait_step(1, 1, Step.PROPOSE)
+
+            # R1: a DIFFERENT proposal — the amnesiac must prevote it,
+            # contradicting its lock (an honest node prevotes bid0)
+            block1, parts1 = h.make_block(txs=[b"other=block"], proposer_i=2)
+            bid1 = await h.inject_proposal(2, block1, parts1, 1)
+            v1 = await h.wait_our_vote(SignedMsgType.PREVOTE, 1, 1)
+            assert v1.block_id.hash == bid1.hash, (
+                "amnesiac maverick must vote the live proposal, not its lock"
+            )
+            assert cs.amnesia_prevotes >= 1
+        finally:
+            await cs.stop()
+
+    run(scenario())

@@ -293,6 +293,95 @@ def test_maverick_double_prevote_in_proc():
     asyncio.run(run())
 
 
+def test_maverick_double_precommit_in_proc():
+    """Equivocation at the PRECOMMIT step also becomes committed
+    DuplicateVoteEvidence and never forks the honest majority."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_multinode import make_net, start_mesh, wait_all_height
+
+    from tendermint_tpu.consensus.wal import NopWAL
+    from tendermint_tpu.e2e.maverick import MaverickConsensusState
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+    async def run():
+        nodes = make_net(4)
+        byz = nodes[3]
+        cs = byz.cs
+        byz.cs = MaverickConsensusState(
+            cs.config, cs.state, cs.block_exec, cs.block_store,
+            wal=NopWAL(), priv_validator=cs.priv_validator,
+            evidence_pool=cs.evpool,
+            # two strikes: an equivocating vote can race the height
+            # transition and miss honest vote sets; either height landing
+            # in evidence satisfies the scenario
+            misbehaviors={2: "double-precommit", 3: "double-precommit"},
+            raw_key=byz.key,
+        )
+        byz.reactor.cs = byz.cs
+        byz.cs.event_bus = cs.event_bus
+        byz.cs.on_event = byz.reactor._on_cs_event
+        from tendermint_tpu.consensus.messages import VoteMessage
+        from tendermint_tpu.p2p.types import Envelope
+
+        byz.cs.broadcast_vote = lambda v: byz.reactor.vote_ch.try_send(
+            Envelope(message=VoteMessage(v), broadcast=True)
+        )
+        await start_mesh(nodes)
+        try:
+            await wait_all_height(nodes, 7)
+        finally:
+            for n in nodes:
+                await n.stop()
+
+        committed = []
+        for h in range(1, nodes[0].block_store.height() + 1):
+            committed.extend(nodes[0].block_store.load_block(h).evidence)
+        dupes = [e for e in committed if isinstance(e, DuplicateVoteEvidence)]
+        assert dupes, "double precommit never became committed evidence"
+        assert dupes[0].vote_a.validator_address == byz.key.pub_key().address()
+        for h in range(1, 6):
+            hashes = {n.block_store.load_block(h).hash() for n in nodes}
+            assert len(hashes) == 1, f"fork at height {h}"
+
+    asyncio.run(run())
+
+
+def test_maverick_amnesia_net_stays_safe():
+    """One amnesiac validator (votes the live proposal, ignoring its own
+    lock) cannot break safety for the 3 honest nodes: the chain advances
+    with identical blocks everywhere."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_multinode import make_net, start_mesh, wait_all_height
+
+    from tendermint_tpu.consensus.wal import NopWAL
+    from tendermint_tpu.e2e.maverick import MaverickConsensusState
+
+    async def run():
+        nodes = make_net(4)
+        byz = nodes[2]
+        cs = byz.cs
+        byz.cs = MaverickConsensusState(
+            cs.config, cs.state, cs.block_exec, cs.block_store,
+            wal=NopWAL(), priv_validator=cs.priv_validator,
+            evidence_pool=cs.evpool,
+            misbehaviors={2: "amnesia", 3: "amnesia"}, raw_key=byz.key,
+        )
+        byz.reactor.cs = byz.cs
+        byz.cs.event_bus = cs.event_bus
+        byz.cs.on_event = byz.reactor._on_cs_event
+        await start_mesh(nodes)
+        try:
+            await wait_all_height(nodes, 5)
+        finally:
+            for n in nodes:
+                await n.stop()
+        for h in range(1, 5):
+            hashes = {n.block_store.load_block(h).hash() for n in nodes}
+            assert len(hashes) == 1, f"fork at height {h}"
+
+    asyncio.run(run())
+
+
 def test_generator_reproducible_and_valid():
     """Manifest generator: seeded determinism + schema validity
     (reference test/e2e/generator)."""
