@@ -73,38 +73,37 @@ def _handle_conflicting_headers(
     # Each side receives the OTHER side's block as the conflicting one
     # (detector.go:120-147): the witness gets evidence packaging the
     # PRIMARY's divergent header (so the honest chain sees the forgery),
-    # and the primary gets evidence packaging the witness's header.
+    # and the primary gets evidence packaging the witness's header.  The
+    # same-height block from the receiving side is the "trusted" header
+    # that classifies the attack (lunatic/equivocation/amnesia).
     try:
         primary_at = next(
             lb for lb in reversed(primary_trace) if lb.height == witness_lb.height
         )
     except StopIteration:
         primary_at = primary_trace[-1]
-    ev_against_primary = _make_evidence(common, primary_at)
+    ev_against_primary = _make_evidence(common, primary_at, witness_lb)
     witness.report_evidence(ev_against_primary)
-    ev_against_witness = _make_evidence(common, witness_lb)
+    ev_against_witness = _make_evidence(common, witness_lb, primary_at)
     client.primary.report_evidence(ev_against_witness)
     return True
 
 
 def _make_evidence(
-    common: LightBlock, conflicting: LightBlock
+    common: LightBlock, conflicting: LightBlock, trusted: LightBlock
 ) -> LightClientAttackEvidence:
-    """reference detector.go:150-192 newLightClientAttackEvidence +
-    types/evidence.go GetByzantineValidators (lunatic case: common-set
-    validators that signed the conflicting commit)."""
-    byzantine = []
-    for i, cs in enumerate(conflicting.commit.signatures):
-        if not cs.for_block():
-            continue
-        _, val = common.validator_set.get_by_address(cs.validator_address)
-        if val is not None:
-            byzantine.append(val)
-    return LightClientAttackEvidence(
+    """reference detector.go:150-192 newLightClientAttackEvidence; the
+    byzantine signers follow the attack-type rules of
+    types/evidence.go:233-279 (lunatic → common-set signers of the
+    conflicting commit, equivocation → double-signers, amnesia → none)."""
+    ev = LightClientAttackEvidence(
         conflicting_block_bytes=conflicting.encode(),
         common_height=common.height,
-        byzantine_validators=byzantine,
         total_voting_power=common.validator_set.total_voting_power(),
         timestamp_ns=common.time_ns if common.time_ns else GO_ZERO_TIME_NS,
         conflicting_header_hash=conflicting.hash(),
     )
+    ev.byzantine_validators = ev.get_byzantine_validators(
+        common.validator_set, trusted.signed_header
+    )
+    return ev

@@ -118,6 +118,64 @@ class LightClientAttackEvidence:
         if self.common_height < 1:
             raise ValueError("common height must be >= 1")
 
+    def conflicting_light_block(self):
+        """Decode the attached conflicting LightBlock (stored as opaque
+        bytes to keep this module cycle-free)."""
+        from .light import LightBlock
+
+        return LightBlock.decode(self.conflicting_block_bytes)
+
+    def conflicting_header_is_invalid(self, trusted_header, _header=None) -> bool:
+        """True when the conflicting header cannot be the product of a
+        valid state transition — i.e. a LUNATIC attack (reference
+        types/evidence.go:285-292: any deterministic header field
+        differing from the trusted header at the same height).
+        `_header`: pre-decoded conflicting header, to avoid re-decoding
+        when the caller already holds the LightBlock."""
+        ch = _header if _header is not None else self.conflicting_light_block().header
+        return (
+            ch.validators_hash != trusted_header.validators_hash
+            or ch.next_validators_hash != trusted_header.next_validators_hash
+            or ch.consensus_hash != trusted_header.consensus_hash
+            or ch.app_hash != trusted_header.app_hash
+            or ch.last_results_hash != trusted_header.last_results_hash
+        )
+
+    def get_byzantine_validators(self, common_vals, trusted_sh, _lb=None) -> list:
+        """The provably-malicious signers, by attack type (reference
+        types/evidence.go:233-279 GetByzantineValidators):
+
+        * lunatic (invalid conflicting header): common-set validators who
+          signed the conflicting commit;
+        * equivocation (same round as the trusted commit): validators who
+          signed BOTH commits (validator sets are identical, so indexes
+          align);
+        * amnesia (different round, valid header): not attributable —
+          empty list.
+        """
+        lb = _lb if _lb is not None else self.conflicting_light_block()
+        out = []
+        if self.conflicting_header_is_invalid(trusted_sh.header, _header=lb.header):
+            for cs in lb.commit.signatures:
+                if not cs.for_block():
+                    continue
+                _, val = common_vals.get_by_address(cs.validator_address)
+                if val is not None:
+                    out.append(val)
+        elif trusted_sh.commit.round == lb.commit.round:
+            for i, sig_a in enumerate(lb.commit.signatures):
+                if sig_a.absent():
+                    continue
+                if i >= len(trusted_sh.commit.signatures):
+                    continue
+                if trusted_sh.commit.signatures[i].absent():
+                    continue
+                _, val = lb.validator_set.get_by_address(sig_a.validator_address)
+                if val is not None:
+                    out.append(val)
+        out.sort(key=lambda v: (-v.voting_power, v.address))
+        return out
+
 
 def decode_evidence(data: bytes):
     f = fields_to_dict(data)

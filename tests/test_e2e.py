@@ -264,7 +264,11 @@ def test_maverick_double_prevote_in_proc():
             cs.config, cs.state, cs.block_exec, cs.block_store,
             wal=NopWAL(), priv_validator=cs.priv_validator,
             evidence_pool=cs.evpool,
-            misbehaviors={2: "double-prevote"}, raw_key=byz.key,
+            # two strikes: the equivocating vote can race the height
+            # transition and miss honest vote sets; either height landing
+            # in committed evidence satisfies the scenario
+            misbehaviors={2: "double-prevote", 3: "double-prevote"},
+            raw_key=byz.key,
         )
         byz.reactor.cs = byz.cs
         # reactor wiring: reuse the original channels on the new cs

@@ -534,3 +534,115 @@ def test_client_update_advances_to_primary_head(chain):
     assert lb is not None and lb.height == chain.height()
     assert c.last_trusted_height() == chain.height()
     assert c.update(now_at(chain.height())) is None
+
+
+# -- attack classification (reference types/evidence.go:233-279
+# GetByzantineValidators: lunatic / equivocation / amnesia) ---------------
+
+
+def _attack_evidence(chain, conflicting_lb, common_h=1):
+    from tendermint_tpu.types.evidence import LightClientAttackEvidence
+
+    common = chain.blocks[common_h]
+    return LightClientAttackEvidence(
+        conflicting_block_bytes=conflicting_lb.encode(),
+        common_height=common.height,
+        total_voting_power=common.validator_set.total_voting_power(),
+        timestamp_ns=common.time_ns,
+        conflicting_header_hash=conflicting_lb.hash(),
+    )
+
+
+def test_byzantine_validators_lunatic(chain):
+    """A conflicting header with a forged app hash is a lunatic attack:
+    byzantine = common-set validators who signed the conflicting commit."""
+    fork = chain.fork()
+    del fork.blocks[6]
+    for h in (7, 8, 9, 10, 11, 12):
+        del fork.blocks[h]
+    fork.last_block_id = chain.blocks[5].signed_header.commit.block_id
+    fork.extend(1, app_hash=b"\xEE" * 32)  # invalid state transition at 6
+    evil = fork.blocks[6]
+
+    ev = _attack_evidence(chain, evil, common_h=5)
+    trusted = chain.blocks[6].signed_header
+    assert ev.conflicting_header_is_invalid(trusted.header)
+    byz = ev.get_byzantine_validators(chain.blocks[5].validator_set, trusted)
+    signers = {cs.validator_address for cs in evil.commit.signatures
+               if cs.for_block()}
+    assert byz and {v.address for v in byz} <= signers
+
+
+def test_byzantine_validators_equivocation(chain):
+    """Same height, same round, valid header fields, different block:
+    equivocation — byzantine = validators who signed BOTH commits."""
+    real = chain.blocks[6]
+    # forge a sibling block at height 6 with identical deterministic
+    # fields but a different data hash → different block hash
+    from tendermint_tpu.types.block import Header
+
+    h6 = real.header
+    evil_header = Header(
+        chain_id=h6.chain_id, height=h6.height, time_ns=h6.time_ns,
+        last_block_id=h6.last_block_id, validators_hash=h6.validators_hash,
+        next_validators_hash=h6.next_validators_hash,
+        consensus_hash=h6.consensus_hash, app_hash=h6.app_hash,
+        last_results_hash=h6.last_results_hash,
+        data_hash=b"\x77" * 32,
+        proposer_address=h6.proposer_address,
+    )
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader
+    from tendermint_tpu.types.commit import BlockIDFlag, Commit, CommitSig
+    from tendermint_tpu.types.light import LightBlock, SignedHeader
+    from tendermint_tpu.types.vote import SignedMsgType, vote_sign_bytes_raw
+
+    bid = BlockID(hash=evil_header.hash(),
+                  part_set_header=PartSetHeader(total=1, hash=b"\x03" * 32))
+    key_by_addr = {k.pub_key().address(): k for k in chain.keys}
+    sigs = []
+    for v in real.validator_set.validators:
+        sb = vote_sign_bytes_raw(chain.chain_id, SignedMsgType.PRECOMMIT,
+                                 6, 0, bid, real.commit.signatures[0].timestamp_ns)
+        sigs.append(CommitSig(block_id_flag=BlockIDFlag.COMMIT,
+                              validator_address=v.address,
+                              timestamp_ns=real.commit.signatures[0].timestamp_ns,
+                              signature=key_by_addr[v.address].sign(sb)))
+    evil = LightBlock(
+        signed_header=SignedHeader(
+            header=evil_header,
+            commit=Commit(height=6, round=0, block_id=bid, signatures=sigs),
+        ),
+        validator_set=real.validator_set,
+    )
+
+    ev = _attack_evidence(chain, evil, common_h=5)
+    trusted = real.signed_header
+    assert not ev.conflicting_header_is_invalid(trusted.header)
+    byz = ev.get_byzantine_validators(chain.blocks[5].validator_set, trusted)
+    # every validator double-signed → all are byzantine
+    assert {v.address for v in byz} == {
+        v.address for v in real.validator_set.validators
+    }
+
+
+def test_byzantine_validators_amnesia_not_attributable(chain):
+    """Valid header, different round: amnesia — no validator is provably
+    malicious from the evidence alone."""
+    real = chain.blocks[6]
+    from tendermint_tpu.types.commit import Commit
+    from tendermint_tpu.types.light import LightBlock, SignedHeader
+
+    evil = LightBlock(
+        signed_header=SignedHeader(
+            header=real.header,
+            commit=Commit(height=6, round=1,  # different round
+                          block_id=real.commit.block_id,
+                          signatures=list(real.commit.signatures)),
+        ),
+        validator_set=real.validator_set,
+    )
+    ev = _attack_evidence(chain, evil, common_h=5)
+    byz = ev.get_byzantine_validators(
+        chain.blocks[5].validator_set, real.signed_header
+    )
+    assert byz == []
