@@ -1,0 +1,68 @@
+"""bench.py output contract: the driver parses EXACTLY one JSON line
+with metric/value/unit/vs_baseline from stdout, whatever happens to the
+backend.  Round 1 was lost to this surface; these tests pin it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REQUIRED = {"metric", "value", "unit", "vs_baseline"}
+
+
+def _run_bench(env_extra: dict, timeout: float) -> dict:
+    env = dict(os.environ)
+    env.update(env_extra)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=ROOT,
+        env=env,
+    )
+    assert out.returncode == 0, (out.returncode, out.stderr[-500:])
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"want exactly 1 stdout line, got {lines!r}"
+    doc = json.loads(lines[0])
+    assert REQUIRED <= set(doc), doc
+    return doc
+
+
+@pytest.mark.slow
+def test_bench_emits_one_json_line_on_cpu():
+    """Happy-ish path: tiny batch on the CPU backend (compile cache makes
+    this a few minutes at worst, seconds when warm)."""
+    doc = _run_bench(
+        {
+            "TM_BENCH_BACKENDS": "cpu",
+            "TM_BENCH_N": "8",
+            "TM_BENCH_RUNS": "1",
+            "TM_BENCH_DEADLINE": "420",
+        },
+        timeout=460,
+    )
+    assert doc["metric"] == "ed25519_sig_verifies_per_sec"
+    assert doc["backend"] == "cpu"
+    assert doc["value"] > 0
+    assert "commit8_p50_ms" in doc  # honest label for the tiny batch
+
+
+@pytest.mark.slow
+def test_bench_emits_diagnostic_line_when_no_backend_works():
+    """Failure path: an impossible backend list must still produce one
+    parseable JSON line (value 0 + error + stage), exit code 0."""
+    doc = _run_bench(
+        {
+            "TM_BENCH_BACKENDS": "no_such_platform",
+            "TM_BENCH_DEADLINE": "120",
+            "TM_BENCH_PROBE_TIMEOUT": "30",
+        },
+        timeout=150,
+    )
+    assert doc["value"] == 0
+    assert "error" in doc and "stage" in doc

@@ -243,13 +243,14 @@ def test_statesync_join_live_net(tmp_path):
     asyncio.run(run())
 
 
-def test_maverick_double_prevote_in_proc():
-    """A 4-node net where node 3 runs the maverick state machine with
-    double-prevote at height 2: honest nodes commit the equivocation as
-    DuplicateVoteEvidence (in-proc for speed; same net harness as the
-    multinode suite)."""
+def _run_equivocation_net(misbehavior: str):
+    """Shared driver for the maverick equivocation scenarios: node 3
+    equivocates at every height (bounded far past the poll budget) until
+    the honest nodes commit the DuplicateVoteEvidence (polled — under CPU
+    contention any single height's forged vote can race the height
+    transition and miss)."""
     sys.path.insert(0, os.path.dirname(__file__))
-    from test_multinode import make_net, start_mesh, wait_all_height
+    from test_multinode import make_net, start_mesh
 
     from tendermint_tpu.consensus.wal import NopWAL
     from tendermint_tpu.e2e.maverick import MaverickConsensusState
@@ -258,20 +259,15 @@ def test_maverick_double_prevote_in_proc():
     async def run():
         nodes = make_net(4)
         byz = nodes[3]
-        # swap node 3's consensus for a maverick with double-prevote @ h2
         cs = byz.cs
         byz.cs = MaverickConsensusState(
             cs.config, cs.state, cs.block_exec, cs.block_store,
             wal=NopWAL(), priv_validator=cs.priv_validator,
             evidence_pool=cs.evpool,
-            # two strikes: the equivocating vote can race the height
-            # transition and miss honest vote sets; either height landing
-            # in committed evidence satisfies the scenario
-            misbehaviors={2: "double-prevote", 3: "double-prevote"},
+            misbehaviors={h: misbehavior for h in range(2, 1000)},
             raw_key=byz.key,
         )
         byz.reactor.cs = byz.cs
-        # reactor wiring: reuse the original channels on the new cs
         byz.cs.event_bus = cs.event_bus
         byz.cs.on_event = byz.reactor._on_cs_event
         from tendermint_tpu.consensus.messages import VoteMessage
@@ -281,73 +277,51 @@ def test_maverick_double_prevote_in_proc():
             Envelope(message=VoteMessage(v), broadcast=True)
         )
         await start_mesh(nodes)
+
+        def committed_dupes():
+            out = []
+            for h in range(1, nodes[0].block_store.height() + 1):
+                blk = nodes[0].block_store.load_block(h)
+                if blk is not None:
+                    out.extend(
+                        e for e in blk.evidence
+                        if isinstance(e, DuplicateVoteEvidence)
+                    )
+            return out
+
         try:
-            await wait_all_height(nodes, 6)
+            async def until_evidence():
+                while not committed_dupes():
+                    await asyncio.sleep(0.25)
+
+            await asyncio.wait_for(until_evidence(), 120)
         finally:
             for n in nodes:
                 await n.stop()
 
-        committed = []
-        for h in range(1, nodes[0].block_store.height() + 1):
-            committed.extend(nodes[0].block_store.load_block(h).evidence)
-        dupes = [e for e in committed if isinstance(e, DuplicateVoteEvidence)]
-        assert dupes, "maverick double prevote never became committed evidence"
+        dupes = committed_dupes()
+        assert dupes, f"{misbehavior} never became committed evidence"
         assert dupes[0].vote_a.validator_address == byz.key.pub_key().address()
+        upto = min(n.block_store.height() for n in nodes)
+        for h in range(1, upto + 1):
+            hashes = {n.block_store.load_block(h).hash() for n in nodes}
+            assert len(hashes) == 1, f"fork at height {h}"
 
     asyncio.run(run())
+
+
+def test_maverick_double_prevote_in_proc():
+    """A 4-node net where node 3 runs the maverick state machine with
+    double-prevote: honest nodes commit the equivocation as
+    DuplicateVoteEvidence without forking (in-proc for speed; same net
+    harness as the multinode suite)."""
+    _run_equivocation_net("double-prevote")
 
 
 def test_maverick_double_precommit_in_proc():
     """Equivocation at the PRECOMMIT step also becomes committed
     DuplicateVoteEvidence and never forks the honest majority."""
-    sys.path.insert(0, os.path.dirname(__file__))
-    from test_multinode import make_net, start_mesh, wait_all_height
-
-    from tendermint_tpu.consensus.wal import NopWAL
-    from tendermint_tpu.e2e.maverick import MaverickConsensusState
-    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
-
-    async def run():
-        nodes = make_net(4)
-        byz = nodes[3]
-        cs = byz.cs
-        byz.cs = MaverickConsensusState(
-            cs.config, cs.state, cs.block_exec, cs.block_store,
-            wal=NopWAL(), priv_validator=cs.priv_validator,
-            evidence_pool=cs.evpool,
-            # two strikes: an equivocating vote can race the height
-            # transition and miss honest vote sets; either height landing
-            # in evidence satisfies the scenario
-            misbehaviors={2: "double-precommit", 3: "double-precommit"},
-            raw_key=byz.key,
-        )
-        byz.reactor.cs = byz.cs
-        byz.cs.event_bus = cs.event_bus
-        byz.cs.on_event = byz.reactor._on_cs_event
-        from tendermint_tpu.consensus.messages import VoteMessage
-        from tendermint_tpu.p2p.types import Envelope
-
-        byz.cs.broadcast_vote = lambda v: byz.reactor.vote_ch.try_send(
-            Envelope(message=VoteMessage(v), broadcast=True)
-        )
-        await start_mesh(nodes)
-        try:
-            await wait_all_height(nodes, 7)
-        finally:
-            for n in nodes:
-                await n.stop()
-
-        committed = []
-        for h in range(1, nodes[0].block_store.height() + 1):
-            committed.extend(nodes[0].block_store.load_block(h).evidence)
-        dupes = [e for e in committed if isinstance(e, DuplicateVoteEvidence)]
-        assert dupes, "double precommit never became committed evidence"
-        assert dupes[0].vote_a.validator_address == byz.key.pub_key().address()
-        for h in range(1, 6):
-            hashes = {n.block_store.load_block(h).hash() for n in nodes}
-            assert len(hashes) == 1, f"fork at height {h}"
-
-    asyncio.run(run())
+    _run_equivocation_net("double-precommit")
 
 
 def test_maverick_amnesia_net_stays_safe():
