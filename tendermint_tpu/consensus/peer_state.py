@@ -158,4 +158,10 @@ class PeerState:
         if prs.catchup_commit_round == round_:
             return
         prs.catchup_commit_round = round_
-        prs.catchup_commit = BitArray(num_validators)
+        if round_ == prs.round and prs.precommits is not None:
+            # the commit round IS the peer's current round: alias the live
+            # precommit bitmap so delivered marks survive a later round
+            # advance (reference: ps.PRS.CatchupCommit = ps.PRS.Precommits)
+            prs.catchup_commit = prs.precommits
+        else:
+            prs.catchup_commit = BitArray(num_validators)

@@ -324,6 +324,16 @@ class ConsensusReactor:
             if not isinstance(msg, VoteSetBitsMessage):
                 continue
             rs = self.cs.rs
+            ba = ps.get_vote_bitarray(msg.height, msg.round, msg.type)
+            if ba is None:
+                continue
+            # Reference ApplyVoteSetBitsMessage: REPLACE, don't OR — the
+            # peer's answer is authoritative for the claimed block, so
+            # bits we over-marked (sent but the peer rejected, e.g. an
+            # equivocator's honest vote refused as conflicting) must be
+            # CLEARED so gossip re-sends them once the peer can admit
+            # them: new = (known - ours_for_block) | claimed.
+            our = None
             if rs.height == msg.height and rs.votes is not None:
                 vs = (
                     rs.votes.prevotes(msg.round)
@@ -331,13 +341,35 @@ class ConsensusReactor:
                     else rs.votes.precommits(msg.round)
                 )
                 if vs is not None:
-                    our = vs.bit_array_by_block_id(msg.block_id)
-                    if our is not None:
-                        # peer has what it claims OR'd with what we know it has
-                        ba = ps.get_vote_bitarray(msg.height, msg.round, msg.type)
-                        if ba is not None:
-                            merged = ba.or_(msg.votes)
-                            ba.elems[: len(merged.elems)] = merged.elems[: len(ba.elems)]
+                    bools = vs.bit_array_by_block_id(msg.block_id)
+                    if bools is not None:
+                        our = BitArray.from_bools(bools)
+            elif (
+                rs.height > msg.height
+                and msg.type == SignedMsgType.PRECOMMIT
+                and msg.height >= self.block_store.base()
+                and msg.height <= self.block_store.height()
+            ):
+                # we're past that height: the canonical commit is our vote
+                # source for it (pairs with the lagging-peer maj23 case)
+                commit = self.block_store.load_block_commit(msg.height)
+                if (
+                    commit is not None
+                    and commit.round == msg.round
+                    and commit.block_id.hash == msg.block_id.hash
+                ):
+                    our = BitArray.from_bools(
+                        [not cs.absent() for cs in commit.signatures]
+                    )
+            if our is None:
+                # no own vote source to subtract: the peer's claim is
+                # wholesale authoritative (reference: ourVotes==nil →
+                # votes.Update(msg.Votes)) — replacing, not ORing, is what
+                # clears over-marked bits so rejected votes get re-sent
+                merged = msg.votes
+            else:
+                merged = ba.sub(our).or_(msg.votes)
+            ba.elems[: len(merged.elems)] = merged.elems[: len(ba.elems)]
 
     # ------------------------------------------------------------------
     # gossip: data (reference gossipDataRoutine, reactor.go:492)
@@ -475,9 +507,8 @@ class ConsensusReactor:
         ):
             commit = self.block_store.load_block_commit(prs.height)
             if commit is not None:
-                ps.ensure_catchup_commit_round(
-                    prs.height, commit.round, len(commit.signatures)
-                )
+                # _pick_send_vote registers the catchup-commit round itself
+                # for every commit-bearing source
                 if await self._pick_send_vote(ps, _CommitVotes(commit)):
                     return True
         return False
@@ -521,9 +552,31 @@ class ConsensusReactor:
         vtype = getattr(votes, "signed_msg_type", SignedMsgType.PRECOMMIT)
         round_ = votes.round
         ours = BitArray.from_bools(votes.bit_array())
+        # When the source IS a commit (canonical Commit, or a precommit
+        # set carrying +2/3) and the peer sits at that height on a LATER
+        # round, it still needs these round-`round_` precommits to
+        # finalize — lazily track them as the peer's catchup-commit round
+        # (reference PickVoteToSend: `if votes.IsCommit() {
+        # ps.ensureCatchupCommitRound(...) }`).  Without this, a peer that
+        # advanced past the commit round before gathering +2/3 precommits
+        # can never be served them: get_vote_bitarray returns None for
+        # non-current rounds and the whole net wedges (observed live: two
+        # nodes at H committed-and-ahead, two locked at H round 1,
+        # heights [3,4,4,3] forever).
+        if vtype == SignedMsgType.PRECOMMIT and height == prs.height:
+            is_commit = isinstance(votes, _CommitVotes) or (
+                hasattr(votes, "has_two_thirds_majority")
+                and votes.has_two_thirds_majority()
+            )
+            if is_commit:
+                ps.ensure_catchup_commit_round(height, round_, ours.size())
         ps._ensure_vote_bitarrays(height, ours.size())
         theirs = ps.get_vote_bitarray(height, round_, vtype)
         if theirs is None:
+            self.logger.debug("pick_send_vote: no peer bitarray",
+                              peer=ps.node_id[:8], height=height,
+                              round=round_, type=int(vtype),
+                              peer_h=prs.height, peer_r=prs.round)
             return False
         needed = ours.sub(theirs)
         idx, ok = needed.pick_random()
@@ -534,6 +587,8 @@ class ConsensusReactor:
             return False
         await self.vote_ch.send(Envelope(message=VoteMessage(vote), to=ps.node_id))
         ps.set_has_vote(height, round_, vtype, idx, ours.size())
+        self.logger.debug("pick_send_vote: sent", peer=ps.node_id[:8],
+                          height=height, round=round_, type=int(vtype), index=idx)
         return True
 
     # ------------------------------------------------------------------
@@ -546,23 +601,48 @@ class ConsensusReactor:
                 await asyncio.sleep(self.maj23_sleep + random.random() * 0.1)
                 rs = self.cs.rs
                 prs = ps.prs
-                if rs.votes is None or rs.height != prs.height:
-                    continue
-                for vs, t in (
-                    (rs.votes.prevotes(prs.round), SignedMsgType.PREVOTE),
-                    (rs.votes.precommits(prs.round), SignedMsgType.PRECOMMIT),
+                if rs.votes is not None and rs.height == prs.height:
+                    for vs, t in (
+                        (rs.votes.prevotes(prs.round), SignedMsgType.PREVOTE),
+                        (rs.votes.precommits(prs.round), SignedMsgType.PRECOMMIT),
+                    ):
+                        if vs is None:
+                            continue
+                        maj = vs.two_thirds_majority()
+                        if maj is not None:
+                            self.state_ch.try_send(
+                                Envelope(
+                                    message=VoteSetMaj23Message(
+                                        height=prs.height,
+                                        round=prs.round,
+                                        type=t,
+                                        block_id=maj,
+                                    ),
+                                    to=ps.node_id,
+                                )
+                            )
+                # Peer stuck at an older height we have the canonical commit
+                # for: advertise that commit's majority (reference
+                # reactor.go:811-837).  This is what lets a node that
+                # rejected an equivocator's honest precommit as conflicting
+                # register the peer-claimed majority, admit the conflict,
+                # and finalize — without it, a double-precommit at a
+                # commit-deciding round can wedge the minority forever.
+                elif (
+                    prs.height != 0
+                    and rs.height > prs.height
+                    and prs.height <= self.block_store.height()
+                    and prs.height >= self.block_store.base()
                 ):
-                    if vs is None:
-                        continue
-                    maj = vs.two_thirds_majority()
-                    if maj is not None:
+                    commit = self.block_store.load_block_commit(prs.height)
+                    if commit is not None:
                         self.state_ch.try_send(
                             Envelope(
                                 message=VoteSetMaj23Message(
                                     height=prs.height,
-                                    round=prs.round,
-                                    type=t,
-                                    block_id=maj,
+                                    round=commit.round,
+                                    type=SignedMsgType.PRECOMMIT,
+                                    block_id=commit.block_id,
                                 ),
                                 to=ps.node_id,
                             )

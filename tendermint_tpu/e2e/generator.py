@@ -31,6 +31,10 @@ def generate_manifest(rng: random.Random, index: int = 0) -> dict:
         "validators": n_vals,
         "target_height": target,
         "load_rate": rng.choice((0, 5, 10)),
+        # disjoint port range per manifest: a sweep runs nets back to
+        # back, and recycling one base port made lingering sockets from
+        # manifest N wedge manifest N+1 (each net needs 2 ports/node)
+        "base_port": 28000 + (index % 64) * 24,
     }
 
     # perturbations: up to 2, never on node 0 (the RPC anchor the runner

@@ -360,6 +360,52 @@ def test_maverick_amnesia_net_stays_safe():
     asyncio.run(run())
 
 
+@pytest.mark.xfail(
+    strict=False,
+    reason="KNOWN liveness gap (ROUND2_NOTES.md): a double-precommit at a "
+    "commit-deciding round can still wedge a timing window; round-2 added "
+    "the reference's maj23 recovery loop (catchup-commit bitmaps, "
+    "canonical-commit maj23 to lagging peers, replace-semantics "
+    "VoteSetBits), which fixed the deterministic wedge, but some timings "
+    "still stall — carried to round 3",
+)
+def test_byzantine_precommit_with_kill_does_not_wedge(tmp_path):
+    """Liveness regression probe: a double-precommit at a commit-deciding
+    round made nodes that saw the evil precommit first reject the
+    equivocator's honest one as conflicting — leaving them one vote short
+    of +2/3 while the others advanced; the net wedges at a
+    [H, H+1, H+1, H] height split.  The round-2 maj23 recovery loop
+    (see reactor.py) recovers many of these; the remaining window is a
+    documented known issue."""
+
+    async def run():
+        net = Testnet(
+            {
+                "chain_id": "wedge-regress",
+                "validators": 4,
+                "target_height": 8,
+                "base_port": 27650,
+                "perturb": [{"node": 1, "op": "kill", "at_height": 2},
+                            {"node": 1, "op": "kill", "at_height": 6}],
+                "misbehaviors": {"2": {"4": "double-precommit"}},
+            },
+            str(tmp_path / "net"),
+        )
+        net.setup()
+        net.start()
+        try:
+            pt = asyncio.ensure_future(net.run_perturbations(timeout=360))
+            await net.wait_for_height(8, timeout=360)
+            if not pt.done():
+                pt.cancel()
+            upto = min(n.height() for n in net.nodes)
+            net.check_blocks_identical(upto)
+        finally:
+            net.stop()
+
+    asyncio.run(run())
+
+
 def test_generator_reproducible_and_valid():
     """Manifest generator: seeded determinism + schema validity
     (reference test/e2e/generator)."""
