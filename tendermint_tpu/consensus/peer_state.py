@@ -43,6 +43,12 @@ class PeerState:
     def apply_new_round_step(self, msg, num_validators: int) -> None:
         prs = self.prs
         ps_height, ps_round, ps_step = prs.height, prs.round, prs.step
+        # ignore non-advancing updates (reference ApplyNewRoundStepMessage:
+        # CompareHRS(msg, PRS) <= 0 → return): duplicates from the periodic
+        # round-step refresh are no-ops, and a delayed out-of-order NRS
+        # must not regress the view or clear the vote bitmaps
+        if (msg.height, msg.round, int(msg.step)) <= (ps_height, ps_round, int(ps_step)):
+            return
         prs.height = msg.height
         prs.round = msg.round
         prs.step = Step(msg.step)

@@ -601,6 +601,18 @@ class ConsensusReactor:
                 await asyncio.sleep(self.maj23_sleep + random.random() * 0.1)
                 rs = self.cs.rs
                 prs = ps.prs
+                # Periodic round-step refresh.  NewRoundStep is otherwise
+                # sent only on transitions (and once on peer-add) via
+                # try_send, which can DROP on a full queue — unlike the
+                # reference, whose AddPeer NRS rides a reliable blocking
+                # peer.Send (p2p/peer.go Send).  A wedged node makes no
+                # further transitions, so one lost NRS would leave this
+                # peer's view at height 0 forever and gate off every
+                # `prs.height != 0` recovery branch below.  Re-sending on
+                # the maj23 cadence makes peer state self-healing.
+                self.state_ch.try_send(
+                    Envelope(message=self._new_round_step_msg(), to=ps.node_id)
+                )
                 if rs.votes is not None and rs.height == prs.height:
                     for vs, t in (
                         (rs.votes.prevotes(prs.round), SignedMsgType.PREVOTE),

@@ -181,6 +181,11 @@ class ConsensusState:
                         # (crash ⇒ no double-sign; reference state.go:741-751)
                         self.wal.write_sync(item)
                         fail_point()  # reference state.go:747 (own msg fsynced)
+                        # errors here (e.g. a locally built oversized
+                        # proposal) fall through to the outer log-and-
+                        # continue handler — same containment as the peer
+                        # batch below (reference state.go returns the error
+                        # from addProposalBlockPart)
                         self.handle_msg(item)
                     else:
                         # drain everything else that arrived this tick and

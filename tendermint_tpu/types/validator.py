@@ -110,8 +110,13 @@ class Validator:
         ProtoWriter form — differential-tested): this runs per validator
         row per state save, the hottest encoder after CommitSig."""
         pk = pub_key_proto_bytes(self.pub_key)
-        out = b"\x0a" + encode_uvarint(len(self.address)) + self.address \
-            + b"\x12" + encode_uvarint(len(pk)) + pk
+        # proto3 omit-empty: an empty address (possible on adversarially
+        # decoded input that never passed validate_basic) must not emit
+        # field 1, or re-encoding diverges from the canonical form
+        out = b""
+        if self.address:
+            out += b"\x0a" + encode_uvarint(len(self.address)) + self.address
+        out += b"\x12" + encode_uvarint(len(pk)) + pk
         if self.voting_power:
             out += b"\x18" + encode_varint_signed(self.voting_power)
         if self.proposer_priority:

@@ -149,6 +149,20 @@ def test_proposer_rotation_weighted():
     assert counts[k2.pub_key().address()] == 10
 
 
+def test_validator_encode_omits_empty_address():
+    """proto3 omit-empty: field 1 must not be emitted for an empty address
+    (possible only on adversarially decoded input), so decode→encode is
+    canonical-form-stable."""
+    from tendermint_tpu.crypto.keys import gen_priv_key
+
+    v = Validator(pub_key=gen_priv_key().pub_key(), voting_power=5)
+    assert v.encode()[0] == 0x0A  # normal path: address present
+    v.address = b""
+    enc = v.encode()
+    assert enc[0] == 0x12  # field 1 skipped, pub_key first
+    assert Validator.decode(enc).voting_power == 5
+
+
 def test_validator_set_hash_changes_with_membership():
     vs1, _ = make_val_set(3)
     vs2, _ = make_val_set(4)

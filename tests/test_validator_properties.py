@@ -45,6 +45,107 @@ def _vset(powers) -> ValidatorSet:
 # ---------------------------------------------------------------------------
 
 
+def _raw_vset(entries) -> ValidatorSet:
+    """ValidatorSet with hand-set priorities/powers and NO initial
+    increment — mirrors the reference tests' raw struct construction
+    (validator_set_test.go:473,513 build ValidatorSet{Validators: ...}
+    directly).  `entries` = [(address_byte, priority, power), ...]."""
+    vals = []
+    for i, (addr, prio, power) in enumerate(entries):
+        v = _val(i, power)
+        v.address = bytes([addr]) * 20
+        v.proposer_priority = prio
+        vals.append(v)
+    return ValidatorSet(vals, proposer=vals[0])
+
+
+def test_averaging_in_increment_proposer_priority():
+    """Reference TestAveragingInIncrementProposerPriority
+    (validator_set_test.go:473): with zero voting power, increments are
+    no-ops and exactly one centering shift of the initial average is
+    applied, however many times we increment."""
+    cases = [
+        ([(ord("a"), 1, 0), (ord("b"), 2, 0), (ord("c"), 3, 0)], 1, 2),
+        ([(ord("a"), 10, 0), (ord("b"), -10, 0), (ord("c"), 1, 0)], 11, 0),
+        ([(ord("a"), 100, 0), (ord("b"), -10, 0), (ord("c"), 1, 0)], 1, 91 // 3),
+    ]
+    for i, (entries, times, avg) in enumerate(cases):
+        vs = _raw_vset(entries)
+        new = vs.copy_increment_proposer_priority(times)
+        for addr, prio, _power in entries:
+            _, updated = new.get_by_address(bytes([addr]) * 20)
+            assert updated is not None, (i, addr)
+            assert updated.proposer_priority == prio - avg, (i, addr)
+
+
+def test_averaging_in_increment_proposer_priority_with_voting_power():
+    """Reference TestAveragingInIncrementProposerPriorityWithVotingPower
+    (validator_set_test.go:513): the full priority trajectory of a
+    (10, 1, 1)-power set over 1..11 increments, including which validator
+    is proposer at each step."""
+    vp0, vp1, vp2 = 10, 1, 1
+    total = vp0 + vp1 + vp2
+    avg = 0  # priorities start at 0, so every round's average is 0
+    entries = [(0, 0, vp0), (1, 0, vp1), (2, 0, vp2)]
+    want = [
+        # (times, [prio0, prio1, prio2], proposer_index)
+        (1, [vp0 - total - avg, vp1, vp2], 0),
+        (2, [(vp0 - total) + vp0 - total - avg, 2 * vp1, 2 * vp2], 0),
+        (3, [3 * (vp0 - total) - avg, 3 * vp1, 3 * vp2], 0),
+        (4, [4 * (vp0 - total), 4 * vp1, 4 * vp2], 0),
+        (5, [4 * (vp0 - total) + vp0, 5 * vp1 - total, 5 * vp2], 1),
+        (6, [6 * vp0 - 5 * total, 6 * vp1 - total, 6 * vp2], 0),
+        (7, [7 * vp0 - 6 * total, 7 * vp1 - total, 7 * vp2], 0),
+        (8, [8 * vp0 - 7 * total, 8 * vp1 - total, 8 * vp2], 0),
+        (9, [9 * vp0 - 7 * total, 9 * vp1 - total, 9 * vp2 - total], 2),
+        (10, [10 * vp0 - 8 * total, 10 * vp1 - total, 10 * vp2 - total], 0),
+        (11, [11 * vp0 - 9 * total, 11 * vp1 - total, 11 * vp2 - total], 0),
+    ]
+    for times, prios, proposer_idx in want:
+        vs = _raw_vset(entries)
+        new = vs.copy_increment_proposer_priority(times)
+        got = [
+            new.get_by_address(bytes([a]) * 20)[1].proposer_priority
+            for a, _p, _w in entries
+        ]
+        assert got == prios, (times, got, prios)
+        assert new.get_proposer().address == bytes([proposer_idx]) * 20, times
+
+
+def test_proposer_frequency_proportional_over_long_run():
+    """Reference TestProposerFrequencies-class property: over >=10k
+    increments, each validator proposes with frequency proportional to its
+    voting power.  The weighted round-robin's deviation is bounded (each
+    validator's priority stays within one total-power window of fair
+    share), so observed counts must match expectation to within a small
+    absolute slack — not just statistically."""
+    import random
+
+    rng = random.Random(20260731)
+    powers = [rng.randint(1, 1000) for _ in range(17)]
+    vs = _vset(powers)
+    total = sum(powers)
+    rounds = 10_000
+    counts: dict[bytes, int] = {}
+    for _ in range(rounds):
+        vs.increment_proposer_priority(1)
+        p = vs.get_proposer()
+        counts[p.address] = counts.get(p.address, 0) + 1
+        # center invariant: priorities stay centered after every shift
+        prios = [v.proposer_priority for v in vs.validators]
+        assert abs(sum(prios)) < len(prios), "centering drift"
+        # scale invariant: spread bounded by the rescale window
+        assert max(prios) - min(prios) <= 2 * PRIORITY_WINDOW_SIZE_FACTOR * total
+    for i, power in enumerate(powers):
+        addr = _key(i).pub_key().address()
+        got = counts.get(addr, 0)
+        want = rounds * power / total
+        # bounded-deviation slack: one extra/missing turn per window the
+        # run spans, plus rounding
+        slack = max(3.0, rounds * power / total * 0.05)
+        assert abs(got - want) <= slack, (i, power, got, want)
+
+
 @settings(max_examples=40, deadline=None)
 @given(st.lists(st.integers(min_value=1, max_value=10**6), min_size=1, max_size=10),
        st.integers(min_value=1, max_value=50))

@@ -1,0 +1,107 @@
+"""Wedge-hunt harness: loop the byzantine double-precommit + kill scenario
+(tests/test_e2e.py::test_byzantine_precommit_with_kill_does_not_wedge) and
+capture full diagnostics on any stall.
+
+Not a pytest module (no test_ prefix).  Usage:
+
+    python tests/wedge_repro.py [iterations] [--keep]
+
+Each iteration runs the 4-node TCP net with node 2 double-precommitting at
+height 4 and node 1 killed at heights 2 and 6.  On a stall (height 8 not
+reached within the per-iteration budget) it dumps every node's
+`dump_consensus_state`, `net_info`, and `status` to stdout and preserves the
+net directory (node logs included) for inspection.
+"""
+
+import asyncio
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+from tendermint_tpu.e2e.runner import Testnet  # noqa: E402
+
+TARGET = 8
+BUDGET_S = 150.0
+
+
+def manifest(i: int) -> dict:
+    return {
+        "chain_id": f"wedge-{i}",
+        "validators": 4,
+        "target_height": TARGET,
+        "base_port": 27650 + (i % 40) * 16,
+        "perturb": [
+            {"node": 1, "op": "kill", "at_height": 2},
+            {"node": 1, "op": "kill", "at_height": 6},
+        ],
+        "misbehaviors": {"2": {"4": "double-precommit"}},
+    }
+
+
+def dump_node(n) -> dict:
+    out = {"index": n.index, "running": n.running}
+    for path, key in (
+        ("/dump_consensus_state", "consensus"),
+        ("/net_info", "net"),
+        ("/status", "status"),
+    ):
+        try:
+            out[key] = n.rpc(path, timeout=5.0)
+        except Exception as e:
+            out[key] = f"unreachable: {e}"
+    return out
+
+
+async def run_one(i: int, keep: bool) -> tuple[bool, str]:
+    root = tempfile.mkdtemp(prefix=f"wedge{i}-")
+    net = Testnet(manifest(i), root)
+    net.setup()
+    net.start()
+    stalled = False
+    detail = ""
+    try:
+        pt = asyncio.ensure_future(net.run_perturbations(timeout=BUDGET_S))
+        try:
+            await net.wait_for_height(TARGET, timeout=BUDGET_S)
+        except TimeoutError as e:
+            stalled = True
+            detail = str(e)
+            print(f"\n=== iteration {i}: STALL ({e}) ===")
+            dumps = [dump_node(n) for n in net.nodes]
+            print(json.dumps(dumps, indent=1, default=str)[:20000])
+            print(f"=== net dir preserved: {root} ===")
+        if not pt.done():
+            pt.cancel()
+        if not stalled:
+            upto = min(n.height() for n in net.nodes if n.running)
+            net.check_blocks_identical(upto)
+    finally:
+        net.stop()
+        if not (stalled or keep):
+            shutil.rmtree(root, ignore_errors=True)
+    return (not stalled), detail
+
+
+async def main() -> int:
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    keep = "--keep" in sys.argv
+    passed = 0
+    for i in range(iters):
+        t0 = time.time()
+        ok, detail = await run_one(i, keep)
+        passed += ok
+        print(
+            f"iteration {i}: {'pass' if ok else 'STALL'} "
+            f"({time.time() - t0:.1f}s) {detail}",
+            flush=True,
+        )
+    print(f"\n{passed}/{iters} passed")
+    return 0 if passed == iters else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
