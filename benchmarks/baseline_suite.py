@@ -143,18 +143,62 @@ def bench_fastsync_replay(n_blocks: int, n_vals: int, window: int = 64) -> None:
     from tendermint_tpu.store import BlockStore, MemDB
     from tendermint_tpu.types.validator import CommitVerifyJob, batch_verify_commits
 
+    # Chain construction is harness overhead, not the thing measured (at
+    # 10k blocks x 200 validators it costs ~8 min of Python signing/exec —
+    # r2 found build_s dwarfing total_s).  Build once, pickle the replay
+    # inputs (genesis + blocks + commits: plain dataclass trees of bytes),
+    # and reuse across runs.  The cache is keyed by shape; TM_TPU_CHAIN_CACHE
+    # overrides the directory, TM_TPU_CHAIN_CACHE=off disables.
+    import pickle
+
+    # default the cache into the (user-owned) repo tree, NOT a predictable
+    # world-writable /tmp path — pickle.load of an attacker-planted file
+    # would execute arbitrary code on a shared box
+    cache_dir = os.environ.get(
+        "TM_TPU_CHAIN_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".chain_cache"),
+    )
+    cache_path = (
+        None
+        if cache_dir == "off"
+        else os.path.join(cache_dir, f"chain_v1_{n_blocks}x{n_vals}.pkl")
+    )
     build_t0 = time.perf_counter()
-    b = ChainBuilder(n_vals=n_vals, chain_id="bench-chain")
-    b.build(n_blocks, tx_fn=lambda h: [b"k%d=v%d" % (h, h)])
+    payload = None
+    if cache_path and os.path.exists(cache_path):
+        try:
+            with open(cache_path, "rb") as f:
+                payload = pickle.load(f)
+        except Exception:
+            payload = None
+    cached = payload is not None
+    if payload is None:
+        b = ChainBuilder(n_vals=n_vals, chain_id="bench-chain")
+        b.build(n_blocks, tx_fn=lambda h: [b"k%d=v%d" % (h, h)])
+        payload = {
+            "genesis": b.genesis,
+            "blocks": [b.block_store.load_block(h) for h in range(1, n_blocks + 1)],
+            "commits": [
+                b.block_store.load_block_commit(h) or b.block_store.load_seen_commit(h)
+                for h in range(1, n_blocks + 1)
+            ],
+        }
+        if cache_path:
+            os.makedirs(cache_dir, exist_ok=True)
+            tmp = cache_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, cache_path)
     build_s = time.perf_counter() - build_t0
 
     # fresh node state: replay what the builder produced
-    state = make_genesis_state(b.genesis)
+    state = make_genesis_state(payload["genesis"])
     store = BlockStore(MemDB())
     state_store = StateStore(MemDB())
     state_store.save(state)
     execu = BlockExecutor(state_store, AppConns(KVStoreApplication()).consensus())
 
+    all_blocks, all_commits = payload["blocks"], payload["commits"]
     verify_s = 0.0
     t0 = time.perf_counter()
     h = 1
@@ -162,9 +206,8 @@ def bench_fastsync_replay(n_blocks: int, n_vals: int, window: int = 64) -> None:
         hi = min(h + window - 1, n_blocks)
         blocks, commits, jobs = [], [], []
         for hh in range(h, hi + 1):
-            block = b.block_store.load_block(hh)
-            commit = (b.block_store.load_block_commit(hh)
-                      or b.block_store.load_seen_commit(hh))
+            block = all_blocks[hh - 1]
+            commit = all_commits[hh - 1]
             blocks.append(block)
             commits.append(commit)
             # validator set is static in this fixture, so the whole
@@ -203,6 +246,7 @@ def bench_fastsync_replay(n_blocks: int, n_vals: int, window: int = 64) -> None:
             "verify_s": round(verify_s, 2),
             "total_s": round(sec, 2),
             "build_s": round(build_s, 1),
+            "chain_cached": cached,
         },
     )
 

@@ -126,6 +126,18 @@ def cmd_start(args) -> int:
     logger = new_logger(level=cfg.base.log_level)
     node = Node(cfg, logger=logger)
 
+    # TM_TPU_PROFILE=<path>: cProfile the whole node process, dumped on
+    # clean shutdown — the measurement tool behind docs/performance.md's
+    # localnet throughput analysis (pstats format; inspect with snakeviz
+    # or pstats.Stats)
+    profile_path = os.environ.get("TM_TPU_PROFILE")
+    prof = None
+    if profile_path:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+
     async def run():
         stop_ev = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -138,7 +150,12 @@ def cmd_start(args) -> int:
         logger.info("shutting down")
         await node.stop()
 
-    asyncio.run(run())
+    try:
+        asyncio.run(run())
+    finally:
+        if prof is not None:
+            prof.disable()
+            prof.dump_stats(profile_path)
     return 0
 
 

@@ -25,6 +25,7 @@ verifier.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 
 # ---------------------------------------------------------------------------
@@ -190,6 +191,21 @@ def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     return pt_equal(q8, IDENTITY)
 
 
+@functools.lru_cache(maxsize=16384)  # > 10k-validator working set; true LRU
+def _evp_pub(pub: bytes):
+    """Parsed libcrypto key objects, cached: consensus re-verifies the
+    same validator pubkeys every height, and EVP_PKEY construction is a
+    measurable fraction of a single verify (r2 BENCH_BASELINE showed the
+    production path ~0.8x a loop with pre-constructed keys).  lru_cache
+    does not cache raised exceptions, so malformed keys are re-tried (and
+    fall through to the reference path in verify_fast)."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+
+    return Ed25519PublicKey.from_public_bytes(pub)
+
+
 def verify_fast(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """ZIP-215-identical verification with a libcrypto fast path.
 
@@ -204,11 +220,7 @@ def verify_fast(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """
     if len(sig) == 64 and len(pub) == 32:
         try:
-            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-                Ed25519PublicKey,
-            )
-
-            Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+            _evp_pub(pub).verify(sig, msg)
             return True
         except Exception:
             pass  # fall through to the permissive reference check

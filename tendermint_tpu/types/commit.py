@@ -24,7 +24,7 @@ from .basic import (
     decode_timestamp,
     encode_timestamp,
 )
-from .canonical import vote_sign_bytes_raw
+from .canonical import _canonical_block_id, vote_sign_bytes_raw
 
 
 @dataclass
@@ -102,18 +102,46 @@ class Commit:
     block_id: BlockID
     signatures: list[CommitSig] = field(default_factory=list)
 
+    def _sign_bytes_templates(self, chain_id: str):
+        """Within one commit the canonical vote bytes differ per signature
+        only by BlockID flavor (COMMIT vs NIL/ABSENT) and timestamp, so
+        fields 1-4 and field 6 are built once and reused.  This runs per
+        signature on every commit-verification surface (fast-sync windows,
+        light ranges, VerifyCommit) — at 200 validators x 10k blocks the
+        per-call ProtoWriter cost dominated replay (BENCH r2: 0.86x).
+        Byte-identity with vote_sign_bytes_raw is differential-tested
+        (tests/test_wire.py)."""
+        tpl = getattr(self, "_sb_tpl", None)
+        if tpl is not None and tpl[0] == chain_id:
+            return tpl[1]
+
+        def prefix(block_id: BlockID) -> bytes:
+            return (
+                ProtoWriter()
+                .varint(1, int(SignedMsgType.PRECOMMIT))
+                .sfixed64(2, self.height)
+                .sfixed64(3, self.round)
+                .message(4, _canonical_block_id(block_id))
+                .bytes_out()
+            )
+
+        out = (
+            prefix(self.block_id),
+            prefix(BlockID()),
+            ProtoWriter().string(6, chain_id).bytes_out(),
+        )
+        self._sb_tpl = (chain_id, out)
+        return out
+
     def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
         """Reconstruct validator idx's canonical precommit bytes
         (reference block.go:815)."""
         cs = self.signatures[idx]
-        return vote_sign_bytes_raw(
-            chain_id,
-            SignedMsgType.PRECOMMIT,
-            self.height,
-            self.round,
-            cs.vote_block_id(self.block_id),
-            cs.timestamp_ns,
-        )
+        pre_block, pre_nil, suffix = self._sign_bytes_templates(chain_id)
+        pre = pre_block if cs.block_id_flag == BlockIDFlag.COMMIT else pre_nil
+        ts = encode_timestamp(cs.timestamp_ns)
+        body = pre + b"\x2a" + encode_uvarint(len(ts)) + ts + suffix
+        return encode_uvarint(len(body)) + body
 
     def hash(self) -> bytes:
         """Merkle root over proto-encoded CommitSigs (reference block.go

@@ -149,6 +149,50 @@ def test_proposer_rotation_weighted():
     assert counts[k2.pub_key().address()] == 10
 
 
+def test_commit_vote_sign_bytes_template_matches_raw():
+    """The per-commit template fast path in Commit.vote_sign_bytes must be
+    byte-identical to vote_sign_bytes_raw for every flag/timestamp mix —
+    these bytes are signature inputs, so a single divergent byte is a
+    consensus failure."""
+    import random
+
+    from tendermint_tpu.types.basic import BlockIDFlag, GO_ZERO_TIME_NS
+    from tendermint_tpu.types.canonical import vote_sign_bytes_raw
+    from tendermint_tpu.types.commit import Commit, CommitSig
+
+    rng = random.Random(77)
+    for case in range(20):
+        block_id = BlockID(
+            hash=bytes([case + 1]) * 32,
+            part_set_header=PartSetHeader(total=rng.randrange(1, 9),
+                                          hash=bytes([case + 2]) * 32),
+        )
+        sigs = []
+        for i in range(12):
+            flag = rng.choice([BlockIDFlag.COMMIT, BlockIDFlag.NIL,
+                               BlockIDFlag.ABSENT])
+            ts = rng.choice([
+                GO_ZERO_TIME_NS,
+                0,
+                1_600_000_000 * 10**9 + rng.randrange(10**12),
+                rng.randrange(1, 10**18),
+            ])
+            sigs.append(CommitSig(block_id_flag=flag,
+                                  validator_address=bytes([i]) * 20,
+                                  timestamp_ns=ts,
+                                  signature=b"s" * 64))
+        commit = Commit(height=rng.randrange(1, 2**40),
+                        round=rng.randrange(0, 100),
+                        block_id=block_id, signatures=sigs)
+        for chain_id in ("chain-a", "x" * 50):
+            for idx, cs in enumerate(sigs):
+                want = vote_sign_bytes_raw(
+                    chain_id, SignedMsgType.PRECOMMIT, commit.height,
+                    commit.round, cs.vote_block_id(block_id), cs.timestamp_ns,
+                )
+                assert commit.vote_sign_bytes(chain_id, idx) == want, (case, idx)
+
+
 def test_validator_encode_omits_empty_address():
     """proto3 omit-empty: field 1 must not be emitted for an empty address
     (possible only on adversarially decoded input), so decode→encode is
