@@ -11,16 +11,24 @@ Pipeline per batch:
   host:   parse sig/pubkey bytes, check s < L (ZIP-215 rule 1), hash
           k = SHA-512(R||A||M) mod L (variable-length messages stay on host);
           ship PACKED 32-byte rows (128 B/signature).
-  device: unpack bytes → bits/nibbles → 17-bit limbs (elementwise, free next
-          to the curve math), then permissive point decompression for A and R
+  device: unpack bytes → bits/nibbles → limbs (elementwise, free next to the
+          curve math), then permissive point decompression for A and R
           (ZIP-215 rule 2 — y >= p accepted, x=0/sign=1 accepted, small order
           accepted), W = [s]B + [k](-A) with radix-16 fixed-base tables for B
           (zero doublings) and a 4-bit windowed ladder for A (63 adds + 252
-          doublings at 4S+4M via the dedicated doubling formula), Q = W - R,
-          and the cofactored check [8]Q == identity (ZIP-215 rule 3).
+          doublings via the dedicated doubling formula), Q = W - R, and the
+          cofactored check [8]Q == identity (ZIP-215 rule 3).
 
 Note: -[k]A is computed as [k](-A), never as [L-k]A — the latter is wrong for
 points with a torsion component (L·A ≠ O), exactly the inputs ZIP-215 admits.
+
+Field backends (TM_TPU_FIELD_IMPL, or the `impl=` argument):
+  * "int64" — 15 limbs × 17 bits in int64 lanes (fe25519.py).  Numerically
+    densest, but TPU VPUs emulate int64; ideal on XLA-CPU.
+  * "f32"   — 51 limbs × 5 bits in f32 lanes (fe25519_f32.py).  Every op is
+    a native float multiply/add/floor — the round-3 TPU datapath redesign.
+The curve/scalar pipeline below is field-agnostic; both backends share it and
+both are differentially tested against the pure ZIP-215 reference.
 
 Static batch sizes: inputs are padded to power-of-two buckets so XLA compiles
 one program per bucket (first call per bucket pays compile; consensus reuses
@@ -31,6 +39,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import os
 
 import numpy as np
 
@@ -39,171 +48,204 @@ import jax.numpy as jnp
 from jax import lax
 
 from tendermint_tpu.crypto import ed25519 as _ref
-from . import fe25519 as fe
-from .fe25519 import Pt
 
 L = _ref.L
 SCALAR_BITS = 253  # s, k < L < 2^253
 
-
-# ---------------------------------------------------------------------------
-# Device program
-# ---------------------------------------------------------------------------
-
-def decompress(y: jnp.ndarray, sign: jnp.ndarray) -> tuple[Pt, jnp.ndarray]:
-    """Permissive (ZIP-215/dalek) decompression.
-
-    y: [..., 15] limbs of the 255-bit y encoding (possibly >= p — arithmetic
-    tolerates unreduced input); sign: [...] in {0,1}.
-    Returns (point, on_curve).
-    """
-    yy = fe.fe_sq(y)
-    u = fe.fe_sub(yy, jnp.asarray(fe.ONE))
-    v = fe.fe_carry(fe.fe_add(fe.fe_mul(yy, jnp.asarray(fe.D_CONST)), jnp.asarray(fe.ONE)))
-    v2 = fe.fe_sq(v)
-    v3 = fe.fe_mul(v2, v)
-    v7 = fe.fe_mul(fe.fe_sq(v3), v)
-    t = fe.fe_pow_p58(fe.fe_mul(u, v7))
-    x = fe.fe_mul(fe.fe_mul(u, v3), t)  # candidate sqrt(u/v)
-    vx2 = fe.fe_mul(v, fe.fe_sq(x))
-    is_pos = fe.fe_eq(vx2, u)
-    is_neg = fe.fe_eq(vx2, fe.fe_carry(fe.fe_neg(fe.fe_canonical(u))))
-    ok = is_pos | is_neg
-    x = jnp.where(is_neg[..., None], fe.fe_mul(x, jnp.asarray(fe.SQRT_M1_CONST)), x)
-    # sign-bit adjustment on the canonical representative; x=0/sign=1 is
-    # accepted and stays 0 mod p (fe_neg(0) = 4p ≡ 0) — dalek semantics.
-    cx = fe.fe_canonical(x)
-    flip = (cx[..., 0] & 1) != sign
-    x = jnp.where(flip[..., None], fe.fe_carry(fe.fe_neg(cx)), cx)
-    yr = fe.fe_canonical(y)
-    return Pt(x, yr, jnp.broadcast_to(jnp.asarray(fe.ONE), yr.shape), fe.fe_mul(x, yr)), ok
-
-
 NWINDOWS = 64  # 253-bit scalars as 64 little-endian radix-16 digits
 
-
-def _select16(digit: jnp.ndarray, tbl: list[Pt]) -> Pt:
-    """tbl[digit] per batch element via a 4-level binary select tree
-    (15 pt_selects — elementwise, no gathers).  Entries may be batch
-    points or broadcastable constants."""
-    cur = list(tbl)
-    for b in range(4):
-        bit = (digit >> b) & 1
-        cur = [fe.pt_select(bit, cur[2 * i + 1], cur[2 * i])
-               for i in range(len(cur) // 2)]
-    return cur[0]
+IMPLS = ("int64", "f32")
 
 
-def _scalarmul_var(digits: jnp.ndarray, neg_a: Pt) -> Pt:
-    """[k](-A) by 4-bit fixed windows: 16-entry per-signature table
-    (14 adds to build), then 63 iterations of 4 doublings + 1 add.
-    vs the bitwise ladder: doublings at 4S+4M instead of unified 9M,
-    and 63 adds instead of 253."""
-    shape = digits.shape[:-1]
-    tbl = [fe.pt_identity(shape), neg_a]
-    for _ in range(14):
-        tbl.append(fe.pt_add(tbl[-1], neg_a))
+def default_impl() -> str:
+    impl = os.environ.get("TM_TPU_FIELD_IMPL", "int64")
+    return impl if impl in IMPLS else "int64"
 
-    def body(i, acc: Pt) -> Pt:
-        d = jnp.take(digits, NWINDOWS - 1 - i, axis=-1)
-        acc = fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(acc))))
-        return fe.pt_add(acc, _select16(d, tbl))
 
-    # seed with the top digit: saves 4 doublings and keeps 63 adds
-    top = _select16(jnp.take(digits, NWINDOWS - 1, axis=-1), tbl)
-    return lax.fori_loop(1, NWINDOWS, body, top)
+def _field(impl: str):
+    if impl == "f32":
+        from . import fe25519_f32 as m
+    else:
+        from . import fe25519 as m
+    return m
 
 
 @functools.cache
-def _fixed_base_tables() -> tuple[jnp.ndarray, ...]:
-    """[j * 16^i]B for i in 0..63, j in 0..15, as four [64, 16, 15] limb
-    tensors (X, Y, Z, T).  ~500KB of constants; [s]B then costs 64 table
-    selects + 63 additions and ZERO doublings (classic fixed-base
-    radix-16, as in ref10's precomputed tables)."""
-    coords = [np.zeros((NWINDOWS, 16, fe.NLIMBS), dtype=np.int64) for _ in range(4)]
+def _base_point_table() -> list[list[tuple[int, int, int, int]]]:
+    """[j * 16^i]B for i in 0..63, j in 0..15 as big-int extended coords —
+    host-side, shared by every field backend's constant encoding."""
+    rows = []
     g = _ref.BASE
-    for i in range(NWINDOWS):
-        for j in range(16):
-            pt = _ref.scalar_mult(j, g)
-            for c in range(4):
-                coords[c][i, j] = fe.limbs_from_int(pt[c])
+    for _i in range(NWINDOWS):
+        rows.append([_ref.scalar_mult(j, g) for j in range(16)])
         g = _ref.scalar_mult(16, g)
-    # numpy, NOT jnp: device constants created inside one jit trace must
-    # not be cached across traces (UnexpectedTracerError); callers convert
-    # per-trace, which XLA folds into program constants anyway
-    return tuple(coords)
+    return rows
 
 
-def _scalarmul_base(digits: jnp.ndarray) -> Pt:
-    """[s]B from the fixed-base tables (no doublings)."""
-    tx, ty, tz, tt = (jnp.asarray(c) for c in _fixed_base_tables())
-    shape = digits.shape[:-1]
+# ---------------------------------------------------------------------------
+# Device program (field-agnostic; fe = the selected limb backend)
+# ---------------------------------------------------------------------------
 
-    def body_dyn(i, acc: Pt) -> Pt:
-        # one dynamic slice per coordinate for the whole 16-entry window
-        # (NOT per table entry — 4 gathers instead of 64)
-        rx, ry, rz, rt = (jnp.take(c, i, axis=0) for c in (tx, ty, tz, tt))
-        row = [Pt(rx[j], ry[j], rz[j], rt[j]) for j in range(16)]
-        sel = _select16(jnp.take(digits, i, axis=-1), row)
-        return fe.pt_add(acc, sel)
+class _Core:
+    """The verify pipeline specialized to one field backend."""
 
-    acc0 = _select16(jnp.take(digits, 0, axis=-1),
-                     [Pt(tx[0, j], ty[0, j], tz[0, j], tt[0, j]) for j in range(16)])
-    # broadcast the (possibly constant-shaped) window-0 point to batch shape
-    acc0 = Pt(*(jnp.broadcast_to(c, shape + (fe.NLIMBS,)) for c in acc0.astuple()))
-    return lax.fori_loop(1, NWINDOWS, body_dyn, acc0)
+    def __init__(self, fe):
+        self.fe = fe
+        self._limb_weights = (1 << np.arange(fe.LIMB_BITS, dtype=np.int64))
+
+    # -- unpacking -----------------------------------------------------------
+
+    @staticmethod
+    def _bits_of(rows: jnp.ndarray) -> jnp.ndarray:
+        """[..., 32] uint8 → [..., 256] bits (LE bit order), on device."""
+        b = (rows[..., :, None].astype(jnp.int32) >> jnp.arange(8, dtype=jnp.int32)) & 1
+        return b.reshape(rows.shape[:-1] + (256,))
+
+    @staticmethod
+    def _nibbles_of(rows: jnp.ndarray) -> jnp.ndarray:
+        """[..., 32] uint8 → [..., 64] little-endian radix-16 digits."""
+        lo = (rows & 15).astype(jnp.int32)
+        hi = (rows >> 4).astype(jnp.int32)
+        return jnp.stack([lo, hi], axis=-1).reshape(rows.shape[:-1] + (64,))
+
+    def _limbs_of(self, bits255: jnp.ndarray) -> jnp.ndarray:
+        """[..., 255] bits → [..., NLIMBS] limbs, on device."""
+        fe = self.fe
+        shaped = bits255.reshape(bits255.shape[:-1] + (fe.NLIMBS, fe.LIMB_BITS))
+        w = jnp.asarray(self._limb_weights, dtype=jnp.asarray(fe.ONE).dtype)
+        return (shaped.astype(w.dtype) * w).sum(-1)
+
+    # -- curve pipeline ------------------------------------------------------
+
+    def decompress(self, y: jnp.ndarray, sign: jnp.ndarray):
+        """Permissive (ZIP-215/dalek) decompression.
+
+        y: [..., NLIMBS] limbs of the 255-bit y encoding (possibly >= p —
+        arithmetic tolerates unreduced input); sign: [...] in {0,1}.
+        Returns (point, on_curve).
+        """
+        fe = self.fe
+        yy = fe.fe_sq(y)
+        u = fe.fe_sub(yy, jnp.asarray(fe.ONE))
+        v = fe.fe_carry(fe.fe_add(fe.fe_mul(yy, jnp.asarray(fe.D_CONST)), jnp.asarray(fe.ONE)))
+        v2 = fe.fe_sq(v)
+        v3 = fe.fe_mul(v2, v)
+        v7 = fe.fe_mul(fe.fe_sq(v3), v)
+        t = fe.fe_pow_p58(fe.fe_mul(u, v7))
+        x = fe.fe_mul(fe.fe_mul(u, v3), t)  # candidate sqrt(u/v)
+        vx2 = fe.fe_mul(v, fe.fe_sq(x))
+        is_pos = fe.fe_eq(vx2, u)
+        is_neg = fe.fe_eq(vx2, fe.fe_carry(fe.fe_neg(fe.fe_canonical(u))))
+        ok = is_pos | is_neg
+        x = jnp.where(is_neg[..., None], fe.fe_mul(x, jnp.asarray(fe.SQRT_M1_CONST)), x)
+        # sign-bit adjustment on the canonical representative; x=0/sign=1 is
+        # accepted and stays 0 mod p — dalek semantics.
+        cx = fe.fe_canonical(x)
+        parity = cx[..., 0].astype(jnp.int32) & 1
+        flip = parity != sign
+        x = jnp.where(flip[..., None], fe.fe_carry(fe.fe_neg(cx)), cx)
+        yr = fe.fe_canonical(y)
+        return fe.Pt(x, yr, jnp.broadcast_to(jnp.asarray(fe.ONE), yr.shape), fe.fe_mul(x, yr)), ok
+
+    def _select16(self, digit: jnp.ndarray, tbl: list):
+        """tbl[digit] per batch element via a 4-level binary select tree
+        (15 pt_selects — elementwise, no gathers)."""
+        fe = self.fe
+        cur = list(tbl)
+        for b in range(4):
+            bit = (digit >> b) & 1
+            cur = [fe.pt_select(bit, cur[2 * i + 1], cur[2 * i])
+                   for i in range(len(cur) // 2)]
+        return cur[0]
+
+    def _scalarmul_var(self, digits: jnp.ndarray, neg_a):
+        """[k](-A) by 4-bit fixed windows: 16-entry per-signature table
+        (14 adds to build), then 63 iterations of 4 doublings + 1 add."""
+        fe = self.fe
+        shape = digits.shape[:-1]
+        tbl = [fe.pt_identity(shape), neg_a]
+        for _ in range(14):
+            tbl.append(fe.pt_add(tbl[-1], neg_a))
+
+        def body(i, acc):
+            d = jnp.take(digits, NWINDOWS - 1 - i, axis=-1)
+            acc = fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(acc))))
+            return fe.pt_add(acc, self._select16(d, tbl))
+
+        top = self._select16(jnp.take(digits, NWINDOWS - 1, axis=-1), tbl)
+        return lax.fori_loop(1, NWINDOWS, body, top)
+
+    @functools.cached_property
+    def _fixed_base_tables(self) -> tuple[np.ndarray, ...]:
+        """The shared big-int table encoded as four [64, 16, NLIMBS] limb
+        tensors (X, Y, Z, T) in this backend's limb dtype.  numpy, NOT jnp:
+        device constants created inside one jit trace must not be cached
+        across traces; callers convert per-trace (XLA folds them into
+        program constants)."""
+        fe = self.fe
+        dtype = np.asarray(fe.ONE).dtype
+        coords = [np.zeros((NWINDOWS, 16, fe.NLIMBS), dtype=dtype) for _ in range(4)]
+        for i, row in enumerate(_base_point_table()):
+            for j, pt in enumerate(row):
+                for c in range(4):
+                    coords[c][i, j] = fe.limbs_from_int(pt[c])
+        return tuple(coords)
+
+    def _scalarmul_base(self, digits: jnp.ndarray):
+        """[s]B from the fixed-base tables (no doublings)."""
+        fe = self.fe
+        tx, ty, tz, tt = (jnp.asarray(c) for c in self._fixed_base_tables)
+        shape = digits.shape[:-1]
+
+        def body_dyn(i, acc):
+            rx, ry, rz, rt = (jnp.take(c, i, axis=0) for c in (tx, ty, tz, tt))
+            row = [fe.Pt(rx[j], ry[j], rz[j], rt[j]) for j in range(16)]
+            sel = self._select16(jnp.take(digits, i, axis=-1), row)
+            return fe.pt_add(acc, sel)
+
+        acc0 = self._select16(
+            jnp.take(digits, 0, axis=-1),
+            [fe.Pt(tx[0, j], ty[0, j], tz[0, j], tt[0, j]) for j in range(16)],
+        )
+        acc0 = fe.Pt(*(jnp.broadcast_to(c, shape + (fe.NLIMBS,)) for c in acc0.astuple()))
+        return lax.fori_loop(1, NWINDOWS, body_dyn, acc0)
+
+    def verify_core(self, pub_rows, r_rows, s_rows, k_rows, valid):
+        """Inputs are PACKED byte rows ([N,32] uint8 each) — unpacking to
+        bits/limbs happens on device, so the host→device transfer is 128
+        bytes/signature instead of ~2.3KB of pre-expanded tensors."""
+        fe = self.fe
+        pub_bits = self._bits_of(pub_rows)
+        r_bits = self._bits_of(r_rows)
+        y_a, sign_a = self._limbs_of(pub_bits[..., :255]), pub_bits[..., 255]
+        y_r, sign_r = self._limbs_of(r_bits[..., :255]), r_bits[..., 255]
+        s_digits = self._nibbles_of(s_rows)
+        k_digits = self._nibbles_of(k_rows)
+        a_pt, ok_a = self.decompress(y_a, sign_a)
+        r_pt, ok_r = self.decompress(y_r, sign_r)
+        w = fe.pt_add(self._scalarmul_base(s_digits),
+                      self._scalarmul_var(k_digits, fe.pt_neg(a_pt)))
+        q = fe.pt_add(w, fe.pt_neg(r_pt))
+        q8 = fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(q)))
+        return valid & ok_a & ok_r & fe.pt_is_identity(q8)
 
 
-def _shamir(s_digits: jnp.ndarray, k_digits: jnp.ndarray, neg_a: Pt) -> Pt:
-    """W = [s]B + [k](-A): fixed-base tables for B, windowed ladder for A."""
-    return fe.pt_add(_scalarmul_base(s_digits), _scalarmul_var(k_digits, neg_a))
-
-
-def _bits_of(rows: jnp.ndarray) -> jnp.ndarray:
-    """[..., 32] uint8 → [..., 256] bits (LE bit order), on device."""
-    b = (rows[..., :, None].astype(jnp.int32) >> jnp.arange(8, dtype=jnp.int32)) & 1
-    return b.reshape(rows.shape[:-1] + (256,))
-
-
-def _nibbles_of(rows: jnp.ndarray) -> jnp.ndarray:
-    """[..., 32] uint8 → [..., 64] little-endian radix-16 digits."""
-    lo = (rows & 15).astype(jnp.int32)
-    hi = (rows >> 4).astype(jnp.int32)
-    return jnp.stack([lo, hi], axis=-1).reshape(rows.shape[:-1] + (64,))
-
-
-_LIMB_WEIGHTS = (1 << np.arange(fe.LIMB_BITS, dtype=np.int64))
-
-
-def _limbs_of(bits255: jnp.ndarray) -> jnp.ndarray:
-    """[..., 255] bits → [..., 15] int64 limbs (17 bits each), on device."""
-    shaped = bits255.reshape(bits255.shape[:-1] + (fe.NLIMBS, fe.LIMB_BITS))
-    return (shaped.astype(jnp.int64) * jnp.asarray(_LIMB_WEIGHTS)).sum(-1)
+@functools.cache
+def _core(impl: str) -> _Core:
+    return _Core(_field(impl))
 
 
 def _verify_core(pub_rows, r_rows, s_rows, k_rows, valid):
-    """Inputs are PACKED byte rows ([N,32] uint8 each) — unpacking to
-    bits/limbs happens on device, so the host→device transfer is 128
-    bytes/signature instead of ~2.3KB of pre-expanded tensors (a ~16x
-    cut; on hosts where the TPU sits across a network tunnel the
-    transfer, not the math, is the bottleneck)."""
-    pub_bits = _bits_of(pub_rows)
-    r_bits = _bits_of(r_rows)
-    y_a, sign_a = _limbs_of(pub_bits[..., :255]), pub_bits[..., 255]
-    y_r, sign_r = _limbs_of(r_bits[..., :255]), r_bits[..., 255]
-    s_digits = _nibbles_of(s_rows)
-    k_digits = _nibbles_of(k_rows)
-    a_pt, ok_a = decompress(y_a, sign_a)
-    r_pt, ok_r = decompress(y_r, sign_r)
-    w = _shamir(s_digits, k_digits, fe.pt_neg(a_pt))
-    q = fe.pt_add(w, fe.pt_neg(r_pt))
-    q8 = fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(q)))
-    return valid & ok_a & ok_r & fe.pt_is_identity(q8)
+    """Default-impl core — the traceable entrypoint parallel/sharding jits."""
+    return _core(default_impl()).verify_core(pub_rows, r_rows, s_rows, k_rows, valid)
 
 
 @functools.cache
-def _compiled(n: int):
-    return jax.jit(_verify_core)
+def _compiled(n: int, impl: str | None = None):
+    # NOTE: callers that care about TM_TPU_FIELD_IMPL changing mid-process
+    # must resolve the impl themselves (verify_batch does); this default
+    # resolves once per (n, None) cache entry.
+    return jax.jit(_core(impl or default_impl()).verify_core)
 
 
 # ---------------------------------------------------------------------------
@@ -281,7 +323,7 @@ def _bucket(n: int) -> int:
     return b
 
 
-def verify_batch(pubs, msgs, sigs) -> np.ndarray:
+def verify_batch(pubs, msgs, sigs, impl: str | None = None) -> np.ndarray:
     """ZIP-215 verification of the whole batch in one device call.
 
     Returns bool[N].  Inputs are bytes-like sequences of equal length N.
@@ -289,6 +331,10 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
     n = len(pubs)
     if n == 0:
         return np.zeros(0, dtype=bool)
+    # resolve the env default BEFORE the jit cache key so a later change
+    # to TM_TPU_FIELD_IMPL is honored (and impl=None vs impl="int64"
+    # share one compiled program per bucket)
+    impl = impl or default_impl()
     pub_rows, r_rows, s_rows, k_rows, valid = prepare_batch(pubs, msgs, sigs)
     b = _bucket(n)
     if b != n:
@@ -300,5 +346,5 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
         pub_rows, r_rows = p2(pub_rows), p2(r_rows)
         s_rows, k_rows = p2(s_rows), p2(k_rows)
         valid = np.pad(valid, (0, pad))
-    ok = _compiled(b)(pub_rows, r_rows, s_rows, k_rows, valid)
+    ok = _compiled(b, impl)(pub_rows, r_rows, s_rows, k_rows, valid)
     return np.asarray(ok)[:n]
