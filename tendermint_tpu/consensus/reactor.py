@@ -222,6 +222,15 @@ class ConsensusReactor:
     # receive loops
     # ------------------------------------------------------------------
 
+    def _load_commit(self, height: int):
+        """Reference cs.LoadCommit: canonical commit with the seen-commit
+        fallback at the store tip.  Without the fallback, a peer exactly
+        one height ahead — the byzantine-wedge shape, where the advanced
+        pair can't produce block H+1 precisely because the lagging pair
+        is stuck at H — can never advertise the commit's maj23 or serve
+        catchup commits, and the wedge is permanent."""
+        return self.block_store.load_commit(height)
+
     def _nvals(self, height: int) -> int:
         rs = self.cs.rs
         if rs.validators is not None and height == rs.height:
@@ -352,7 +361,7 @@ class ConsensusReactor:
             ):
                 # we're past that height: the canonical commit is our vote
                 # source for it (pairs with the lagging-peer maj23 case)
-                commit = self.block_store.load_block_commit(msg.height)
+                commit = self._load_commit(msg.height)
                 if (
                     commit is not None
                     and commit.round == msg.round
@@ -505,7 +514,7 @@ class ConsensusReactor:
             and rs.height >= prs.height + 2
             and prs.height >= self.block_store.base()
         ):
-            commit = self.block_store.load_block_commit(prs.height)
+            commit = self._load_commit(prs.height)
             if commit is not None:
                 # _pick_send_vote registers the catchup-commit round itself
                 # for every commit-bearing source
@@ -646,7 +655,7 @@ class ConsensusReactor:
                     and prs.height <= self.block_store.height()
                     and prs.height >= self.block_store.base()
                 ):
-                    commit = self.block_store.load_block_commit(prs.height)
+                    commit = self._load_commit(prs.height)
                     if commit is not None:
                         self.state_ch.try_send(
                             Envelope(

@@ -95,9 +95,7 @@ def _signed_header_at(block_store, height: int):
     meta = block_store.load_block_meta(height)
     if meta is None:
         raise ValueError(f"no header at height {height}")
-    commit = block_store.load_block_commit(height) or block_store.load_seen_commit(
-        height
-    )
+    commit = block_store.load_commit(height) or block_store.load_seen_commit(height)
     if commit is None:
         raise ValueError(f"no commit at height {height}")
     return SignedHeader(header=meta.header, commit=commit)

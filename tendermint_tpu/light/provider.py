@@ -81,9 +81,7 @@ class NodeBackedProvider:
         if height <= 0:
             height = self.block_store.height()
         meta = self.block_store.load_block_meta(height)
-        commit = self.block_store.load_seen_commit(height) if (
-            height == self.block_store.height()
-        ) else self.block_store.load_block_commit(height)
+        commit = self.block_store.load_commit(height)
         if meta is None or commit is None:
             raise ErrLightBlockNotFound(f"no block at height {height}")
         vals = self.state_store.load_validators(height)

@@ -122,6 +122,17 @@ class BlockStore:
         raw = self._db.get(_h(_SEEN, height))
         return Commit.decode(raw) if raw is not None else None
 
+    def load_commit(self, height: int) -> Commit | None:
+        """Canonical commit with the SEEN-commit fallback at the store
+        tip (reference cs.LoadCommit, consensus/state.go): the canonical
+        commit for the tip block ships inside block height+1, which
+        doesn't exist yet.  The single home of this invariant — used by
+        the consensus reactor's wedge-recovery chain, the light provider,
+        and evidence verification."""
+        if height == self.height():
+            return self.load_seen_commit(height)
+        return self.load_block_commit(height)
+
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         self._db.set(_h(_SEEN, height), commit.encode())
 

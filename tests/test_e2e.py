@@ -360,23 +360,19 @@ def test_maverick_amnesia_net_stays_safe():
     asyncio.run(run())
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="KNOWN liveness gap (ROUND2_NOTES.md): a double-precommit at a "
-    "commit-deciding round can still wedge a timing window; round-2 added "
-    "the reference's maj23 recovery loop (catchup-commit bitmaps, "
-    "canonical-commit maj23 to lagging peers, replace-semantics "
-    "VoteSetBits), which fixed the deterministic wedge, but some timings "
-    "still stall — carried to round 3",
-)
 def test_byzantine_precommit_with_kill_does_not_wedge(tmp_path):
-    """Liveness regression probe: a double-precommit at a commit-deciding
-    round made nodes that saw the evil precommit first reject the
-    equivocator's honest one as conflicting — leaving them one vote short
-    of +2/3 while the others advanced; the net wedges at a
-    [H, H+1, H+1, H] height split.  The round-2 maj23 recovery loop
-    (see reactor.py) recovers many of these; the remaining window is a
-    documented known issue."""
+    """Liveness regression GATE (strict since round 3): a double-precommit
+    at a commit-deciding round made nodes that saw the evil precommit
+    first reject the equivocator's honest one as conflicting — one vote
+    short of +2/3 while the others advanced; the net wedged at a
+    [H, H+1, H+1, H] height split.  Root cause (round 3): the advanced
+    pair, exactly one height ahead and unable to produce block H+1, has
+    no canonical block-commit for H — only the SEEN commit — and the
+    maj23/catchup/bits recovery chain gated on the bare block-commit
+    load, so the majority advertisement that unlocks conflict admission
+    was never sent.  Fixed by the reference's cs.LoadCommit seen-commit
+    fallback (reactor._load_commit); validated 26/26 in
+    tests/wedge_repro.py loops (20 on a quiet box, 6 under heavy load)."""
 
     async def run():
         net = Testnet(

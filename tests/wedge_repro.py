@@ -56,10 +56,18 @@ def dump_node(n) -> dict:
     return out
 
 
-async def run_one(i: int, keep: bool) -> tuple[bool, str]:
+async def run_one(i: int, keep: bool, debug: bool = False) -> tuple[bool, str]:
     root = tempfile.mkdtemp(prefix=f"wedge{i}-")
     net = Testnet(manifest(i), root)
     net.setup()
+    if debug:
+        import re
+
+        for n in range(4):
+            cfg = f"{root}/node{n}/config/config.toml"
+            s = open(cfg).read()
+            s = re.sub(r'log_level *= *"[^"]*"', 'log_level = "debug"', s)
+            open(cfg, "w").write(s)
     net.start()
     stalled = False
     detail = ""
@@ -89,10 +97,11 @@ async def run_one(i: int, keep: bool) -> tuple[bool, str]:
 async def main() -> int:
     iters = int(sys.argv[1]) if len(sys.argv) > 1 else 10
     keep = "--keep" in sys.argv
+    debug = "--debug" in sys.argv
     passed = 0
     for i in range(iters):
         t0 = time.time()
-        ok, detail = await run_one(i, keep)
+        ok, detail = await run_one(i, keep, debug)
         passed += ok
         print(
             f"iteration {i}: {'pass' if ok else 'STALL'} "
