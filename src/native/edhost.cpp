@@ -247,7 +247,76 @@ static void batch_range(size_t lo, size_t hi, const uint8_t* r32,
   }
 }
 
+// ---------------------------------------------------------------------------
+// batched canonical sign-bytes assembly
+//
+// Within one commit the canonical precommit bytes differ per signature
+// only by BlockID flavor (COMMIT vs NIL/ABSENT prefix) and timestamp,
+// so the Python layer ships the two prefix templates + the chain-id
+// suffix once and this kernel emits every delimited row.  The Python
+// template fast path still costs ~4 us/row (40 ms for a 10k commit —
+// 20x the BASELINE 2 ms target); this is ~40 ns/row.
+// Byte-identity contract: google.protobuf.Timestamp{seconds=1,nanos=2}
+// with omit-if-zero fields (types/basic.py encode_timestamp), field 5
+// tag 0x2a, outer varint length delimiter (canonical.py
+// vote_sign_bytes_raw) — differential-tested from Python.
+// ---------------------------------------------------------------------------
+
+static inline int put_uvarint(uint8_t* p, uint64_t v) {
+  int i = 0;
+  while (v >= 0x80) {
+    p[i++] = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  p[i++] = (uint8_t)v;
+  return i;
+}
+
 extern "C" {
+
+// Returns total bytes written, or 0 when `cap` is insufficient (callers
+// size cap = n * (max_prefix + suffix + 30) which always suffices).
+// flags[i] != 0 selects the block prefix, else the nil prefix.
+uint64_t tmed_batch_sign_bytes(
+    uint64_t n, const uint8_t* prefix_block, uint64_t pb_len,
+    const uint8_t* prefix_nil, uint64_t pn_len, const uint8_t* suffix,
+    uint64_t suf_len, const uint8_t* flags, const int64_t* ts_sec,
+    const int32_t* ts_nanos, uint8_t* out, uint64_t cap,
+    uint64_t* offsets) {
+  // seconds/nanos are pre-split by the caller (Python divmod is exact
+  // for timestamps beyond int64-nanosecond range, e.g. Go's zero time)
+  uint64_t pos = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    int64_t s = ts_sec[i];
+    int64_t nan = ts_nanos[i];
+    uint8_t ts[24];
+    int tlen = 0;
+    if (s != 0) {
+      ts[tlen++] = 0x08;
+      tlen += put_uvarint(ts + tlen, (uint64_t)s);  // two's-complement
+    }
+    if (nan != 0) {
+      ts[tlen++] = 0x10;
+      tlen += put_uvarint(ts + tlen, (uint64_t)nan);
+    }
+    const uint8_t* pre = flags[i] ? prefix_block : prefix_nil;
+    uint64_t plen = flags[i] ? pb_len : pn_len;
+    uint64_t body = plen + 1 + 1 + (uint64_t)tlen + suf_len;  // 0x2a len ts
+    if (pos + body + 10 > cap) return 0;
+    offsets[i] = pos;
+    pos += (uint64_t)put_uvarint(out + pos, body);
+    memcpy(out + pos, pre, plen);
+    pos += plen;
+    out[pos++] = 0x2a;
+    out[pos++] = (uint8_t)tlen;  // tlen <= 23 < 0x80: single-byte varint
+    memcpy(out + pos, ts, (size_t)tlen);
+    pos += (uint64_t)tlen;
+    memcpy(out + pos, suffix, suf_len);
+    pos += suf_len;
+  }
+  offsets[n] = pos;
+  return pos;
+}
 
 void tmed_sha512(const uint8_t* data, uint64_t len, uint8_t out[64]) {
   Sha512 s;

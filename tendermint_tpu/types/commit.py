@@ -143,6 +143,31 @@ class Commit:
         body = pre + b"\x2a" + encode_uvarint(len(ts)) + ts + suffix
         return encode_uvarint(len(body)) + body
 
+    def vote_sign_bytes_batch(self, chain_id: str, idxs) -> list[bytes]:
+        """Every selected validator's canonical precommit bytes, assembled
+        by the native kernel in one C call when available (the per-row
+        Python path costs ~4 µs — 40 ms for a 10k commit, 20× the
+        BASELINE 2 ms end-to-end target).  Byte-identical to
+        vote_sign_bytes per index (differential-tested)."""
+        idxs = list(idxs)
+        if len(idxs) >= 64:
+            from tendermint_tpu.crypto import signbytes_native
+
+            pre_block, pre_nil, suffix = self._sign_bytes_templates(chain_id)
+            sigs = self.signatures
+            flags = [sigs[i].block_id_flag == BlockIDFlag.COMMIT for i in idxs]
+            ts = [sigs[i].timestamp_ns for i in idxs]
+            packed = signbytes_native.batch_sign_bytes(
+                pre_block, pre_nil, suffix, flags, ts
+            )
+            if packed is not None:
+                buf, offsets = packed
+                return [
+                    buf[int(offsets[j]):int(offsets[j + 1])]
+                    for j in range(len(idxs))
+                ]
+        return [self.vote_sign_bytes(chain_id, i) for i in idxs]
+
     def hash(self) -> bytes:
         """Merkle root over proto-encoded CommitSigs (reference block.go
         Commit.Hash)."""

@@ -478,7 +478,10 @@ def batch_verify_commits(jobs: list[CommitVerifyJob]) -> None:
         vs, commit = job.val_set, job.commit
         vs._check_commit_basics(job.chain_id, job.block_id, job.height, commit)
         needed = vs.total_voting_power() * 2 // 3
-        entries = []
+        # select indices first, then assemble all sign-bytes in one
+        # native call (the per-row Python path is ~4 µs — 40 ms on a 10k
+        # commit, 20x the BASELINE end-to-end budget)
+        sel = []
         running = 0
         for idx, cs in enumerate(commit.signatures):
             if job.mode == "light":
@@ -486,14 +489,18 @@ def batch_verify_commits(jobs: list[CommitVerifyJob]) -> None:
                     continue
             elif cs.absent():
                 continue
-            val = vs.validators[idx]
-            bv.add(val.pub_key, commit.vote_sign_bytes(job.chain_id, idx), cs.signature)
-            entries.append((n, idx, val.voting_power))
-            n += 1
+            sel.append(idx)
             if job.mode == "light":
-                running += val.voting_power
+                running += vs.validators[idx].voting_power
                 if running > needed:
                     break
+        msgs = commit.vote_sign_bytes_batch(job.chain_id, sel)
+        entries = []
+        for idx, msg in zip(sel, msgs):
+            val = vs.validators[idx]
+            bv.add(val.pub_key, msg, commit.signatures[idx].signature)
+            entries.append((n, idx, val.voting_power))
+            n += 1
         plans.append((job, entries, needed))
     _, oks = bv.verify() if n else (True, [])
     for job, entries, needed in plans:

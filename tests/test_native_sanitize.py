@@ -142,3 +142,67 @@ def test_native_engine_concurrent_stress_plain(tmp_path):
     for k, v in db2.iterate(b""):
         assert k and v
     db2.close()
+
+
+SIGNBYTES_STRESS = r"""
+import random, sys
+import tendermint_tpu.crypto.signbytes_native as sbn
+sbn._LIB_NAME = "libedhost_asan.so"
+from tendermint_tpu.types.basic import BlockID, BlockIDFlag, GO_ZERO_TIME_NS, PartSetHeader
+from tendermint_tpu.types.commit import Commit, CommitSig
+
+assert sbn._load() is not None, "sanitized kernel must load — a silent "\
+    "fallback to the Python path would pass this test without ever "\
+    "executing C under ASan"
+
+rng = random.Random(5)
+for case in range(8):
+    n = rng.choice([64, 101, 500])
+    sigs = []
+    for i in range(n):
+        ts = rng.choice([GO_ZERO_TIME_NS, 0, 1, -1, 10**9 - 1,
+                         rng.randrange(-10**18, 10**18)])
+        sigs.append(CommitSig(
+            block_id_flag=rng.choice([BlockIDFlag.COMMIT, BlockIDFlag.NIL]),
+            validator_address=bytes([i % 256]) * 20,
+            timestamp_ns=ts, signature=b"s" * 64))
+    commit = Commit(height=rng.randrange(1, 2**62), round=rng.randrange(0, 2**31 - 1),
+                    block_id=BlockID(hash=bytes([case]) * 32,
+                                     part_set_header=PartSetHeader(total=1, hash=bytes([case + 1]) * 32)),
+                    signatures=sigs)
+    chain = "x" * rng.choice([1, 49, 200])
+    got = commit.vote_sign_bytes_batch(chain, range(n))
+    want = [commit.vote_sign_bytes(chain, i) for i in range(n)]
+    assert got == want, case
+print("SIGNBYTES-OK")
+"""
+
+
+@pytest.mark.slow
+def test_signbytes_kernel_under_asan(tmp_path):
+    """tmed_batch_sign_bytes under ASan+UBSan: adversarial timestamps
+    (Go zero time, negatives, nanos boundaries), both BlockID flavors,
+    odd batch sizes, long chain IDs — byte-identity asserted against the
+    Python path inside the sanitized process."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    asan = _libasan()
+    if asan is None:
+        pytest.skip("libasan not found")
+    build = subprocess.run(["make", "-C", SRC, "asan"],
+                           capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr
+
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = asan
+    env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", SIGNBYTES_STRESS],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(SRC.rstrip(os.sep).rsplit(os.sep, 1)[0]),
+    )
+    assert proc.returncode == 0, f"stderr:\n{proc.stderr[-3000:]}"
+    assert "SIGNBYTES-OK" in proc.stdout
+    for marker in ("ERROR: AddressSanitizer", "runtime error:"):
+        assert marker not in proc.stderr, proc.stderr[-3000:]
