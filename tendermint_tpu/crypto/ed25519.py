@@ -238,7 +238,16 @@ def verify_batch_reference(pubs, msgs, sigs) -> list[bool]:
 def verify_batch_fast(pubs, msgs, sigs) -> list[bool]:
     """Sequential host verification via `verify_fast` — the production
     CPU path (small batches, device unavailable).  Bit-identical verdicts
-    to `verify_batch_reference`."""
+    to `verify_batch_reference`.
+
+    Deliberately NOT thread-pooled: the installed cryptography binding
+    HOLDS the GIL through Ed25519 verify (empirically confirmed via a
+    switch-interval starvation test — 50 verifies completed alongside a
+    greedy spinner with a 2 s switch interval, impossible if the GIL were
+    released), so Python threads give 0x parallelism here and a pool is
+    pure overhead on the consensus verify path.  Multi-core CPU scaling
+    would need a GIL-releasing binding or a process pool; the framework's
+    actual scaling axis is the device batch path."""
     return [verify_fast(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
 
 
