@@ -371,14 +371,18 @@ class ValidatorSet:
                     f"double vote from validator {val_idx} ({seen[val_idx]} and {idx})"
                 )
             seen[val_idx] = idx
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
-            entries.append((idx, val.voting_power))
+            entries.append((idx, val, val.voting_power))
             running += val.voting_power
             if running > needed:
                 break
+        # assemble all selected sign-bytes in one (native) call, same as
+        # batch_verify_commits
+        msgs = commit.vote_sign_bytes_batch(chain_id, [e[0] for e in entries])
+        for (idx, val, _power), msg in zip(entries, msgs):
+            bv.add(val.pub_key, msg, commit.signatures[idx].signature)
         _, oks = bv.verify()
         tallied = 0
-        for ok, (idx, power) in zip(oks, entries):
+        for ok, (idx, _val, power) in zip(oks, entries):
             if not ok:
                 raise ValueError(f"wrong signature (#{idx})")
             tallied += power
