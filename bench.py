@@ -181,11 +181,9 @@ def main() -> None:
             # CPU compile (and recompiles after transient TPU failures)
             import jax
 
-            jax.config.update(
-                "jax_compilation_cache_dir",
-                os.environ.get("TM_BENCH_CACHE", "/tmp/tm_tpu_jax_cache"),
-            )
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            from tendermint_tpu.utils import jaxcache
+
+            jaxcache.enable(jax)
         except Exception:
             pass
         platform, devs = _init_backend()
@@ -220,6 +218,30 @@ def main() -> None:
         msgs = [b"block-commit-sig-%d" % i for i in range(N)]
         sigs = [s.sign(m) for s, m in zip(signers, msgs)]
 
+        # Same-moment baseline sampler (VERDICT r3 weak #1 / item 2): the
+        # r3 driver artifact read 0.798x because the sequential baseline
+        # was sampled ONCE, AFTER the timed runs, on a 1-core box whose
+        # cpu-steal drifts >2x between moments.  Baseline and production
+        # runs are now interleaved A/B/A/B and the ratio is the median of
+        # per-pair ratios — the fix already proven in
+        # benchmarks/baseline_suite.py and tests/test_replay_ratio.py.
+        baseline_pub_objs = [
+            Ed25519PublicKey.from_public_bytes(p) for p in pubs[:BASELINE_SAMPLE]
+        ]
+
+        def run_baseline() -> float:
+            """One sequential-verify pass; returns sigs/s at this moment."""
+            t0 = time.perf_counter()
+            for po, m, s in zip(baseline_pub_objs, msgs, sigs):
+                po.verify(s, m)
+            return len(baseline_pub_objs) / (time.perf_counter() - t0)
+
+        run_baseline()  # warm
+
+        # (production sigs/s, same-moment baseline sigs/s) pairs for the
+        # path that carries the headline
+        headline_pairs: list = []
+
         if platform == "cpu":
             _stage_set("timed-production-cpu")
             from tendermint_tpu.crypto.batch import new_batch_verifier
@@ -235,7 +257,12 @@ def main() -> None:
                 return dt
 
             run_production(64)  # warm the libcrypto binding
-            times = [run_production(N) for _ in range(3)]
+            times = []
+            for _ in range(3):
+                base_rate = run_baseline()
+                dt = run_production(N)
+                times.append(dt)
+                headline_pairs.append((N / dt, base_rate))
             ours = N / statistics.median(times)
             _partial.update({"value": round(ours, 1), "n": N,
                              "production_path": "libcrypto-batch"})
@@ -295,10 +322,14 @@ def main() -> None:
 
                     _stage_set(f"timed-throughput-{impl}")
                     times = []
+                    impl_pairs = []
                     for _ in range(TIMED_RUNS):
+                        base_rate = run_baseline()
                         t0 = time.perf_counter()
                         ok = dev.verify_batch(pubs, msgs, sigs, impl=impl)
-                        times.append(time.perf_counter() - t0)
+                        dt = time.perf_counter() - t0
+                        times.append(dt)
+                        impl_pairs.append((N / dt, base_rate))
                         assert ok.all()
                     rate = N / statistics.median(times)
                     _partial[f"field_impl_{impl}_sigs_per_sec"] = round(rate, 1)
@@ -318,6 +349,7 @@ def main() -> None:
                     if rate > ours:
                         ours = rate
                         p50_ms = impl_p50
+                        headline_pairs = impl_pairs
                         _partial.update(
                             {"value": round(ours, 1), "n": N, "field_impl": impl}
                         )
@@ -331,22 +363,21 @@ def main() -> None:
             lat_key = "commit10k_p50_ms" if cn == COMMIT_N else f"commit{cn}_p50_ms"
             _partial[lat_key] = round(p50_ms, 3)
 
-        _stage_set("baseline-cpu")
-        pub_objs = [Ed25519PublicKey.from_public_bytes(p) for p in pubs[:BASELINE_SAMPLE]]
-        t0 = time.perf_counter()
-        for po, m, s in zip(pub_objs, msgs, sigs):
-            po.verify(s, m)
-        base = len(pub_objs) / (time.perf_counter() - t0)
+        _stage_set("pair-median")
+        assert headline_pairs, "headline path recorded no (prod, baseline) pairs"
+        base = statistics.median(b for _p, b in headline_pairs)
+        vs_baseline = statistics.median(p / b for p, b in headline_pairs)
 
         out = {
             "metric": "ed25519_sig_verifies_per_sec",
             "value": round(ours, 1),
             "unit": "sigs/s",
-            "vs_baseline": round(ours / base, 3),
+            "vs_baseline": round(vs_baseline, 3),
             lat_key: _partial[lat_key],
             "backend": platform,
             "n": N,
             "baseline_sigs_per_sec": round(base, 1),
+            "baseline_sampling": "interleaved-pair-median",
         }
         for k, v in _partial.items():
             out.setdefault(k, v)

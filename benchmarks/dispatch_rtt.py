@@ -72,7 +72,9 @@ def main() -> int:
     ap.add_argument("--impl", default=None, choices=[None, "int64", "f32"])
     args = ap.parse_args()
 
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tm_tpu_jax_cache")
+    from tendermint_tpu.utils.jaxcache import cache_dir
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
     import jax
 
     jax.config.update("jax_platforms", args.platform)

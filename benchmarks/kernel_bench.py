@@ -38,10 +38,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _force_platform(platform: str) -> None:
+    from tendermint_tpu.utils.jaxcache import cache_dir
+
     os.environ.setdefault("JAX_PLATFORMS", platform)
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR", "/tmp/tm_tpu_jax_cache"
-    )
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
     import jax
 
     jax.config.update("jax_platforms", platform)

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Round-4 TPU tunnel probe daemon.
+
+VERDICT r3 item 1: "make bench.py probe more aggressively ... retry
+across the session, log every probe outcome to a file committed with
+the round, and run benchmarks/kernel_bench.py --all the moment a probe
+succeeds".
+
+This daemon loops for TM_PROBE_BUDGET_S seconds (default 11 h):
+  - every TM_PROBE_INTERVAL_S (default 900 s) it probes the default
+    JAX platform (the axon TPU tunnel) in a SUBPROCESS with a timeout
+    (a hung tunnel blocks jax.devices() indefinitely and poisons the
+    in-process xla_bridge lock — see bench.py._probe_platform).
+  - every outcome is appended as a JSON line to
+    benchmarks/tpu_probe_r04.log (the committed evidence artifact).
+  - on the FIRST success it runs, in order, each with its own timeout:
+      1. benchmarks/kernel_bench.py --all   -> benchmarks/tpu_kernel_r04.json
+      2. benchmarks/dispatch_rtt.py         -> benchmarks/tpu_rtt_r04.json
+      3. python bench.py (TM_BENCH_BACKENDS=<auto>) -> benchmarks/tpu_bench_r04.json
+    then exits 0.  If the budget expires with no success, exits 3.
+
+Run it detached:  python benchmarks/tpu_probe_loop.py &
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+LOG = os.path.join(HERE, "tpu_probe_r04.log")
+
+BUDGET_S = float(os.environ.get("TM_PROBE_BUDGET_S", str(11 * 3600)))
+INTERVAL_S = float(os.environ.get("TM_PROBE_INTERVAL_S", "900"))
+PROBE_TIMEOUT_S = float(os.environ.get("TM_PROBE_TIMEOUT_S", "150"))
+
+
+def log(obj: dict) -> None:
+    obj["t"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+    print(json.dumps(obj), flush=True)
+
+
+def probe() -> tuple[bool, str]:
+    code = (
+        "import jax\n"
+        "x = jax.jit(lambda v: v * 2 + 1)(jax.numpy.arange(8, dtype='int32'))\n"
+        "assert int(x.sum()) == 64\n"
+        "print('OK', jax.devices()[0].platform, len(jax.devices()))\n"
+    )
+    t0 = time.monotonic()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"timeout {PROBE_TIMEOUT_S:.0f}s (hung) after {time.monotonic()-t0:.0f}s"
+    if out.returncode == 0 and out.stdout.startswith("OK"):
+        plat = out.stdout.split()[1] if len(out.stdout.split()) > 1 else "?"
+        if plat == "cpu":
+            return False, "probe resolved to cpu (tunnel absent, sitecustomize fell back)"
+        return True, out.stdout.strip()
+    return False, (out.stderr or out.stdout)[-300:]
+
+
+def run_stage(name: str, cmd: list[str], out_path: str, timeout_s: float, env=None) -> bool:
+    log({"event": "stage_start", "stage": name, "cmd": " ".join(cmd)})
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    t0 = time.monotonic()
+    try:
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s, cwd=REPO, env=full_env
+        )
+    except subprocess.TimeoutExpired:
+        log({"event": "stage_timeout", "stage": name, "timeout_s": timeout_s})
+        return False
+    rec = {
+        "event": "stage_done",
+        "stage": name,
+        "rc": out.returncode,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "stdout_tail": out.stdout[-2000:],
+        "stderr_tail": out.stderr[-1000:],
+    }
+    log(rec)
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    return out.returncode == 0
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    log({"event": "daemon_start", "budget_s": BUDGET_S, "interval_s": INTERVAL_S})
+    n = 0
+    while time.monotonic() - t_start < BUDGET_S:
+        n += 1
+        ok, detail = probe()
+        log({"event": "probe", "n": n, "ok": ok, "detail": detail})
+        if ok:
+            run_stage(
+                "kernel_bench",
+                [sys.executable, os.path.join(HERE, "kernel_bench.py"), "--all"],
+                os.path.join(HERE, "tpu_kernel_r04.json"),
+                1800,
+            )
+            run_stage(
+                "dispatch_rtt",
+                [sys.executable, os.path.join(HERE, "dispatch_rtt.py")],
+                os.path.join(HERE, "tpu_rtt_r04.json"),
+                900,
+            )
+            run_stage(
+                "bench",
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                os.path.join(HERE, "tpu_bench_r04.json"),
+                1200,
+                env={"TM_BENCH_BACKENDS": "<auto>"},
+            )
+            log({"event": "daemon_done", "probes": n})
+            return 0
+        time.sleep(INTERVAL_S)
+    log({"event": "daemon_budget_expired", "probes": n})
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,11 @@ class P2PConfig:
     dial_timeout_s: int = 3
     send_rate: int = 5_120_000
     recv_rate: int = 5_120_000
+    # keepalive (reference p2p/conn/connection.go:47-48): ping every
+    # ping_interval_s; evict a peer silent for pong_timeout_s after a
+    # ping.  ping_interval_s = 0 disables keepalive.
+    ping_interval_s: float = 60.0
+    pong_timeout_s: float = 45.0
     addr_book_file: str = "config/addrbook.json"
     addr_book_strict: bool = True
 

@@ -236,18 +236,34 @@ def verify_batch_reference(pubs, msgs, sigs) -> list[bool]:
 
 
 def verify_batch_fast(pubs, msgs, sigs) -> list[bool]:
-    """Sequential host verification via `verify_fast` — the production
-    CPU path (small batches, device unavailable).  Bit-identical verdicts
-    to `verify_batch_reference`.
+    """Host verification of a whole batch — the production CPU path
+    (small batches, device unavailable).  Bit-identical verdicts to
+    `verify_batch_reference`.
 
-    Deliberately NOT thread-pooled: the installed cryptography binding
-    HOLDS the GIL through Ed25519 verify (empirically confirmed via a
-    switch-interval starvation test — 50 verifies completed alongside a
-    greedy spinner with a 2 s switch interval, impossible if the GIL were
-    released), so Python threads give 0x parallelism here and a pool is
-    pure overhead on the consensus verify path.  Multi-core CPU scaling
-    would need a GIL-releasing binding or a process pool; the framework's
-    actual scaling axis is the device batch path."""
+    Batches of ≥16 go through the native kernel
+    (src/native/edhost.cpp tmed_batch_verify): ONE C call into
+    libcrypto for the entire batch — no per-item Python dispatch, GIL
+    released, threaded across hardware cores.  The Python-loop
+    fallback is deliberately NOT thread-pooled: the installed
+    cryptography binding HOLDS the GIL through Ed25519 verify
+    (empirically confirmed via a switch-interval starvation test), so
+    Python threads give 0x parallelism there — multi-core CPU scaling
+    lives in the native kernel instead.
+
+    ZIP-215 bit-identity: libcrypto acceptance implies ZIP-215
+    acceptance (see verify_fast); every native REJECTION is re-checked
+    against the permissive pure reference, so the permissive ZIP-215
+    cases libcrypto refuses are still accepted."""
+    pubs, msgs, sigs = list(pubs), list(msgs), list(sigs)
+    if len(pubs) >= 16:
+        from tendermint_tpu.utils import host_prep
+
+        oks = host_prep.batch_verify_native(pubs, msgs, sigs)
+        if oks is not None:
+            return [
+                ok or verify(p, m, s)
+                for ok, p, m, s in zip(oks, pubs, msgs, sigs)
+            ]
     return [verify_fast(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
 
 

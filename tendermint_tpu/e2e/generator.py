@@ -2,14 +2,22 @@
 
 Parity: reference test/e2e/generator/ — explores the testnet config
 space with a seeded RNG so nightly runs cover combinations no hand-
-written manifest would (validator counts, load rates, perturbation
-schedules, byzantine misbehaviors), while staying reproducible: the
-same seed always yields the same manifest list.
+written manifest would, while staying reproducible: the same seed
+always yields the same manifest list.
 
-The config space is the subset this framework's runner supports
-(tendermint_tpu/e2e/runner.py manifest schema); each knob cites the
-reference generator's equivalent dimension (test/e2e/generator/
-generate.go: testnetCombinations, nodeVersions/perturbations).
+Dimensions (each citing the reference generator's equivalent in
+test/e2e/generator/generate.go testnetCombinations):
+  validators / target_height / load_rate   — topology + load
+  perturb (kill/pause/restart)             — perturbations
+  misbehaviors (all 5 maverick hooks)      — misbehaviors
+  abci builtin/socket/grpc                 — ABCIProtocol
+  db_backend sqlite/native/memdb           — database (config_overrides)
+  statesync_join                           — state_sync node mode
+
+Not covered (audited waivers): validator key types other than ed25519
+(the privval layer is ed25519-only — secp256k1 exists in crypto/ but is
+not wired as a consensus key; PARITY.md), ABCI-over-unix-socket (tcp
+only), and per-node version mixing (single binary).
 """
 
 from __future__ import annotations
@@ -17,15 +25,24 @@ from __future__ import annotations
 import random
 
 PERTURB_OPS = ("kill", "pause", "restart")  # reference perturb.go:29-66
-# the maverick's full misbehavior menu (e2e/maverick.py); the generator
-# draws equivocations and amnesia — nil-voting is just liveness noise
-MISBEHAVIORS = ("double-prevote", "double-precommit", "amnesia")
+# the maverick's FULL misbehavior menu (e2e/maverick.py MISBEHAVIORS)
+MISBEHAVIORS = (
+    "double-prevote",
+    "double-precommit",
+    "amnesia",
+    "nil-prevote",
+    "nil-precommit",
+)
+ABCI_MODES = ("builtin", "builtin", "socket", "grpc")  # weighted to in-proc
+DB_BACKENDS = ("sqlite", "sqlite", "native", "memdb")
 
 
 def generate_manifest(rng: random.Random, index: int = 0) -> dict:
     """One random manifest (reference generate.go Generate)."""
     n_vals = rng.choice((2, 4, 4, 5))  # weighted toward the canonical 4
     target = rng.randint(6, 10)
+    abci = rng.choice(ABCI_MODES)
+    db = rng.choice(DB_BACKENDS)
     manifest: dict = {
         "chain_id": f"gen-{index}",
         "validators": n_vals,
@@ -33,17 +50,41 @@ def generate_manifest(rng: random.Random, index: int = 0) -> dict:
         "load_rate": rng.choice((0, 5, 10)),
         # disjoint port range per manifest: a sweep runs nets back to
         # back, and recycling one base port made lingering sockets from
-        # manifest N wedge manifest N+1 (each net needs 2 ports/node)
+        # manifest N wedge manifest N+1 (each net needs 2 ports/node
+        # plus n app-server ports for socket/grpc abci, all inside the
+        # 24-port slice: offsets 0..3n-1, n <= 5)
         "base_port": 28000 + (index % 64) * 24,
     }
+    overrides: dict = {}
+    if abci != "builtin":
+        manifest["abci"] = abci
+    if db != "sqlite":
+        overrides["base.db_backend"] = db
+
+    # statesync join: the last validator sits out, then joins the live
+    # net via snapshot restore.  Needs >=4 validators so the remaining
+    # supermajority keeps committing, and snapshot serving enabled.
+    statesync_join = n_vals >= 4 and db != "memdb" and rng.random() < 0.25
+    if statesync_join:
+        manifest["statesync_join"] = True
+        overrides["base.snapshot_interval"] = 4
+        manifest["target_height"] = target = max(target, 10)
 
     # perturbations: up to 2, never on node 0 (the RPC anchor the runner
-    # uses for invariant checks), at heights the net will actually reach
+    # uses for invariant checks) and never on the statesync joiner, at
+    # heights the net will actually reach.  memdb keeps only "pause": a
+    # killed memdb node restarts empty and re-syncs from genesis, which
+    # blows the sweep's time budget without adding coverage beyond the
+    # dedicated blocksync tests.
+    ops = ("pause",) if db == "memdb" else PERTURB_OPS
+    hi_node = n_vals - 1 if statesync_join else n_vals
     perturb = []
     for _ in range(rng.randint(0, 2)):
+        if hi_node <= 1:
+            break
         perturb.append({
-            "node": rng.randrange(1, n_vals),
-            "op": rng.choice(PERTURB_OPS),
+            "node": rng.randrange(1, hi_node),
+            "op": rng.choice(ops),
             "at_height": rng.randint(2, max(2, target - 3)),
         })
     if perturb:
@@ -53,10 +94,12 @@ def generate_manifest(rng: random.Random, index: int = 0) -> dict:
     # single misbehaving node per net), only with >= 4 validators so the
     # honest supermajority keeps the chain live
     if n_vals >= 4 and rng.random() < 0.5:
-        node = rng.randrange(1, n_vals)
+        node = rng.randrange(1, hi_node)
         height = rng.randint(2, max(2, target - 3))
         manifest["misbehaviors"] = {str(node): {str(height): rng.choice(MISBEHAVIORS)}}
 
+    if overrides:
+        manifest["config_overrides"] = overrides
     return manifest
 
 

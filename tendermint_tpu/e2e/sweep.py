@@ -27,12 +27,17 @@ async def run_manifest(manifest: dict, root: str, timeout: float = 300.0) -> Non
     net.start()
     try:
         target = manifest["target_height"]
+        # with statesync_join the last validator starts OFFLINE and
+        # joins mid-run; height waits track the initially-live nodes
+        live = [n for n in net.nodes if n.proc is not None]
         # perturbations fire at their scheduled heights while the net
         # climbs toward the target (reference runner: Perturb between
         # Load and Test) — run them concurrently with the height wait
         perturb_task = asyncio.ensure_future(net.run_perturbations(timeout=timeout))
         try:
-            await net.wait_for_height(target, timeout=timeout)
+            if manifest.get("statesync_join"):
+                await net.run_statesync_join(timeout=timeout)
+            await net.wait_for_height(target, nodes=live, timeout=timeout)
             await asyncio.wait_for(perturb_task, timeout=timeout)
         finally:
             if not perturb_task.done():

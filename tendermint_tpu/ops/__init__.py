@@ -4,19 +4,15 @@ The field arithmetic uses 64-bit integer lanes; enable x64 before any
 tracing.  This must happen before the first jitted call in the process.
 """
 
-import os
-
 import jax
+
+from tendermint_tpu.utils import jaxcache
 
 jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: the verifier's scalar-mul loop is a large
 # program; caching its binary makes test sessions and bench reruns cheap.
-_cache_dir = os.environ.get(
-    "TENDERMINT_TPU_JAX_CACHE", os.path.expanduser("~/.cache/tendermint_tpu_jax")
-)
 try:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    jaxcache.enable(jax)
 except Exception:  # older jax without the knobs: cache is an optimization only
     pass

@@ -82,6 +82,31 @@ def _base_point_table() -> list[list[tuple[int, int, int, int]]]:
     return rows
 
 
+# Opt-in MXU path for the fixed-base scalar mult: selection from a SHARED
+# constant table is the one shape in this kernel with a genuine shared
+# contraction dimension (docs/tpu-verifier.md "The MXU question, answered
+# with arithmetic" names it as the open avenue).  Unproven on hardware
+# until the tunnel yields a measurement — default off.
+_BASE_MXU = os.environ.get("TM_TPU_BASE_MXU", "0") == "1"
+
+
+@functools.cache
+def _base_point_table256() -> list[list[tuple[int, int, int, int]]]:
+    """[j * 256^i]B for i in 0..31, j in 0..255 — the w=8 comb the MXU
+    one-hot path uses (the signature's s bytes ARE its radix-256 digits).
+    Built iteratively (adds/doublings), not 8192 scalar_mults."""
+    rows = []
+    g = _ref.BASE
+    for _i in range(32):
+        row = [_ref.IDENTITY]
+        for _j in range(255):
+            row.append(_ref.pt_add(row[-1], g))
+        rows.append(row)
+        for _ in range(8):
+            g = _ref.pt_double(g)
+    return rows
+
+
 # ---------------------------------------------------------------------------
 # Device program (field-agnostic; fe = the selected limb backend)
 # ---------------------------------------------------------------------------
@@ -169,7 +194,7 @@ class _Core:
 
         def body(i, acc):
             d = jnp.take(digits, NWINDOWS - 1 - i, axis=-1)
-            acc = fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(acc))))
+            acc = fe.pt_dbl_n(acc, 4)
             return fe.pt_add(acc, self._select16(d, tbl))
 
         top = self._select16(jnp.take(digits, NWINDOWS - 1, axis=-1), tbl)
@@ -210,6 +235,58 @@ class _Core:
         acc0 = fe.Pt(*(jnp.broadcast_to(c, shape + (fe.NLIMBS,)) for c in acc0.astuple()))
         return lax.fori_loop(1, NWINDOWS, body_dyn, acc0)
 
+    @functools.cached_property
+    def _fixed_base_tables256(self) -> np.ndarray:
+        """The w=8 comb table as ONE [32, 256, 4*NLIMBS] float32 tensor
+        (limb values in this backend's radix; int64-backend limbs < 2^18
+        and f32-backend limbs < 2^5 are both f32-exact).  numpy, not
+        jnp — converted per-trace like _fixed_base_tables."""
+        fe = self.fe
+        out = np.zeros((32, 256, 4 * fe.NLIMBS), dtype=np.float32)
+        for i, row in enumerate(_base_point_table256()):
+            for j, pt in enumerate(row):
+                for c in range(4):
+                    out[i, j, c * fe.NLIMBS:(c + 1) * fe.NLIMBS] = np.asarray(
+                        fe.limbs_from_int(pt[c]), dtype=np.float64
+                    )
+        return out
+
+    def _scalarmul_base_mxu(self, s_rows: jnp.ndarray):
+        """[s]B via one-hot × constant-table matmuls (w=8 comb): the
+        signature's 32 s bytes are its radix-256 digits, each window
+        selects from a SHARED 256-entry table — one_hot[N,256] @
+        table[256, 4*NLIMBS] has a true shared contraction dimension,
+        the one shape here the MXU can genuinely accelerate
+        (docs/tpu-verifier.md).  Halves the fixed-base adds (32 vs 64)
+        as a bonus.  Exactness: exactly one nonzero per one-hot row and
+        every table entry is f32-exact, so each output IS the selected
+        limb; Precision.HIGHEST keeps TPU matmuls in (6-pass emulated)
+        f32 rather than raw bf16."""
+        fe = self.fe
+        tbl = jnp.asarray(self._fixed_base_tables256)  # [32,256,4*NLIMBS] f32
+        out_dtype = jnp.asarray(fe.ONE).dtype
+        shape = s_rows.shape[:-1]
+
+        def sel(i, acc_unused=None):
+            digit = jnp.take(s_rows, i, axis=-1).astype(jnp.int32)
+            oh = (digit[..., None] == jnp.arange(256, dtype=jnp.int32)).astype(
+                jnp.float32
+            )
+            flat = lax.dot_general(
+                oh,
+                jnp.take(tbl, i, axis=0),
+                (((oh.ndim - 1,), (0,)), ((), ())),
+                precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )
+            c = flat.reshape(shape + (4, fe.NLIMBS)).astype(out_dtype)
+            return fe.Pt(c[..., 0, :], c[..., 1, :], c[..., 2, :], c[..., 3, :])
+
+        def body(i, acc):
+            return fe.pt_add(acc, sel(i))
+
+        return lax.fori_loop(1, 32, body, sel(0))
+
     def verify_core(self, pub_rows, r_rows, s_rows, k_rows, valid):
         """Inputs are PACKED byte rows ([N,32] uint8 each) — unpacking to
         bits/limbs happens on device, so the host→device transfer is 128
@@ -223,10 +300,11 @@ class _Core:
         k_digits = self._nibbles_of(k_rows)
         a_pt, ok_a = self.decompress(y_a, sign_a)
         r_pt, ok_r = self.decompress(y_r, sign_r)
-        w = fe.pt_add(self._scalarmul_base(s_digits),
-                      self._scalarmul_var(k_digits, fe.pt_neg(a_pt)))
+        sb = (self._scalarmul_base_mxu(s_rows) if _BASE_MXU
+              else self._scalarmul_base(s_digits))
+        w = fe.pt_add(sb, self._scalarmul_var(k_digits, fe.pt_neg(a_pt)))
         q = fe.pt_add(w, fe.pt_neg(r_pt))
-        q8 = fe.pt_dbl(fe.pt_dbl(fe.pt_dbl(q)))
+        q8 = fe.pt_dbl_n(q, 3)
         return valid & ok_a & ok_r & fe.pt_is_identity(q8)
 
 

@@ -275,6 +275,30 @@ def pt_double(p: Pt) -> Pt:
     return pt_dbl(p)
 
 
+def pt_dbl_n(p: Pt, k: int) -> Pt:
+    """k chained doublings, computing the extended T coordinate ONLY on
+    the last: dbl-2008-hwcd reads just (X, Y, Z), so each intermediate
+    T = E*H is a dead fe_mul.  XLA's DCE already eliminates those dead
+    muls from the compiled program — this primitive makes the ladder's
+    true op count explicit in the trace instead of relying on the
+    compiler, and shrinks the traced graph (255 fewer fe_mul subgraphs
+    per scalar ladder → faster tracing/compiles)."""
+    assert k >= 1
+    x, y, z = p.x, p.y, p.z
+    for i in range(k):
+        a = fe_sq(x)
+        b = fe_sq(y)
+        c = fe_sq(z)
+        c = fe_add(c, c)
+        h = fe_add(a, b)
+        e = fe_sub(h, fe_sq(fe_add(x, y)))
+        g = fe_sub(a, b)
+        f = fe_add(c, g)
+        if i == k - 1:
+            return Pt(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+        x, y, z = fe_mul(e, f), fe_mul(g, h), fe_mul(f, g)
+
+
 def pt_neg(p: Pt) -> Pt:
     # re-carry: negated coordinates feed fe_sub, which needs reduced inputs
     return Pt(fe_carry(fe_neg(p.x)), p.y, p.z, fe_carry(fe_neg(p.t)))

@@ -169,7 +169,14 @@ def _fe_mul_mxu(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         p,
         jnp.asarray(_INC),
         (((p.ndim - 1,), (0,)), ((), ())),
-        precision=lax.Precision.HIGHEST,  # bf16_3x on TPU: exact for these ranges
+        # HIGHEST = XLA's 6-pass f32 emulation on TPU (bf16_3x would be
+        # Precision.HIGH).  The 6-pass algorithm represents each f32
+        # operand exactly as bf16 triples, so products of our <=2^24
+        # integers accumulate exactly — but TPU-mode exactness is
+        # asserted here by argument, not yet by test: the differential
+        # test (test_fe_mul_mxu_variant_matches) has only ever run on
+        # XLA-CPU, where dot is natively f32.  Unverified on device
+        # until the TPU-side differential run lands (ADVICE r3).
         preferred_element_type=jnp.float32,
     )
     return fe_carry(cols, rounds=6)
@@ -343,6 +350,29 @@ def pt_dbl(p: Pt) -> Pt:
 
 def pt_double(p: Pt) -> Pt:
     return pt_dbl(p)
+
+
+def pt_dbl_n(p: Pt, k: int) -> Pt:
+    """k chained doublings with the T coordinate computed only on the
+    last (see fe25519.pt_dbl_n — trace-size/doc win; XLA DCEs the dead
+    muls either way).  Same bound ledger as pt_dbl: every intermediate
+    re-enters the loop reduced (the outputs of e*f, g*h, f*g are
+    fe_mul-reduced), so the chain is bound-safe for any k."""
+    assert k >= 1
+    x, y, z = p.x, p.y, p.z
+    for i in range(k):
+        a = fe_sq(x)
+        b = fe_sq(y)
+        c = fe_sq(z)
+        c = fe_add(c, c)
+        h = fe_add(a, b)
+        xy = fe_add(x, y)
+        e = fe_sub(h, fe_mul(xy, xy))
+        g = fe_sub(a, b)
+        f = fe_carry(fe_add(c, g), rounds=3)
+        if i == k - 1:
+            return Pt(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+        x, y, z = fe_mul(e, f), fe_mul(g, h), fe_mul(f, g)
 
 
 def pt_neg(p: Pt) -> Pt:

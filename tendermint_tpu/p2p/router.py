@@ -4,44 +4,88 @@ connections.
 Parity: reference p2p/router.go:15-525 — the new-architecture router the
 reference prototyped but never wired (SURVEY §1); here it IS the
 production stack.  Per peer: one recv task (frames → decode → channel
-in-queues) and one send task (priority queue → frames); per channel: one
-route task (out-queue → peer queues) and one error task (peer errors →
-disconnect).  Peer lifecycle changes are published to subscribers
-(reference PeerUpdates), which is how reactors learn to start/stop
-per-peer gossip.
+in-queues), one send task (per-channel bounded queues drained by
+weighted-fair scheduling), and one keepalive task (ping/pong liveness,
+reference p2p/conn/connection.go:47-48); per channel: one route task
+(out-queue → peer queues) and one error task (peer errors → disconnect).
+Peer lifecycle changes are published to subscribers (reference
+PeerUpdates), which is how reactors learn to start/stop per-peer gossip.
+
+Send scheduling (reference MConnection sendRoutine,
+p2p/conn/connection.go:422-434 sendSomePacketMsgs/channel selection):
+each channel gets its OWN bounded queue per peer (descriptor
+send_queue_capacity), so a saturating low-priority transfer (blocksync
+block parts) can never crowd votes out of a shared queue; the send task
+picks the non-empty channel with the lowest recently-sent/priority
+ratio, which converges to priority-weighted bandwidth shares while
+keeping every channel live.
+
+Keepalive (reference ping/pong, connection.go:47-48,170-180): a ping
+control frame every ping_interval; ANY inbound frame counts as life
+(pong included); a peer silent for pong_timeout after a ping is evicted
+— the Router publishes DOWN and the node's persistent-peer dialer
+redials with backoff.
 """
 
 from __future__ import annotations
 
 import asyncio
-import itertools
+import time
+from collections import deque
 
 from tendermint_tpu.utils.log import Logger, nop_logger
 
 from .channel import Channel
 from .types import ChannelDescriptor, Envelope, NodeID, PeerStatus, PeerUpdate
 
+# Control channel for router-internal keepalive frames.  Reserved: no
+# reactor channel may claim it (reference puts ping/pong at the packet
+# layer inside MConnection; here the frame layer is channel-tagged, so a
+# reserved id is the equivalent).
+CTRL_CHANNEL = 0xFE
+_PING = b"\x01"
+_PONG = b"\x02"
+
 
 class _Peer:
     def __init__(self, node_id: NodeID, conn):
         self.node_id = node_id
         self.conn = conn
-        # (negated priority, seq) orders the heap: higher priority first,
-        # FIFO within a priority class (reference mconn channel priorities)
-        self.send_q: asyncio.PriorityQueue = asyncio.PriorityQueue(maxsize=4096)
+        # per-channel bounded send queues (reference MConnection
+        # Channel.sendQueue w/ SendQueueCapacity): channel isolation is
+        # the point — see module docstring
+        self.send_queues: dict[int, deque] = {}
+        # exponentially-decayed bytes recently sent per channel, the
+        # fair-scheduling signal (reference channel.recentlySent)
+        self.recent_sent: dict[int, float] = {}
+        self._recent_stamp = time.monotonic()
+        self.send_ready = asyncio.Event()
+        self.pong_owed = False
+        self.ping_due = False
+        self.last_recv = time.monotonic()
         self.tasks: list[asyncio.Task] = []
 
 
 class Router:
-    def __init__(self, node_id: NodeID, transport, logger: Logger | None = None):
+    def __init__(
+        self,
+        node_id: NodeID,
+        transport,
+        logger: Logger | None = None,
+        ping_interval: float = 60.0,
+        pong_timeout: float = 45.0,
+    ):
         self.node_id = node_id
         self.transport = transport
         self.logger = logger or nop_logger()
+        # reference defaults: pingInterval 60s / pongTimeout 45s
+        # (p2p/conn/connection.go:47-48); tests shrink both
+        self.ping_interval = ping_interval
+        self.pong_timeout = pong_timeout
         self.channels: dict[int, Channel] = {}
         self.peers: dict[NodeID, _Peer] = {}
         self._peer_update_subs: list[asyncio.Queue] = []
         self._tasks: list[asyncio.Task] = []
-        self._seq = itertools.count()
         self._stopping = False
         # per-channel traffic counters (reference p2p/metrics.go bytes
         # by channel), read by the metrics scraper
@@ -50,6 +94,8 @@ class Router:
 
     # -- channels --------------------------------------------------------
     def open_channel(self, descriptor: ChannelDescriptor) -> Channel:
+        if descriptor.channel_id == CTRL_CHANNEL:
+            raise ValueError(f"channel {CTRL_CHANNEL:#x} is reserved for keepalive")
         if descriptor.channel_id in self.channels:
             raise ValueError(f"channel {descriptor.channel_id:#x} already open")
         ch = Channel(descriptor)
@@ -119,6 +165,8 @@ class Router:
         loop = asyncio.get_running_loop()
         peer.tasks.append(loop.create_task(self._peer_recv(peer)))
         peer.tasks.append(loop.create_task(self._peer_send(peer)))
+        if self.ping_interval > 0:
+            peer.tasks.append(loop.create_task(self._peer_keepalive(peer)))
         self.peers[node_id] = peer
         self.logger.info("peer up", peer=node_id[:8])
         self._publish_peer_update(PeerUpdate(node_id, PeerStatus.UP))
@@ -143,9 +191,16 @@ class Router:
         try:
             while True:
                 channel_id, data = await peer.conn.receive()
+                peer.last_recv = time.monotonic()
                 self.bytes_received[channel_id] = (
                     self.bytes_received.get(channel_id, 0) + len(data)
                 )
+                if channel_id == CTRL_CHANNEL:
+                    if data == _PING:
+                        peer.pong_owed = True
+                        peer.send_ready.set()
+                    # _PONG needs no action beyond the last_recv update
+                    continue
                 ch = self.channels.get(channel_id)
                 if ch is None:
                     continue  # unknown channel: drop silently
@@ -165,24 +220,98 @@ class Router:
                 self.logger.info("peer recv ended", peer=peer.node_id[:8], err=str(e))
                 asyncio.get_running_loop().create_task(self._disconnect(peer.node_id))
 
+    def _pick_channel(self, peer: _Peer) -> int | None:
+        """Non-empty channel with the lowest recently-sent/priority ratio
+        (reference MConnection channel selection, connection.go:422-434):
+        priority-weighted fair shares, no channel ever starved."""
+        now = time.monotonic()
+        # decay recentlySent ~0.8x per 100 ms (reference flush cadence)
+        decay = 0.8 ** ((now - peer._recent_stamp) / 0.1)
+        peer._recent_stamp = now
+        best, best_ratio = None, None
+        for cid, q in peer.send_queues.items():
+            peer.recent_sent[cid] = peer.recent_sent.get(cid, 0.0) * decay
+            if not q:
+                continue
+            prio = self.channels[cid].descriptor.priority if cid in self.channels else 1
+            ratio = peer.recent_sent[cid] / max(prio, 1)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = cid, ratio
+        return best
+
     async def _peer_send(self, peer: _Peer) -> None:
         try:
             while True:
-                _, _, channel_id, data = await peer.send_q.get()
-                await peer.conn.send(channel_id, data)
-                self.bytes_sent[channel_id] = (
-                    self.bytes_sent.get(channel_id, 0) + len(data)
-                )
+                await peer.send_ready.wait()
+                peer.send_ready.clear()
+                while True:
+                    # control frames preempt everything: a pong delayed
+                    # past pong_timeout by queued bulk data would read as
+                    # death to the remote side
+                    if peer.pong_owed:
+                        peer.pong_owed = False
+                        await peer.conn.send(CTRL_CHANNEL, _PONG)
+                        self.bytes_sent[CTRL_CHANNEL] = (
+                            self.bytes_sent.get(CTRL_CHANNEL, 0) + len(_PONG)
+                        )
+                        continue
+                    if peer.ping_due:
+                        peer.ping_due = False
+                        await peer.conn.send(CTRL_CHANNEL, _PING)
+                        self.bytes_sent[CTRL_CHANNEL] = (
+                            self.bytes_sent.get(CTRL_CHANNEL, 0) + len(_PING)
+                        )
+                        continue
+                    cid = self._pick_channel(peer)
+                    if cid is None:
+                        break
+                    data = peer.send_queues[cid].popleft()
+                    await peer.conn.send(cid, data)
+                    peer.recent_sent[cid] = peer.recent_sent.get(cid, 0.0) + len(data)
+                    self.bytes_sent[cid] = self.bytes_sent.get(cid, 0) + len(data)
         except asyncio.CancelledError:
             return
         except ConnectionError:
             if not self._stopping and peer.node_id in self.peers:
                 asyncio.get_running_loop().create_task(self._disconnect(peer.node_id))
 
+    async def _peer_keepalive(self, peer: _Peer) -> None:
+        """Ping every ping_interval; if the peer sends NOTHING (pong or
+        otherwise) for pong_timeout after a ping, evict it (reference
+        connection.go:47-48,170-180).  A silently-dead TCP peer (NAT
+        drop, SIGSTOP, power loss) is detected within
+        ping_interval + pong_timeout instead of occupying a peer slot
+        until the OS gives up (VERDICT r3 missing #2)."""
+        try:
+            next_ping = time.monotonic() + self.ping_interval
+            while True:
+                # pings hold the ping_interval cadence: the pong wait
+                # overlaps the time until the next ping rather than
+                # stretching the period to interval + timeout
+                await asyncio.sleep(max(0.0, next_ping - time.monotonic()))
+                t_ping = time.monotonic()
+                next_ping = t_ping + self.ping_interval
+                peer.ping_due = True
+                peer.send_ready.set()
+                await asyncio.sleep(self.pong_timeout)
+                if peer.last_recv < t_ping:
+                    self.logger.info(
+                        "peer unresponsive, evicting",
+                        peer=peer.node_id[:8],
+                        silent_s=round(time.monotonic() - peer.last_recv, 1),
+                    )
+                    asyncio.get_running_loop().create_task(
+                        self._disconnect(peer.node_id)
+                    )
+                    return
+        except asyncio.CancelledError:
+            return
+
     # -- channel routing ----------------------------------------------------
     async def _route_channel(self, ch: Channel) -> None:
-        """Drain a channel's out-queue into peer send queues."""
-        prio = -ch.descriptor.priority
+        """Drain a channel's out-queue into per-peer per-channel queues."""
+        cid = ch.channel_id
+        cap = ch.descriptor.send_queue_capacity
         while True:
             try:
                 env = await ch.out_queue.get()
@@ -195,12 +324,19 @@ class Router:
                 p = self.peers.get(env.to)
                 targets = [p] if p is not None else []
             for p in targets:
-                try:
-                    p.send_q.put_nowait((prio, next(self._seq), ch.channel_id, data))
-                except asyncio.QueueFull:
-                    # backpressure: drop lowest-urgency gossip rather than
-                    # stall the whole channel (reference TrySend semantics)
-                    self.logger.debug("peer send queue full", peer=p.node_id[:8])
+                q = p.send_queues.get(cid)
+                if q is None:
+                    q = p.send_queues[cid] = deque()
+                if len(q) >= cap:
+                    # backpressure: drop THIS channel's overflow only —
+                    # other channels' queues are untouched (reference
+                    # TrySend semantics + per-channel SendQueueCapacity)
+                    self.logger.debug(
+                        "channel send queue full", peer=p.node_id[:8], ch=cid
+                    )
+                    continue
+                q.append(data)
+                p.send_ready.set()
 
     async def _route_errors(self, ch: Channel) -> None:
         while True:

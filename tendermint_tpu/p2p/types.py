@@ -48,6 +48,10 @@ class ChannelDescriptor:
     decode: Callable[[bytes], object] = None
     recv_buffer_capacity: int = 1024
     max_msg_bytes: int = 1024 * 1024
+    # per-peer bound on THIS channel's outbound queue (reference
+    # conn.ChannelDescriptor.SendQueueCapacity): overflow drops this
+    # channel's gossip only, never another channel's
+    send_queue_capacity: int = 256
 
 
 class PeerStatus(enum.Enum):

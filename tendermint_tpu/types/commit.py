@@ -111,8 +111,18 @@ class Commit:
         per-call ProtoWriter cost dominated replay (BENCH r2: 0.86x).
         Byte-identity with vote_sign_bytes_raw is differential-tested
         (tests/test_wire.py)."""
+        # ADVICE r3: key on every field the prefix bytes depend on, not
+        # just chain_id, so a mutated Commit can never serve stale bytes
+        key = (
+            chain_id,
+            self.height,
+            self.round,
+            self.block_id.hash,
+            self.block_id.part_set_header.total,
+            self.block_id.part_set_header.hash,
+        )
         tpl = getattr(self, "_sb_tpl", None)
-        if tpl is not None and tpl[0] == chain_id:
+        if tpl is not None and tpl[0] == key:
             return tpl[1]
 
         def prefix(block_id: BlockID) -> bytes:
@@ -130,7 +140,7 @@ class Commit:
             prefix(BlockID()),
             ProtoWriter().string(6, chain_id).bytes_out(),
         )
-        self._sb_tpl = (chain_id, out)
+        self._sb_tpl = (key, out)
         return out
 
     def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:

@@ -1,0 +1,38 @@
+"""JAX persistent compile-cache location.
+
+ADVICE r3: the old default `/tmp/tm_tpu_jax_cache` is a predictable
+world-writable path, and the compile cache deserializes compiled XLA
+executables — on a shared box another user could pre-own the directory
+and plant poisoned entries.  The default now lives inside the repo tree
+(`<repo>/.jax_cache`, same rationale as `benchmarks/.chain_cache`);
+`TM_BENCH_CACHE` remains the explicit override.
+"""
+
+import os
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def cache_dir() -> str:
+    env = os.environ.get("TM_BENCH_CACHE") or os.environ.get(
+        "TENDERMINT_TPU_JAX_CACHE"
+    )
+    if env:
+        return env
+    # exists(), not isdir(): .git is a FILE in git worktrees
+    if os.path.exists(os.path.join(_REPO_ROOT, ".git")):
+        return os.path.join(_REPO_ROOT, ".jax_cache")
+    # installed as a package (no repo tree): per-user cache dir
+    return os.path.expanduser("~/.cache/tendermint_tpu_jax")
+
+
+def enable(jax_module) -> None:
+    """Point JAX's persistent compile cache at cache_dir().
+
+    Without this, every program in this container recompiles through
+    the ~100 s/bucket remote-compile relay (see .claude/skills/verify).
+    """
+    jax_module.config.update("jax_compilation_cache_dir", cache_dir())
+    jax_module.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -23,8 +23,6 @@ jax.config.update("jax_platforms", _platform)
 # persistent compile cache: this box routes XLA-CPU compiles through a
 # remote relay (~100s per program); the cache turns suite re-runs from
 # hours into minutes.  Same dir as bench.py / __graft_entry__.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("TM_BENCH_CACHE", "/tmp/tm_tpu_jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from tendermint_tpu.utils import jaxcache  # noqa: E402
+
+jaxcache.enable(jax)

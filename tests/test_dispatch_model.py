@@ -46,3 +46,67 @@ def test_default_threshold_consistent_with_direct_attach_model():
     via TM_TPU_CPU_THRESHOLD (docs/performance.md)."""
     host, dev = 45e-6, 21e-6
     assert breakeven(0.0015, dev, host) <= 64
+
+
+def test_measured_cpu_threshold_auto(monkeypatch):
+    """VERDICT r3 item 6: with no TM_TPU_CPU_THRESHOLD the breakeven is
+    MEASURED from a real n=8 device round trip, clamped to [16, 16384],
+    and the diagnostics record the inputs."""
+    from tendermint_tpu.crypto import batch
+
+    monkeypatch.setattr(batch, "_MEASURED_THRESHOLD", None)
+    monkeypatch.setattr(batch, "_THRESHOLD_DIAG", {})
+    thr = batch.measured_cpu_threshold()
+    assert 16 <= thr <= 16384
+    diag = batch.threshold_diagnostics()
+    assert diag["threshold"] == thr
+    if diag["measured"]:
+        assert diag["device_rtt_ms"] > 0
+        assert diag["host_us_per_sig"] > 0
+    # once measured, the process-wide cache serves later verifiers
+    assert batch.measured_cpu_threshold() == thr
+
+
+def test_cpu_threshold_env_override_wins(monkeypatch):
+    from tendermint_tpu.crypto import batch
+
+    monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "777")
+    v = batch.JAXBatchVerifier()
+    assert v.cpu_threshold == 777
+
+
+def test_cpu_threshold_malformed_env_defers(monkeypatch):
+    from tendermint_tpu.crypto import batch
+
+    monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "not-a-number")
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        v = batch.JAXBatchVerifier()
+    assert v.cpu_threshold is None  # deferred to lazy measurement
+    assert any("TM_TPU_CPU_THRESHOLD" in str(x.message) for x in w)
+
+
+def test_cpu_threshold_lazy_resolution(monkeypatch):
+    """Deferred threshold: sub-floor batches resolve to the static 64
+    without touching the device; the first >=64 batch measures once and
+    pins the instance threshold."""
+    from tendermint_tpu.crypto import batch
+
+    monkeypatch.delenv("TM_TPU_CPU_THRESHOLD", raising=False)
+    v = batch.JAXBatchVerifier()
+    assert v.cpu_threshold is None
+    called = []
+
+    def fake_measure():
+        called.append(1)
+        return 999
+
+    monkeypatch.setattr(batch, "measured_cpu_threshold", fake_measure)
+    assert v._resolved_threshold(8) == 64      # floor, no measurement
+    assert not called
+    assert v._resolved_threshold(64) == 999    # measured once
+    assert v.cpu_threshold == 999
+    assert v._resolved_threshold(8) == 999     # pinned thereafter
+    assert len(called) == 1

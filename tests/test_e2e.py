@@ -447,3 +447,70 @@ def test_generated_manifest_runs(tmp_path):
             net.stop()
 
     asyncio.run(run())
+
+
+def test_sigstop_peer_evicted_then_redialed(tmp_path):
+    """Keepalive e2e (VERDICT r3 item 4): SIGSTOP (not kill) one node of
+    a 4-node TCP net — the kernel keeps its sockets open, so only
+    ping/pong can tell it is dead.  The others must evict it within
+    ~2x ping_interval, keep committing without it, and redial it after
+    SIGCONT (persistent-peer recovery)."""
+
+    async def run():
+        net = Testnet(
+            {
+                "chain_id": "ka-net",
+                "validators": 4,
+                "base_port": 29960,
+                "config_overrides": {
+                    "p2p.ping_interval_s": 2.0,
+                    "p2p.pong_timeout_s": 2.0,
+                },
+            },
+            str(tmp_path / "net"),
+        )
+        net.setup()
+        net.start()
+        try:
+            await net.wait_for_height(3, timeout=240)
+            frozen = net.nodes[2]
+            frozen_id = frozen.rpc("/status")["node_info"]["id"]
+            observers = [net.nodes[0], net.nodes[1], net.nodes[3]]
+
+            def peers_of(n):
+                return {p["node_info"]["id"]
+                        for p in n.rpc("/net_info")["peers"]}
+
+            assert all(frozen_id in peers_of(n) for n in observers)
+
+            frozen.pause()  # SIGSTOP: sockets stay open, nothing answers
+            t0 = time.time()
+            deadline = t0 + 30  # 2x(ping 2s + pong 2s) + loaded-box slack
+            while time.time() < deadline:
+                if all(frozen_id not in peers_of(n) for n in observers):
+                    break
+                await asyncio.sleep(0.5)
+            evict_s = time.time() - t0
+            assert all(frozen_id not in peers_of(n) for n in observers), \
+                f"frozen peer still listed after {evict_s:.0f}s"
+
+            # liveness: the remaining 3/4 supermajority keeps committing
+            h = max(n.height() for n in observers)
+            await net.wait_for_height(h + 2, nodes=observers, timeout=120)
+
+            frozen.resume()
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if any(frozen_id in peers_of(n) for n in observers):
+                    break
+                await asyncio.sleep(0.5)
+            assert any(frozen_id in peers_of(n) for n in observers), \
+                "frozen peer was not redialed after SIGCONT"
+            # and it catches back up with the net
+            target = max(n.height() for n in observers) + 1
+            await net.wait_for_height(target, timeout=120)
+        finally:
+            rcs = net.stop()
+        assert all(rc == 0 for rc in rcs), f"exit codes {rcs}"
+
+    asyncio.run(run())

@@ -1,0 +1,216 @@
+"""Router keepalive (ping/pong) and per-channel send budgeting.
+
+Scenario parity: reference p2p/conn/connection.go:47-48,170-180 — a peer
+that stops responding (NAT drop, SIGSTOP, power loss) is detected by
+ping/pong timeout and evicted; per-channel SendQueueCapacity +
+priority-weighted channel scheduling (connection.go:422-434) keep a
+saturating bulk transfer from delaying or dropping votes.
+
+VERDICT r3 items 4 and 8.
+"""
+
+import asyncio
+import time
+
+from tendermint_tpu.p2p.memory import MemoryNetwork
+from tendermint_tpu.p2p.router import CTRL_CHANNEL, Router
+from tendermint_tpu.p2p.types import ChannelDescriptor, Envelope, PeerStatus
+
+import pytest
+
+
+def _ident(x: bytes) -> bytes:
+    return x
+
+
+def _desc(cid: int, priority: int = 1, cap: int = 256) -> ChannelDescriptor:
+    return ChannelDescriptor(
+        channel_id=cid,
+        priority=priority,
+        encode=_ident,
+        decode=_ident,
+        send_queue_capacity=cap,
+    )
+
+
+async def _connected_pair(net: MemoryNetwork, descs_a, descs_b, **router_kw):
+    ra = Router("a" * 40, net.create_transport("a" * 40), **router_kw)
+    rb = Router("b" * 40, net.create_transport("b" * 40), **router_kw)
+    chans_a = [ra.open_channel(d) for d in descs_a]
+    chans_b = [rb.open_channel(d) for d in descs_b]
+    await ra.start()
+    await rb.start()
+    await ra.dial("b" * 40)
+    for _ in range(50):
+        if ra.peer_ids() and rb.peer_ids():
+            break
+        await asyncio.sleep(0.01)
+    assert ra.peer_ids() == ["b" * 40] and rb.peer_ids() == ["a" * 40]
+    return ra, rb, chans_a, chans_b
+
+
+def test_keepalive_healthy_peers_stay_connected():
+    """Idle but responsive peers must NOT be evicted: pings flow, pongs
+    answer, nobody dies (no app traffic at all for many intervals)."""
+
+    async def run():
+        net = MemoryNetwork()
+        ra, rb, _, _ = await _connected_pair(
+            net, [_desc(0x20)], [_desc(0x20)], ping_interval=0.05, pong_timeout=0.1
+        )
+        await asyncio.sleep(0.6)  # ~12 ping intervals
+        assert ra.peer_ids() == ["b" * 40]
+        assert rb.peer_ids() == ["a" * 40]
+        # pings actually happened (control bytes counted on both sides)
+        assert ra.bytes_received.get(CTRL_CHANNEL, 0) > 0
+        assert rb.bytes_received.get(CTRL_CHANNEL, 0) > 0
+        await ra.stop()
+        await rb.stop()
+
+    asyncio.run(run())
+
+
+def test_keepalive_evicts_frozen_peer_and_publishes_down():
+    """Freeze B (cancel its router tasks; the connection object stays
+    open — the in-proc analog of SIGSTOP, where the kernel keeps the TCP
+    socket alive but the process answers nothing).  A must evict within
+    ping_interval + pong_timeout (+scheduling slack) and publish DOWN."""
+
+    async def run():
+        net = MemoryNetwork()
+        ra, rb, _, _ = await _connected_pair(
+            net, [_desc(0x20)], [_desc(0x20)], ping_interval=0.1, pong_timeout=0.15
+        )
+        updates = ra.subscribe_peer_updates()
+
+        # freeze: B's tasks stop running, but nothing is closed
+        for peer in rb.peers.values():
+            for t in peer.tasks:
+                t.cancel()
+
+        t0 = time.monotonic()
+        up = await asyncio.wait_for(updates.get(), timeout=2.0)
+        elapsed = time.monotonic() - t0
+        assert up.status is PeerStatus.DOWN
+        assert "b" * 40 not in ra.peer_ids()
+        # 2x ping_interval bound from the VERDICT criterion, generous
+        # slack for a loaded 1-core box
+        assert elapsed < 1.5, f"eviction took {elapsed:.2f}s"
+        await ra.stop()
+        await rb.stop()
+
+    asyncio.run(run())
+
+
+def test_keepalive_disabled_with_zero_interval():
+    async def run():
+        net = MemoryNetwork()
+        ra, rb, _, _ = await _connected_pair(
+            net, [_desc(0x20)], [_desc(0x20)], ping_interval=0, pong_timeout=0.05
+        )
+        await asyncio.sleep(0.3)
+        assert ra.peer_ids() and rb.peer_ids()
+        assert ra.bytes_sent.get(CTRL_CHANNEL, 0) == 0
+        await ra.stop()
+        await rb.stop()
+
+    asyncio.run(run())
+
+
+def test_ctrl_channel_reserved():
+    net = MemoryNetwork()
+    r = Router("c" * 40, net.create_transport("c" * 40))
+    with pytest.raises(ValueError, match="reserved"):
+        r.open_channel(_desc(CTRL_CHANNEL))
+
+
+def test_votes_not_starved_by_saturating_bulk_channel():
+    """A blocksync-like flood on a low-priority channel must not delay a
+    vote beyond one scheduling quantum, nor crowd it out of the queue
+    (per-channel capacity isolation).  The conn is slowed so a real
+    backlog forms."""
+
+    async def run():
+        net = MemoryNetwork()
+        BULK, VOTE = 0x40, 0x22
+        ra, rb, (bulk_a, vote_a), (bulk_b, vote_b) = await _connected_pair(
+            net,
+            [_desc(BULK, priority=1, cap=512), _desc(VOTE, priority=10)],
+            [_desc(BULK, priority=1, cap=512), _desc(VOTE, priority=10)],
+            ping_interval=0,
+        )
+
+        # slow the wire: 2ms per frame — the "scheduling quantum"
+        peer = ra.peers["b" * 40]
+        real_send = peer.conn.send
+
+        async def slow_send(channel_id, data):
+            await asyncio.sleep(0.002)
+            await real_send(channel_id, data)
+
+        peer.conn.send = slow_send
+
+        # saturate bulk: 400 x 1KB frames ≈ 800ms of wire time
+        payload = b"x" * 1024
+        for _ in range(400):
+            await bulk_a.send(Envelope(message=payload, to="b" * 40))
+        await asyncio.sleep(0.05)  # let the backlog build
+
+        t0 = time.monotonic()
+        await vote_a.send(Envelope(message=b"vote", to="b" * 40))
+
+        async def wait_vote():
+            while True:
+                env = await vote_b.receive()
+                if env.message == b"vote":
+                    return time.monotonic() - t0
+
+        delay = await asyncio.wait_for(wait_vote(), timeout=5.0)
+        # the vote may wait for the in-flight bulk frame plus a couple of
+        # scheduling quanta — NOT for the hundreds-of-frames backlog
+        assert delay < 0.25, f"vote delayed {delay*1e3:.0f}ms behind bulk backlog"
+        await ra.stop()
+        await rb.stop()
+
+    asyncio.run(run())
+
+
+def test_bulk_overflow_drops_only_bulk():
+    """Overflowing the bulk channel's queue drops bulk frames, never the
+    vote channel's (isolation is per channel, not per peer)."""
+
+    async def run():
+        net = MemoryNetwork()
+        BULK, VOTE = 0x40, 0x22
+        ra, rb, (bulk_a, vote_a), (bulk_b, vote_b) = await _connected_pair(
+            net,
+            [_desc(BULK, priority=1, cap=4), _desc(VOTE, priority=10, cap=64)],
+            [_desc(BULK, priority=1, cap=4), _desc(VOTE, priority=10, cap=64)],
+            ping_interval=0,
+        )
+        peer = ra.peers["b" * 40]
+        real_send = peer.conn.send
+
+        async def slow_send(channel_id, data):
+            await asyncio.sleep(0.005)
+            await real_send(channel_id, data)
+
+        peer.conn.send = slow_send
+
+        for i in range(64):
+            await bulk_a.send(Envelope(message=b"blk%d" % i, to="b" * 40))
+        for i in range(8):
+            await vote_a.send(Envelope(message=b"vote%d" % i, to="b" * 40))
+
+        got_votes = set()
+        async def collect():
+            while len(got_votes) < 8:
+                env = await vote_b.receive()
+                got_votes.add(env.message)
+
+        await asyncio.wait_for(collect(), timeout=5.0)
+        assert len(got_votes) == 8  # every vote delivered despite bulk overflow
+        await ra.stop()
+        await rb.stop()
+
+    asyncio.run(run())
