@@ -81,15 +81,25 @@ def _dec_events(d: dict, field: int) -> list:
 
 
 def _enc_val_update(vu: abci.ValidatorUpdate) -> bytes:
-    return (ProtoWriter().bytes_(1, vu.pub_key.bytes_())
+    """abci.ValidatorUpdate{pub_key: crypto.PublicKey = 1, power = 2} —
+    pub_key is the NESTED PublicKey oneof (types.proto), same dialect as
+    the state store's ABCIResponses codec, so key types survive the
+    app boundary (secp256k1 validators included)."""
+    from tendermint_tpu.crypto.encoding import pub_key_proto_field
+
+    field, raw = pub_key_proto_field(vu.pub_key)
+    pk = ProtoWriter().bytes_(field, raw, omit_empty=False).bytes_out()
+    return (ProtoWriter().message(1, pk, always=True)
             .varint(2, vu.power, omit_zero=False).bytes_out())
 
 
 def _dec_val_update(data: bytes) -> abci.ValidatorUpdate:
-    from tendermint_tpu.crypto.keys import PubKey
+    from tendermint_tpu.crypto.encoding import pub_key_from_proto_fields
 
     d = fields_to_dict(data)
-    return abci.ValidatorUpdate(pub_key=PubKey(_bv(d, 1)), power=_iv(d, 2))
+    pk = fields_to_dict(_bv(d, 1))
+    return abci.ValidatorUpdate(pub_key=pub_key_from_proto_fields(pk),
+                                power=_iv(d, 2))
 
 
 def _enc_validator(v: abci.Validator) -> bytes:

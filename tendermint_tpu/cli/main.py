@@ -97,7 +97,9 @@ def cmd_init(args) -> int:
         write_config(cfg)
         print(f"wrote config to {cfg.config_file}")
 
-    pv = load_or_gen_file_pv(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
+    key_type = getattr(args, "key_type", "ed25519")
+    pv = load_or_gen_file_pv(cfg.priv_validator_key_file,
+                             cfg.priv_validator_state_file, key_type=key_type)
     nk = load_or_gen_node_key(cfg.node_key_file)
 
     if os.path.exists(cfg.genesis_file):
@@ -109,6 +111,8 @@ def cmd_init(args) -> int:
             genesis_time_ns=time.time_ns(),
             validators=[GenesisValidator(pub_key=pv.get_pub_key(), power=10)],
         )
+        if key_type != "ed25519":
+            gen.consensus_params.validator.pub_key_types = ["ed25519", key_type]
         with open(cfg.genesis_file, "w") as fh:
             fh.write(gen.to_json())
         print(f"wrote genesis (chain {chain_id}) to {cfg.genesis_file}")
@@ -163,13 +167,18 @@ def cmd_gen_validator(args) -> int:
     """reference gen_validator.go: print a fresh priv validator key."""
     from tendermint_tpu.crypto.keys import gen_priv_key
 
-    key = gen_priv_key()
+    from tendermint_tpu.utils import tmjson
+
+    if getattr(args, "key_type", "ed25519") == "secp256k1":
+        from tendermint_tpu.crypto import secp256k1
+
+        key = secp256k1.gen_priv_key()
+    else:
+        key = gen_priv_key()
     print(json.dumps({
         "address": key.pub_key().address().hex().upper(),
-        "pub_key": {"type": "tendermint/PubKeyEd25519",
-                    "value": key.pub_key().bytes_().hex()},
-        "priv_key": {"type": "tendermint/PrivKeyEd25519",
-                     "value": key.bytes_().hex()},
+        "pub_key": tmjson.encode(key.pub_key()),
+        "priv_key": tmjson.encode(key),
     }, indent=2))
     return 0
 
@@ -202,10 +211,10 @@ def cmd_show_validator(args) -> int:
     from tendermint_tpu.privval.file_pv import FilePV
 
     cfg = load_config(_home(args))
+    from tendermint_tpu.utils import tmjson
+
     pv = FilePV.load(cfg.priv_validator_key_file, cfg.priv_validator_state_file)
-    pub = pv.get_pub_key()
-    print(json.dumps({"type": "tendermint/PubKeyEd25519",
-                      "value": pub.bytes_().hex()}))
+    print(json.dumps(tmjson.encode(pv.get_pub_key())))
     return 0
 
 
@@ -245,7 +254,9 @@ def cmd_testnet(args) -> int:
         cfg = default_config(home)
         cfg.ensure_dirs()
         pvs.append(load_or_gen_file_pv(cfg.priv_validator_key_file,
-                                       cfg.priv_validator_state_file))
+                                       cfg.priv_validator_state_file,
+                                       key_type=getattr(args, "key_type",
+                                                        "ed25519")))
         nks.append(load_or_gen_node_key(cfg.node_key_file))
         homes.append(home)
 
@@ -255,6 +266,8 @@ def cmd_testnet(args) -> int:
         validators=[GenesisValidator(pub_key=pv.get_pub_key(), power=1)
                     for pv in pvs],
     )
+    if getattr(args, "key_type", "ed25519") != "ed25519":
+        gen.consensus_params.validator.pub_key_types = ["ed25519", args.key_type]
     if args.per_host:
         # one node per host (docker-compose / real deployments): every
         # node uses the standard ports, peers resolve by hostname
@@ -731,6 +744,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("init", help="initialize home dir (config, genesis, keys)")
     sp.add_argument("--chain-id", default="")
+    sp.add_argument("--key-type", dest="key_type", default="ed25519",
+                    choices=["ed25519", "secp256k1"],
+                    help="validator consensus key type")
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("start", help="run the node")
@@ -741,6 +757,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--v", type=int, default=4, help="number of validators")
     sp.add_argument("--o", default="./mytestnet", help="output directory")
     sp.add_argument("--chain-id", default="")
+    sp.add_argument("--key-type", dest="key_type", default="ed25519",
+                    choices=["ed25519", "secp256k1"],
+                    help="validator consensus key type")
     sp.add_argument("--node-dir-prefix", default="node")
     sp.add_argument("--hostname", default="127.0.0.1")
     sp.add_argument("--starting-port", type=int, default=26656)
@@ -821,6 +840,9 @@ def build_parser() -> argparse.ArgumentParser:
         ("version", cmd_version),
     ):
         sp = sub.add_parser(name)
+        if name == "gen-validator":
+            sp.add_argument("--key-type", dest="key_type", default="ed25519",
+                            choices=["ed25519", "secp256k1"])
         sp.set_defaults(fn=fn)
     return p
 

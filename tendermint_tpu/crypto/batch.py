@@ -60,6 +60,36 @@ class _BaseBatch:
         return batch
 
 
+def _split_verify(pubs, msgs, sigs, ed_batch_fn) -> list[bool]:
+    """Key-type routing for a mixed batch (reference: VerifyCommit &c.
+    call pubkey.VerifySignature through the crypto.PubKey interface, so
+    any registered key type participates).  Key-byte length is the
+    discriminator — ed25519 pubs are 32 bytes, secp256k1 compressed
+    pubs are 33 — so no type tags ride the batch.  The ed25519 majority
+    goes through `ed_batch_fn` (batched: native kernel or device);
+    other rows verify individually."""
+    ed_idx = [i for i, p in enumerate(pubs) if len(p) == 32]
+    if len(ed_idx) == len(pubs):
+        return ed_batch_fn(pubs, msgs, sigs)
+    oks = [False] * len(pubs)
+    if ed_idx:
+        ed_oks = ed_batch_fn([pubs[i] for i in ed_idx],
+                             [msgs[i] for i in ed_idx],
+                             [sigs[i] for i in ed_idx])
+        for i, ok in zip(ed_idx, ed_oks):
+            oks[i] = bool(ok)
+    from tendermint_tpu.crypto.secp256k1 import PubKeySecp256k1
+
+    for i, p in enumerate(pubs):
+        if len(p) == 33:
+            try:
+                oks[i] = PubKeySecp256k1(p).verify_signature(msgs[i], sigs[i])
+            except ValueError:
+                oks[i] = False
+        # any other length: not a known key encoding, stays False
+    return oks
+
+
 class CPUBatchVerifier(_BaseBatch):
     """Sequential host loop — ZIP-215 verdicts via the libcrypto fast
     path (rejections re-checked by the pure reference; see
@@ -67,7 +97,7 @@ class CPUBatchVerifier(_BaseBatch):
 
     def verify(self) -> tuple[bool, list[bool]]:
         pubs, msgs, sigs = self._take()
-        oks = _ed.verify_batch_fast(pubs, msgs, sigs)
+        oks = _split_verify(pubs, msgs, sigs, _ed.verify_batch_fast)
         return all(oks) if oks else False, oks
 
 
@@ -221,20 +251,25 @@ class JAXBatchVerifier(_BaseBatch):
         self.cpu_threshold = measured_cpu_threshold()
         return self.cpu_threshold
 
-    def verify(self) -> tuple[bool, list[bool]]:
-        pubs, msgs, sigs = self._take()
-        if not pubs:
-            return False, []
+    def _ed_batch(self, pubs, msgs, sigs) -> list[bool]:
+        """The ed25519-only core: device program (sharded on a mesh) or
+        host fallback below the dispatch threshold."""
         if len(pubs) < self._resolved_threshold(len(pubs)):
-            oks = _ed.verify_batch_fast(pubs, msgs, sigs)
-            return all(oks) if oks else False, oks
+            return _ed.verify_batch_fast(pubs, msgs, sigs)
         if self._device_count() > 1:
             from tendermint_tpu.parallel import sharding
 
             oks = sharding.verify_batch_sharded(pubs, msgs, sigs)
         else:
             oks = self._impl.verify_batch(pubs, msgs, sigs)
-        return bool(all(oks)), [bool(v) for v in oks]
+        return [bool(v) for v in oks]
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        pubs, msgs, sigs = self._take()
+        if not pubs:
+            return False, []
+        oks = _split_verify(pubs, msgs, sigs, self._ed_batch)
+        return bool(all(oks)), oks
 
 
 _DEFAULT_BACKEND = os.environ.get("TM_TPU_CRYPTO_BACKEND", "auto")

@@ -13,11 +13,12 @@ test/e2e/generator/generate.go testnetCombinations):
   abci builtin/socket/grpc                 — ABCIProtocol
   db_backend sqlite/native/memdb           — database (config_overrides)
   statesync_join                           — state_sync node mode
+  key_type ed25519/secp256k1               — KeyType (r4: secp256k1 is a
+                                             first-class consensus key)
 
-Not covered (audited waivers): validator key types other than ed25519
-(the privval layer is ed25519-only — secp256k1 exists in crypto/ but is
-not wired as a consensus key; PARITY.md), ABCI-over-unix-socket (tcp
-only), and per-node version mixing (single binary).
+Not covered (audited waivers): sr25519 validator keys (no vetted
+schnorrkel implementation in-image — PARITY.md), ABCI-over-unix-socket
+(tcp only), and per-node version mixing (single binary).
 """
 
 from __future__ import annotations
@@ -60,6 +61,10 @@ def generate_manifest(rng: random.Random, index: int = 0) -> dict:
         manifest["abci"] = abci
     if db != "sqlite":
         overrides["base.db_backend"] = db
+    # validator key type (reference manifest KeyType): secp256k1 nets
+    # exercise the non-batched verify routing end to end
+    if rng.random() < 0.2:
+        manifest["key_type"] = "secp256k1"
 
     # statesync join: the last validator sits out, then joins the live
     # net via snapshot restore.  Needs >=4 validators so the remaining

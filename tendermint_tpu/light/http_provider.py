@@ -16,6 +16,7 @@ import json
 import urllib.parse
 import urllib.request
 
+from tendermint_tpu.crypto.encoding import pub_key_from_json
 from tendermint_tpu.crypto.keys import PubKey
 from tendermint_tpu.types.basic import BlockID, BlockIDFlag, PartSetHeader
 from tendermint_tpu.types.block import Header
@@ -87,7 +88,7 @@ def commit_from_json(d: dict) -> Commit:
 
 def validator_from_json(d: dict) -> Validator:
     return Validator(
-        pub_key=PubKey(_b64(d["pub_key"]["value"])),
+        pub_key=pub_key_from_json(d["pub_key"]),
         voting_power=int(d["voting_power"]),
         proposer_priority=int(d.get("proposer_priority", 0)),
         address=_hx(d.get("address")),

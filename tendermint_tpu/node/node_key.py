@@ -25,16 +25,20 @@ class NodeKey:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # private key material: owner-only, like the reference's 0600
         # (p2p/key.go LoadOrGenNodeKey)
+        from tendermint_tpu.utils import tmjson
+
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as fh:
-            json.dump({"priv_key": {"type": "tendermint/PrivKeyEd25519",
-                                    "value": self.priv_key.bytes_().hex()}}, fh)
+            json.dump({"priv_key": tmjson.encode(self.priv_key)}, fh)
 
     @classmethod
     def load(cls, path: str) -> "NodeKey":
+        from tendermint_tpu.crypto.keys import PrivKey
+        from tendermint_tpu.utils import tmjson
+
         with open(path) as fh:
             doc = json.load(fh)
-        return cls(priv_key=priv_key_from_seed(bytes.fromhex(doc["priv_key"]["value"])))
+        return cls(priv_key=tmjson.decode(doc["priv_key"], expect=PrivKey))
 
 
 def load_or_gen_node_key(path: str) -> NodeKey:

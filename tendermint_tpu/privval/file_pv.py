@@ -122,30 +122,55 @@ class FilePV:
 
     # -- construction ---------------------------------------------------
     @classmethod
-    def generate(cls, key_path: str, state_path: str) -> "FilePV":
-        pv = cls(gen_priv_key(), key_path, state_path)
+    def generate(cls, key_path: str, state_path: str,
+                 key_type: str = "ed25519") -> "FilePV":
+        """key_type: "ed25519" (default) or "secp256k1" (reference
+        e2e manifest KeyType / privval supports any registered key)."""
+        if key_type == "secp256k1":
+            from tendermint_tpu.crypto import secp256k1
+
+            priv = secp256k1.gen_priv_key()
+        elif key_type == "ed25519":
+            priv = gen_priv_key()
+        else:
+            raise ValueError(f"unsupported key type {key_type!r}")
+        pv = cls(priv, key_path, state_path)
         pv.save_key()
         pv.state.save()
         return pv
 
     @classmethod
     def load(cls, key_path: str, state_path: str) -> "FilePV":
+        from tendermint_tpu.utils import tmjson
+
         with open(key_path) as f:
             d = json.load(f)
-        priv = PrivKey(bytes.fromhex(d["priv_key"]))
+        raw = d["priv_key"]
+        if isinstance(raw, dict):
+            # reference-parity envelope (privval/file.go key files go
+            # through the libs/json registry); any registered priv key
+            # class decodes (ed25519 or secp256k1)
+            priv = tmjson.decode(raw)
+            if not hasattr(priv, "sign"):
+                raise ValueError(f"{raw.get('type')} is not a private key")
+        else:
+            # pre-round-4 files stored bare hex; keep loading them
+            priv = PrivKey(bytes.fromhex(raw))
         pv = cls(priv, key_path, state_path)
         pv.state.load()
         return pv
 
     def save_key(self) -> None:
+        from tendermint_tpu.utils import tmjson
+
         pub = self.priv_key.pub_key()
         _atomic_write(
             self.key_path,
             json.dumps(
                 {
                     "address": pub.address().hex().upper(),
-                    "pub_key": pub.bytes_().hex(),
-                    "priv_key": self.priv_key.bytes_().hex(),
+                    "pub_key": tmjson.encode(pub),
+                    "priv_key": tmjson.encode(self.priv_key),
                 },
                 indent=2,
             ),
@@ -214,7 +239,8 @@ class FilePV:
         st.save()
 
 
-def load_or_gen_file_pv(key_path: str, state_path: str) -> FilePV:
+def load_or_gen_file_pv(key_path: str, state_path: str,
+                        key_type: str = "ed25519") -> FilePV:
     if os.path.exists(key_path):
         return FilePV.load(key_path, state_path)
-    return FilePV.generate(key_path, state_path)
+    return FilePV.generate(key_path, state_path, key_type=key_type)

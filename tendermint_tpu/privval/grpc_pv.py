@@ -130,10 +130,10 @@ class GRPCSignerClient:
         return d
 
     def _get_pub_key(self):
-        from tendermint_tpu.crypto.keys import PubKey
+        from tendermint_tpu.crypto.encoding import pub_key_from_raw
 
         d = self._call("GetPubKey", b"")
-        return PubKey(_bv(d, 1))
+        return pub_key_from_raw(_bv(d, 1))
 
     # -- PrivValidator interface -----------------------------------------
     def get_pub_key(self):

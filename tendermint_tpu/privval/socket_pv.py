@@ -249,13 +249,13 @@ class SignerClient:
             return resp
 
     async def _get_pub_key(self):
-        from tendermint_tpu.crypto.keys import PubKey
+        from tendermint_tpu.crypto.encoding import pub_key_from_raw
 
         resp = await self._call(_MSG_PUBKEY_REQ, b"", _MSG_PUBKEY_RESP)
         err = _get_str(resp, 2)
         if err:
             raise RemoteSignerError(err)
-        return PubKey(_get_bytes(resp, 1))
+        return pub_key_from_raw(_get_bytes(resp, 1))
 
     async def _sign_vote(self, chain_id: str, vote: Vote) -> Vote:
         body = (ProtoWriter().bytes_(1, vote.encode()).string(2, chain_id)

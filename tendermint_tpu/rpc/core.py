@@ -125,10 +125,8 @@ def status(env: Environment) -> dict:
         },
         "validator_info": {
             "address": enc.hexu(pub.address() if pub else b""),
-            "pub_key": {
-                "type": "tendermint/PubKeyEd25519",
-                "value": enc.b64(pub.bytes_() if pub else b""),
-            },
+            "pub_key": (enc.pub_key_json(pub) if pub else
+                        {"type": "tendermint/PubKeyEd25519", "value": ""}),
             "voting_power": enc.i64(power),
         },
     }
@@ -220,10 +218,7 @@ def block_results(env: Environment, height=None) -> dict:
         "end_block_events": [enc.event_json(e) for e in (eb.events if eb else [])],
         "validator_updates": [
             {
-                "pub_key": {
-                    "type": "tendermint/PubKeyEd25519",
-                    "value": enc.b64(vu.pub_key.bytes_()),
-                },
+                "pub_key": enc.pub_key_json(vu.pub_key),
                 "power": enc.i64(vu.power),
             }
             for vu in (eb.validator_updates if eb else [])

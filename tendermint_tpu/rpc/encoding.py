@@ -10,6 +10,8 @@ from __future__ import annotations
 import base64
 import datetime as _dt
 
+from tendermint_tpu.crypto.encoding import pub_key_json  # noqa: F401
+
 
 def b64(data: bytes | None) -> str:
     return base64.b64encode(data or b"").decode()
@@ -149,7 +151,7 @@ def vote_json(v) -> dict:
 def validator_json(v) -> dict:
     return {
         "address": hexu(v.address),
-        "pub_key": {"type": "tendermint/PubKeyEd25519", "value": b64(v.pub_key.bytes_())},
+        "pub_key": pub_key_json(v.pub_key),
         "voting_power": i64(v.voting_power),
         "proposer_priority": i64(v.proposer_priority),
     }

@@ -498,10 +498,7 @@ def _event_data_json(data) -> dict:
             "value": {
                 "validator_updates": [
                     {
-                        "pub_key": {
-                            "type": "tendermint/PubKeyEd25519",
-                            "value": enc.b64(v.pub_key.bytes_()),
-                        },
+                        "pub_key": enc.pub_key_json(v.pub_key),
                         "power": enc.i64(v.power),
                     }
                     for v in data.validator_updates

@@ -377,11 +377,14 @@ def _decode_abci_responses(raw: bytes) -> ABCIResponses:
     if f.get(2):
         eb = ResponseEndBlock()
         ef = fields_to_dict(f[2][0])
+        from tendermint_tpu.crypto.encoding import pub_key_from_proto_fields
+
         for b in ef.get(1, []):
             vf = fields_to_dict(b)
             pk = fields_to_dict(vf.get(1, [b""])[0])
             eb.validator_updates.append(
-                ValidatorUpdate(pub_key=PubKey(pk.get(1, [b""])[0]), power=vf.get(2, [0])[0])
+                ValidatorUpdate(pub_key=pub_key_from_proto_fields(pk),
+                                power=vf.get(2, [0])[0])
             )
         if ef.get(2):
             eb.consensus_param_updates = _decode_param_updates(ef[2][0])

@@ -16,6 +16,12 @@ from .basic import now_ns
 from .params import ConsensusParams
 from .validator import Validator, ValidatorSet
 
+
+def _tmjson():
+    from tendermint_tpu.utils import tmjson
+
+    return tmjson
+
 MAX_CHAIN_ID_LEN = 50
 
 
@@ -90,10 +96,9 @@ class GenesisDoc:
                 "validators": [
                     {
                         "address": v.address.hex().upper(),
-                        "pub_key": {
-                            "type": "tendermint/PubKeyEd25519",
-                            "value": v.pub_key.bytes_().hex(),
-                        },
+                        # registry envelope (utils/tmjson): supports any
+                        # registered key type, not just ed25519
+                        "pub_key": _tmjson().encode(v.pub_key),
                         "power": str(v.power),
                         "name": v.name,
                     }
@@ -144,7 +149,7 @@ class GenesisDoc:
             consensus_params=params,
             validators=[
                 GenesisValidator(
-                    pub_key=PubKey(bytes.fromhex(v["pub_key"]["value"])),
+                    pub_key=_tmjson().decode(v["pub_key"]),
                     power=int(v["power"]),
                     name=v.get("name", ""),
                 )

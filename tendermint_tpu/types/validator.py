@@ -47,13 +47,17 @@ _PK_PROTO_CACHE: dict[bytes, bytes] = {}
 
 
 def pub_key_proto_bytes(pub_key: PubKey) -> bytes:
-    """tendermint.crypto.PublicKey{oneof sum: ed25519=1} (keys.proto).
-    Memoized by key bytes: encoded for every validator row of every
-    state save / wire message, and keys are immutable."""
-    raw = pub_key.bytes_()
+    """tendermint.crypto.PublicKey{oneof sum: ed25519=1, secp256k1=2}
+    (keys.proto; dispatch in crypto/encoding.py).  Memoized by key
+    bytes: encoded for every validator row of every state save / wire
+    message, keys are immutable, and the two key types have distinct
+    lengths so raw bytes are a sufficient cache key."""
+    from tendermint_tpu.crypto.encoding import pub_key_proto_field
+
+    field, raw = pub_key_proto_field(pub_key)
     enc = _PK_PROTO_CACHE.get(raw)
     if enc is None:
-        enc = ProtoWriter().bytes_(1, raw, omit_empty=False).bytes_out()
+        enc = ProtoWriter().bytes_(field, raw, omit_empty=False).bytes_out()
         if len(_PK_PROTO_CACHE) < 65536:  # bound: ~100B/entry
             _PK_PROTO_CACHE[raw] = enc
     return enc
@@ -127,13 +131,15 @@ class Validator:
     def decode(cls, data: bytes) -> "Validator":
         from tendermint_tpu.wire.proto import fields_to_dict
 
+        from tendermint_tpu.crypto.encoding import pub_key_from_proto_fields
+
         f = fields_to_dict(data)
         pk = fields_to_dict(f.get(2, [b""])[0])
         prio = f.get(4, [0])[0]
         if prio >= 1 << 63:
             prio -= 1 << 64
         return cls(
-            pub_key=PubKey(pk.get(1, [b""])[0]),
+            pub_key=pub_key_from_proto_fields(pk),
             voting_power=f.get(3, [0])[0],
             proposer_priority=prio,
             address=f.get(1, [b""])[0],

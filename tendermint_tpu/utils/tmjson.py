@@ -1,0 +1,111 @@
+"""Amino-compatible JSON type registry.
+
+Parity: reference `libs/json` (SURVEY §2.1) — a RegisterType registry
+rendering interface-valued fields as `{"type": "tendermint/…",
+"value": …}` envelopes, used by genesis docs, priv-validator files,
+node keys and the debug/CLI printers.  This module is the ONE place
+the type-name ⇄ class mapping lives; the operator-file writers
+(types/genesis.py, node/node_key.py, privval/file_pv.py, cli) all
+route their envelopes through it.
+
+Divergence from the reference, by design: envelope *values* for key
+material are lowercase hex, not base64 — this framework's round-1
+operator-file convention, kept consistent everywhere.  Everything else
+(type names, envelope shape) matches `libs/json` registrations
+(crypto/encoding + privval: tendermint/PubKeyEd25519,
+tendermint/PrivKeyEd25519, tendermint/PubKeySecp256k1,
+tendermint/PrivKeySecp256k1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+class UnknownType(ValueError):
+    """An envelope named a type that was never registered."""
+
+
+_BY_NAME: dict[str, tuple[type, Callable[[Any], Any], Callable[[Any], Any]]] = {}
+_BY_CLASS: dict[type, str] = {}
+
+
+def register_type(
+    name: str,
+    cls: type,
+    enc: Callable[[Any], Any],
+    dec: Callable[[Any], Any],
+) -> None:
+    """Register a concrete class under an amino type name (reference
+    libs/json RegisterType).  `enc` renders the instance to the
+    envelope's "value"; `dec` rebuilds the instance from it."""
+    if name in _BY_NAME:
+        raise ValueError(f"type name {name!r} already registered")
+    if cls in _BY_CLASS:
+        raise ValueError(f"class {cls.__name__} already registered")
+    _BY_NAME[name] = (cls, enc, dec)
+    _BY_CLASS[cls] = name
+
+
+def encode(obj: Any) -> dict:
+    """`{"type": name, "value": enc(obj)}` for a registered instance."""
+    name = _BY_CLASS.get(type(obj))
+    if name is None:
+        raise UnknownType(f"{type(obj).__name__} is not a registered tmjson type")
+    return {"type": name, "value": _BY_NAME[name][1](obj)}
+
+
+def decode(doc: Any, expect: type | None = None) -> Any:
+    """Rebuild the instance from an envelope; `expect` narrows the
+    acceptable classes (reference json.Unmarshal into an interface with
+    a concrete target)."""
+    if (not isinstance(doc, dict) or set(doc) - {"type", "value"}
+            or "value" not in doc):
+        raise ValueError(f"not a type envelope: {doc!r}")
+    name = doc.get("type")
+    entry = _BY_NAME.get(name)
+    if entry is None:
+        raise UnknownType(f"unregistered type {name!r}")
+    cls, _enc, dec = entry
+    if expect is not None and not issubclass(cls, expect):
+        raise ValueError(f"envelope {name!r} decodes to {cls.__name__}, "
+                         f"expected {expect.__name__}")
+    return dec(doc.get("value"))
+
+
+def registered_name(cls: type) -> str | None:
+    return _BY_CLASS.get(cls)
+
+
+# ---------------------------------------------------------------------------
+# Standard registrations (reference: crypto/encoding/codec.go + privval
+# key files; names from the reference's amino registry)
+# ---------------------------------------------------------------------------
+
+def _register_keys() -> None:
+    from tendermint_tpu.crypto.keys import PrivKey, PubKey, priv_key_from_seed
+    from tendermint_tpu.crypto.secp256k1 import PrivKeySecp256k1, PubKeySecp256k1
+
+    register_type(
+        "tendermint/PubKeyEd25519", PubKey,
+        lambda k: k.bytes_().hex(),
+        lambda v: PubKey(bytes.fromhex(v)),
+    )
+    register_type(
+        "tendermint/PrivKeyEd25519", PrivKey,
+        lambda k: k.bytes_().hex(),
+        lambda v: priv_key_from_seed(bytes.fromhex(v)),
+    )
+    register_type(
+        "tendermint/PubKeySecp256k1", PubKeySecp256k1,
+        lambda k: k.bytes_().hex(),
+        lambda v: PubKeySecp256k1(bytes.fromhex(v)),
+    )
+    register_type(
+        "tendermint/PrivKeySecp256k1", PrivKeySecp256k1,
+        lambda k: k.bytes_().hex(),
+        lambda v: PrivKeySecp256k1(bytes.fromhex(v)),
+    )
+
+
+_register_keys()

@@ -40,10 +40,13 @@ def test_breakeven_monotone_in_dispatch():
 
 
 def test_default_threshold_consistent_with_direct_attach_model():
-    """crypto/batch.py ships cpu_threshold=64: justified iff the dispatch
-    cost is ~1.5ms or less at round-1 device speed.  This pins the
-    documented operating assumption; a tunneled deployment must override
-    via TM_TPU_CPU_THRESHOLD (docs/performance.md)."""
+    """Since r4 the threshold is auto-MEASURED at the first >=64-sig
+    batch (crypto/batch.measured_cpu_threshold); 64 survives only as the
+    static floor below which the device is never touched.  This pins
+    that the floor is consistent with the direct-attach model (dispatch
+    ~1.5ms at round-1 device speed): batches under it could not beat the
+    host even on the best-case hardware, so skipping measurement for
+    them is sound."""
     host, dev = 45e-6, 21e-6
     assert breakeven(0.0015, dev, host) <= 64
 

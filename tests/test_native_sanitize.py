@@ -206,3 +206,66 @@ def test_signbytes_kernel_under_asan(tmp_path):
     assert "SIGNBYTES-OK" in proc.stdout
     for marker in ("ERROR: AddressSanitizer", "runtime error:"):
         assert marker not in proc.stderr, proc.stderr[-3000:]
+
+
+BATCH_VERIFY_STRESS = r"""
+import random, sys
+import tendermint_tpu.utils.host_prep as hp
+hp._LIB_NAME = "libedhost_asan.so"
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+lib = hp.load_lib()
+assert lib is not None, "sanitized kernel must load"
+if not lib.tmed_have_libcrypto():
+    print("NO-LIBCRYPTO")  # environment without libcrypto: nothing to stress
+    sys.exit(0)
+
+rng = random.Random(7)
+privs = [Ed25519PrivateKey.from_private_bytes(bytes([i + 1]) * 32)
+         for i in range(80)]
+pubs = [p.public_key().public_bytes_raw() for p in privs]
+for case in range(6):
+    n = rng.choice([16, 33, 80])
+    msgs = [bytes([case]) * rng.choice([0, 1, 7, 300]) or b"" for _ in range(n)]
+    msgs = [m + b"m%d" % i for i, m in enumerate(msgs)]
+    sigs = [p.sign(m) for p, m in zip(privs[:n], msgs)]
+    bad = set(rng.sample(range(n), k=max(1, n // 7)))
+    for b in bad:
+        sigs[b] = bytes(64) if b % 2 else sigs[b][:-1] + bytes([sigs[b][-1] ^ 1])
+    # force the multi-threaded chunking path even on a 1-core box
+    oks = hp.batch_verify_native(pubs[:n], msgs, sigs, n_threads=4)
+    assert oks is not None
+    got_bad = {i for i, v in enumerate(oks) if not v}
+    assert got_bad == bad, (case, got_bad, bad)
+print("BATCHVERIFY-OK")
+"""
+
+
+@pytest.mark.slow
+def test_batch_verify_kernel_under_asan(tmp_path):
+    """tmed_batch_verify under ASan+UBSan: mixed-validity batches, odd
+    sizes, zero-length and long messages, forced 4-thread chunking (the
+    path a 1-core box never takes naturally) — verdict correctness
+    asserted inside the sanitized process."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    asan = _libasan()
+    if asan is None:
+        pytest.skip("libasan not found")
+    build = subprocess.run(["make", "-C", SRC, "asan"],
+                           capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr
+
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = asan
+    env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", BATCH_VERIFY_STRESS],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(SRC.rstrip(os.sep).rsplit(os.sep, 1)[0]),
+    )
+    assert proc.returncode == 0, f"stderr:\n{proc.stderr[-3000:]}"
+    assert ("BATCHVERIFY-OK" in proc.stdout) or ("NO-LIBCRYPTO" in proc.stdout)
+    for marker in ("ERROR: AddressSanitizer", "runtime error:"):
+        assert marker not in proc.stderr, proc.stderr[-3000:]

@@ -1,0 +1,177 @@
+"""secp256k1 as a first-class consensus key type (round 4).
+
+Scenario parity: the reference accepts any registered crypto.PubKey as
+a validator key (validator_set.go VerifyCommit calls the PubKey
+interface; e2e manifests draw KeyType secp256k1; crypto/encoding/
+codec.go maps the PublicKey proto oneof).  These tests drive the same
+surfaces here: proto oneof round-trip, valset hashing, mixed-key-type
+commit verification through the BATCHED paths (split routing), the
+ABCI ValidatorUpdate boundary, FilePV signing, and a real
+multi-process secp testnet.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto import secp256k1
+from tendermint_tpu.crypto.batch import CPUBatchVerifier
+from tendermint_tpu.crypto.encoding import (
+    pub_key_from_proto_fields,
+    pub_key_from_raw,
+    pub_key_json,
+    pub_key_proto_field,
+)
+from tendermint_tpu.crypto.keys import PubKey, priv_key_from_seed
+from tendermint_tpu.crypto.secp256k1 import PrivKeySecp256k1, PubKeySecp256k1
+from tendermint_tpu.types.basic import BlockID, PartSetHeader
+from tendermint_tpu.types.validator import Validator, ValidatorSet
+
+from tests.helpers import sign_commit
+
+
+def _mixed_valset(n_ed=2, n_secp=2, power=10):
+    keys = [priv_key_from_seed(bytes([7 * i + 1]) * 32) for i in range(n_ed)]
+    keys += [PrivKeySecp256k1(bytes([9 * i + 5]) * 32) for i in range(n_secp)]
+    vals = [Validator(pub_key=k.pub_key(), voting_power=power) for k in keys]
+    vs = ValidatorSet(vals)
+    by_addr = {k.pub_key().address(): k for k in keys}
+    return vs, by_addr
+
+
+def test_proto_oneof_roundtrip():
+    ed = priv_key_from_seed(b"\x01" * 32).pub_key()
+    sp = PrivKeySecp256k1(b"\x02" * 32).pub_key()
+    assert pub_key_proto_field(ed) == (1, ed.bytes_())
+    assert pub_key_proto_field(sp) == (2, sp.bytes_())
+    assert pub_key_from_proto_fields({1: [ed.bytes_()]}) == ed
+    got = pub_key_from_proto_fields({2: [sp.bytes_()]})
+    assert isinstance(got, PubKeySecp256k1) and got == sp
+    # length-discriminated raw decode (remote-signer dialect)
+    assert isinstance(pub_key_from_raw(sp.bytes_()), PubKeySecp256k1)
+    assert isinstance(pub_key_from_raw(ed.bytes_()), PubKey)
+
+
+def test_validator_encode_decode_secp():
+    sp = PrivKeySecp256k1(b"\x03" * 32).pub_key()
+    v = Validator(pub_key=sp, voting_power=7, proposer_priority=-3)
+    back = Validator.decode(v.encode())
+    assert isinstance(back.pub_key, PubKeySecp256k1)
+    assert back.pub_key == sp
+    assert back.voting_power == 7 and back.proposer_priority == -3
+    # address = RIPEMD160(SHA256), distinct from the ed25519 scheme
+    assert back.address == sp.address() and len(back.address) == 20
+
+
+def test_valset_hash_covers_key_type():
+    """Two valsets whose keys have identical *lengths-stripped* material
+    but different types must hash differently (the oneof field number is
+    part of SimpleValidator bytes)."""
+    vs_mixed, _ = _mixed_valset(1, 1)
+    vs_ed, _ = _mixed_valset(2, 0)
+    assert vs_mixed.hash() != vs_ed.hash()
+
+
+def test_mixed_commit_verify_all_paths():
+    """verify_commit / _light / _light_trusting over a 2-ed + 2-secp
+    valset: the batched ed25519 path and the per-sig secp path must
+    both contribute; tampering either key type's signature fails."""
+    vs, by_addr = _mixed_valset()
+    bid = BlockID(hash=b"\x0b" * 32,
+                  part_set_header=PartSetHeader(total=1, hash=b"\x0c" * 32))
+    commit = sign_commit("secp-chain", 5, 0, bid, vs, by_addr,
+                         1_700_000_123 * 10**9)
+    vs.verify_commit("secp-chain", bid, 5, commit)
+    vs.verify_commit_light("secp-chain", bid, 5, commit)
+    from fractions import Fraction
+
+    vs.verify_commit_light_trusting("secp-chain", commit, Fraction(1, 3))
+
+    # tamper a secp signature (index of a secp validator)
+    secp_idx = next(i for i, v in enumerate(vs.validators)
+                    if isinstance(v.pub_key, PubKeySecp256k1))
+    good = commit.signatures[secp_idx].signature
+    commit.signatures[secp_idx].signature = good[:-1] + bytes([good[-1] ^ 1])
+    with pytest.raises(ValueError):
+        vs.verify_commit("secp-chain", bid, 5, commit)
+    commit.signatures[secp_idx].signature = good
+
+    ed_idx = next(i for i, v in enumerate(vs.validators)
+                  if isinstance(v.pub_key, PubKey))
+    good = commit.signatures[ed_idx].signature
+    commit.signatures[ed_idx].signature = bytes(64)
+    with pytest.raises(ValueError):
+        vs.verify_commit("secp-chain", bid, 5, commit)
+
+
+def test_batch_verifier_split_routing():
+    eds = [priv_key_from_seed(bytes([i + 1]) * 32) for i in range(3)]
+    sps = [PrivKeySecp256k1(bytes([i + 40]) * 32) for i in range(3)]
+    bv = CPUBatchVerifier()
+    expected = []
+    for i, k in enumerate([eds[0], sps[0], eds[1], sps[1], eds[2], sps[2]]):
+        msg = b"route-%d" % i
+        sig = k.sign(msg)
+        if i == 2:
+            sig = bytes(64)  # corrupt an ed row
+        if i == 3:
+            sig = sig[:32] + bytes(32)  # corrupt a secp row
+        bv.add(k.pub_key(), msg, sig)
+        expected.append(i not in (2, 3))
+    all_ok, oks = bv.verify()
+    assert oks == expected and all_ok is False
+
+
+def test_abci_val_update_wire_roundtrip():
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.wire import _dec_val_update, _enc_val_update
+
+    sp = PrivKeySecp256k1(b"\x0e" * 32).pub_key()
+    ed = priv_key_from_seed(b"\x0f" * 32).pub_key()
+    for pub in (sp, ed):
+        vu = abci.ValidatorUpdate(pub_key=pub, power=9)
+        back = _dec_val_update(_enc_val_update(vu))
+        assert type(back.pub_key) is type(pub)
+        assert back.pub_key == pub and back.power == 9
+
+
+def test_file_pv_secp_sign_vote(tmp_path):
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types import Vote
+    from tendermint_tpu.types.basic import SignedMsgType
+
+    kp, sp_ = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(kp, sp_, key_type="secp256k1")
+    assert isinstance(pv.get_pub_key(), PubKeySecp256k1)
+    pv2 = FilePV.load(kp, sp_)
+    assert isinstance(pv2.get_pub_key(), PubKeySecp256k1)
+
+    vote = Vote(
+        type=SignedMsgType.PREVOTE, height=3, round=0,
+        block_id=BlockID(hash=b"\x0d" * 32,
+                         part_set_header=PartSetHeader(total=1, hash=b"\x0d" * 32)),
+        timestamp_ns=1_700_000_000 * 10**9,
+        validator_address=pv.get_pub_key().address(), validator_index=0,
+    )
+    pv.sign_vote("secp-chain", vote)
+    vote.verify("secp-chain", pv.get_pub_key())  # raises on failure
+
+
+def test_pub_key_json_rpc_envelope():
+    sp = PrivKeySecp256k1(b"\x04" * 32).pub_key()
+    env = pub_key_json(sp)
+    assert env["type"] == "tendermint/PubKeySecp256k1"
+    from tendermint_tpu.crypto.encoding import pub_key_from_json
+
+    assert pub_key_from_json(env) == sp
+
+
+@pytest.mark.slow
+def test_secp_testnet_commits_blocks(tmp_path):
+    """A real 2-node multi-process net whose validators sign with
+    secp256k1 keys commits blocks and agrees (reference e2e KeyType)."""
+    from tendermint_tpu.e2e.sweep import run_manifest
+
+    m = {"chain_id": "secp-net", "validators": 2, "target_height": 4,
+         "key_type": "secp256k1", "base_port": 30400, "load_rate": 5}
+    asyncio.run(run_manifest(m, str(tmp_path / "net"), timeout=240))
