@@ -384,6 +384,48 @@ def main() -> None:
                     # one impl failing (e.g. compile OOM) must not cost
                     # the other's headline
                     _partial[f"field_impl_{impl}_error"] = str(e)[-300:]
+            # Round 4: the RLC batch equation (ops/ed25519_jax.verify_batch_rlc,
+            # shared-doubling Straus — the production default for device
+            # batches via crypto/batch.JAXBatchVerifier) competes for the
+            # headline alongside the per-row programs.
+            _stage_set("warmup-rlc-n%d" % N)
+            try:
+                ok = dev.verify_batch_rlc(pubs, msgs, sigs)
+                assert ok.all(), "rlc warmup verification failed"
+
+                _stage_set("timed-throughput-rlc")
+                times = []
+                rlc_pairs = []
+                for _ in range(TIMED_RUNS):
+                    t0 = time.perf_counter()
+                    ok = dev.verify_batch_rlc(pubs, msgs, sigs)
+                    dt = time.perf_counter() - t0
+                    times.append(dt)
+                    base_rate = run_baseline_for(dt)
+                    rlc_pairs.append((N / dt, base_rate))
+                    assert ok.all()
+                rate = N / statistics.median(times)
+                _partial["rlc_sigs_per_sec"] = round(rate, 1)
+
+                _stage_set("timed-commit-latency-rlc")
+                cn = min(COMMIT_N, N)
+                lat = []
+                for _ in range(max(TIMED_RUNS, 5)):
+                    t0 = time.perf_counter()
+                    ok = dev.verify_batch_rlc(pubs[:cn], msgs[:cn], sigs[:cn])
+                    lat.append(time.perf_counter() - t0)
+                    assert ok.all()
+                rlc_p50 = statistics.median(lat) * 1e3
+                _partial["rlc_commit_p50_ms"] = round(rlc_p50, 3)
+                if rate > ours:
+                    ours = rate
+                    p50_ms = rlc_p50
+                    headline_pairs = rlc_pairs
+                    _partial.update(
+                        {"value": round(ours, 1), "n": N, "field_impl": "rlc"}
+                    )
+            except Exception as e:  # noqa: BLE001
+                _partial["rlc_error"] = str(e)[-300:]
             if ours == 0.0:
                 raise RuntimeError("no field impl produced a device number")
             cn = min(COMMIT_N, N)

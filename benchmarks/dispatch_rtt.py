@@ -77,7 +77,18 @@ def main() -> int:
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
     import jax
 
-    jax.config.update("jax_platforms", args.platform)
+    platform = args.platform
+    if platform == "tpu":
+        # resolve to the axon tunnel plugin when that's how the TPU is
+        # attached (same aliasing as kernel_bench._force_platform)
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if "axon" in set(getattr(_xb, "_backend_factories", {}) or {}):
+                platform = "axon"
+        except Exception:
+            pass
+    jax.config.update("jax_platforms", platform)
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
 

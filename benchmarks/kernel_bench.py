@@ -40,7 +40,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def _force_platform(platform: str) -> None:
     from tendermint_tpu.utils.jaxcache import cache_dir
 
-    os.environ.setdefault("JAX_PLATFORMS", platform)
+    if platform == "tpu":
+        # this image's TPU is the axon tunnel: its PJRT plugin registers
+        # under platform name 'axon' (devices report .platform == 'tpu');
+        # a bare-metal TPU image registers 'tpu'.  Resolve to whichever
+        # is actually registered so --platform tpu works on both.
+        try:
+            from jax._src import xla_bridge as _xb
+
+            regs = set(getattr(_xb, "_backend_factories", {}) or {})
+            # both 'tpu' (libtpu, no local chip) and 'axon' (the tunnel)
+            # are registered in this image; only axon initializes
+            if "axon" in regs:
+                platform = "axon"
+        except Exception:
+            pass
+    os.environ["JAX_PLATFORMS"] = platform
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
     import jax
 
@@ -50,7 +65,10 @@ def _force_platform(platform: str) -> None:
 
 
 def _gen_batch(n: int, bad_every: int = 97):
-    """n signatures, ~1/bad_every invalid, deterministic."""
+    """n signatures, ~1/bad_every invalid, deterministic.  bad_every=0
+    disables corruption entirely (note: any value >= 8 corrupts at
+    least row 7 — i % bad_every == 7 first fires at i = 7 — and values
+    1..7 corrupt nothing, so pass 0 or >= 8)."""
     import hashlib
 
     from tendermint_tpu.crypto.keys import gen_priv_key
@@ -62,7 +80,7 @@ def _gen_batch(n: int, bad_every: int = 97):
         m = hashlib.sha256(i.to_bytes(4, "little")).digest()
         s = k.sign(m)
         ok = True
-        if i % bad_every == 7:
+        if bad_every and i % bad_every == 7:
             s = s[:-1] + bytes([s[-1] ^ 1])
             ok = False
         pubs.append(k.pub_key().bytes_())

@@ -109,7 +109,8 @@ def main() -> int:
         if ok:
             run_stage(
                 "kernel_bench",
-                [sys.executable, os.path.join(HERE, "kernel_bench.py"), "--all"],
+                [sys.executable, os.path.join(HERE, "kernel_bench.py"), "--all",
+                 "--platform", "tpu"],
                 os.path.join(HERE, "tpu_kernel_r04.json"),
                 1800,
             )

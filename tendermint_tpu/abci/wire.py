@@ -85,10 +85,9 @@ def _enc_val_update(vu: abci.ValidatorUpdate) -> bytes:
     pub_key is the NESTED PublicKey oneof (types.proto), same dialect as
     the state store's ABCIResponses codec, so key types survive the
     app boundary (secp256k1 validators included)."""
-    from tendermint_tpu.crypto.encoding import pub_key_proto_field
+    from tendermint_tpu.types.validator import pub_key_proto_bytes
 
-    field, raw = pub_key_proto_field(vu.pub_key)
-    pk = ProtoWriter().bytes_(field, raw, omit_empty=False).bytes_out()
+    pk = pub_key_proto_bytes(vu.pub_key)
     return (ProtoWriter().message(1, pk, always=True)
             .varint(2, vu.power, omit_zero=False).bytes_out())
 

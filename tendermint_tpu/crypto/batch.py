@@ -68,7 +68,12 @@ def _split_verify(pubs, msgs, sigs, ed_batch_fn) -> list[bool]:
     pubs are 33 — so no type tags ride the batch.  The ed25519 majority
     goes through `ed_batch_fn` (batched: native kernel or device);
     other rows verify individually."""
-    ed_idx = [i for i, p in enumerate(pubs) if len(p) == 32]
+    from tendermint_tpu.crypto.encoding import (
+        ED25519_PUB_SIZE,
+        SECP256K1_PUB_SIZE,
+    )
+
+    ed_idx = [i for i, p in enumerate(pubs) if len(p) == ED25519_PUB_SIZE]
     if len(ed_idx) == len(pubs):
         return ed_batch_fn(pubs, msgs, sigs)
     oks = [False] * len(pubs)
@@ -81,7 +86,7 @@ def _split_verify(pubs, msgs, sigs, ed_batch_fn) -> list[bool]:
     from tendermint_tpu.crypto.secp256k1 import PubKeySecp256k1
 
     for i, p in enumerate(pubs):
-        if len(p) == 33:
+        if len(p) == SECP256K1_PUB_SIZE:
             try:
                 oks[i] = PubKeySecp256k1(p).verify_signature(msgs[i], sigs[i])
             except ValueError:
@@ -253,13 +258,22 @@ class JAXBatchVerifier(_BaseBatch):
 
     def _ed_batch(self, pubs, msgs, sigs) -> list[bool]:
         """The ed25519-only core: device program (sharded on a mesh) or
-        host fallback below the dispatch threshold."""
+        host fallback below the dispatch threshold.
+
+        Device batches default to the RLC batch equation
+        (ops.ed25519_jax.verify_batch_rlc — shared-doubling Straus, the
+        same cofactored check as the reference's batch verifier), which
+        falls back internally to the exact per-row program whenever the
+        combined check fails, so verdicts stay bit-identical.
+        TM_TPU_RLC=0 pins the per-row program."""
         if len(pubs) < self._resolved_threshold(len(pubs)):
             return _ed.verify_batch_fast(pubs, msgs, sigs)
         if self._device_count() > 1:
             from tendermint_tpu.parallel import sharding
 
             oks = sharding.verify_batch_sharded(pubs, msgs, sigs)
+        elif os.environ.get("TM_TPU_RLC", "1") != "0":
+            oks = self._impl.verify_batch_rlc(pubs, msgs, sigs)
         else:
             oks = self._impl.verify_batch(pubs, msgs, sigs)
         return [bool(v) for v in oks]

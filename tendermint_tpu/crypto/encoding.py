@@ -55,14 +55,18 @@ def pub_key_json(pub) -> dict:
 def pub_key_from_json(doc: dict):
     """Strict decode: unknown type names fail loudly (a typo or future
     key type must never silently parse as a wrong-type ed25519 key with
-    wrong address/verify semantics)."""
-    raw = base64.b64decode(doc.get("value", ""))
+    wrong address/verify semantics).  The name → class mapping is the
+    tmjson registry's — the single home of the amino type names — with
+    a pubkey-protocol guard so a PrivKey envelope can never decode
+    here.  (The value encoding differs by dialect: RPC carries base64,
+    operator files hex, so only the mapping is shared.)"""
+    from tendermint_tpu.utils import tmjson
+
     name = doc.get("type")
-    if name == "tendermint/PubKeySecp256k1":
-        return PubKeySecp256k1(raw)
-    if name == "tendermint/PubKeyEd25519":
-        return PubKey(raw)
-    raise ValueError(f"unknown pubkey type {name!r}")
+    cls = tmjson.registered_class(name)
+    if cls is None or not hasattr(cls, "verify_signature"):
+        raise ValueError(f"unknown pubkey type {name!r}")
+    return cls(base64.b64decode(doc.get("value", "")))
 
 
 def pub_key_from_raw(raw: bytes):

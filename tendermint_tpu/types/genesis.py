@@ -22,6 +22,18 @@ def _tmjson():
 
     return tmjson
 
+
+def _decode_pub_key(doc):
+    """Envelope decode restricted to PUBLIC key classes: a genesis that
+    carries a PrivKey envelope (key-material leak, or a typo'd type
+    name) must fail loudly at load, not surface later as an
+    AttributeError on a Validator (same guard as privval/file_pv.load
+    and crypto/encoding.pub_key_from_json)."""
+    pub = _tmjson().decode(doc)
+    if not hasattr(pub, "verify_signature"):
+        raise ValueError(f"{doc.get('type')} is not a public key")
+    return pub
+
 MAX_CHAIN_ID_LEN = 50
 
 
@@ -149,7 +161,7 @@ class GenesisDoc:
             consensus_params=params,
             validators=[
                 GenesisValidator(
-                    pub_key=_tmjson().decode(v["pub_key"]),
+                    pub_key=_decode_pub_key(v["pub_key"]),
                     power=int(v["power"]),
                     name=v.get("name", ""),
                 )

@@ -77,6 +77,15 @@ def registered_name(cls: type) -> str | None:
     return _BY_CLASS.get(cls)
 
 
+def registered_class(name: str) -> type | None:
+    """The concrete class behind an amino type name (None when
+    unregistered) — lets other JSON dialects (e.g. the RPC base64
+    envelopes in crypto/encoding.py) share this registry's single
+    name ⇄ class mapping without duplicating it."""
+    entry = _BY_NAME.get(name)
+    return entry[0] if entry else None
+
+
 # ---------------------------------------------------------------------------
 # Standard registrations (reference: crypto/encoding/codec.go + privval
 # key files; names from the reference's amino registry)

@@ -1,0 +1,136 @@
+"""RLC batch verification: the cofactored random-linear-combination
+equation (ops/ed25519_jax.verify_core_rlc + verify_batch_rlc) must keep
+verdicts bit-identical to the pure ZIP-215 reference — the honest path
+takes the cheap shared-doubling program, every adversarial shape routes
+to the exact per-row fallback.
+
+Reference parity: the reference's batch verifier computes the same
+cofactored RLC check (crypto/ed25519/ed25519.go BatchVerifier via
+ed25519consensus); its callers also fall back to per-signature
+verification when the combined check fails.
+"""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as ref
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.ops import ed25519_jax as dev
+from tendermint_tpu.utils import host_prep
+
+IMPLS = ["int64", "f32"]
+
+
+@pytest.fixture(scope="module")
+def batch():
+    privs = [priv_key_from_seed(bytes([i + 1]) * 32) for i in range(12)]
+    pubs = [p.pub_key().bytes_() for p in privs]
+    msgs = [b"rlc-msg-%d" % i for i in range(12)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    return pubs, msgs, sigs
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_all_valid_passes_without_fallback(batch, impl):
+    pubs, msgs, sigs = batch
+    before = dict(dev.RLC_STATS)
+    ok = dev.verify_batch_rlc(pubs, msgs, sigs, impl=impl)
+    assert ok.tolist() == [True] * len(pubs)
+    assert dev.RLC_STATS["pass"] == before["pass"] + 1
+    assert dev.RLC_STATS["fallback"] == before["fallback"]
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_corrupted_sig_falls_back_exact(batch, impl):
+    pubs, msgs, sigs = batch
+    sigs = list(sigs)
+    sigs[5] = sigs[5][:-1] + bytes([sigs[5][-1] ^ 1])
+    before = dict(dev.RLC_STATS)
+    ok = dev.verify_batch_rlc(pubs, msgs, sigs, impl=impl)
+    assert ok.tolist() == ref.verify_batch_reference(pubs, msgs, sigs)
+    assert dev.RLC_STATS["fallback"] == before["fallback"] + 1
+
+
+def test_host_invalid_rows_excluded(batch):
+    """s >= L (ZIP-215 rule 1) and malformed sizes are host-detected:
+    they must come back False without breaking the valid rows, and the
+    batch must still pass the RLC equation (no fallback) because the
+    host zeroes their z_i."""
+    pubs, msgs, sigs = (list(x) for x in batch)
+    sigs[3] = sigs[3][:32] + ref.L.to_bytes(32, "little")  # s = L
+    sigs[7] = sigs[7][:40]  # malformed length
+    before = dict(dev.RLC_STATS)
+    ok = dev.verify_batch_rlc(pubs, msgs, sigs)
+    assert ok.tolist() == ref.verify_batch_reference(pubs, msgs, sigs)
+    assert dev.RLC_STATS["pass"] == before["pass"] + 1
+
+
+def test_zip215_edge_vectors_match_reference():
+    """Torsion-component keys and non-canonical encodings — the inputs
+    ZIP-215 admits that strict RFC-8032 rejects — through the RLC path."""
+    priv = priv_key_from_seed(b"\x07" * 32)
+    pub, msg = priv.pub_key().bytes_(), b"edge"
+    sig = priv.sign(msg)
+    pubs, msgs, sigs = [pub], [msg], [sig]
+    for t in ref.eight_torsion_points():
+        enc = ref.encode_point(t)
+        pubs.append(enc)
+        msgs.append(b"torsion")
+        sigs.append(b"\x01" * 32 + (5).to_bytes(32, "little"))
+    # non-canonical encodings of a small-order point as R
+    small = ref.eight_torsion_points()[1]
+    for enc in ref.noncanonical_encodings(small)[:2]:
+        pubs.append(pub)
+        msgs.append(b"noncanon-r")
+        sigs.append(enc + (7).to_bytes(32, "little"))
+    want = ref.verify_batch_reference(pubs, msgs, sigs)
+    got = dev.verify_batch_rlc(pubs, msgs, sigs)
+    assert got.tolist() == want
+
+
+@pytest.mark.parametrize("n", [1, 2, 5, 8, 9])
+def test_small_and_odd_sizes(n):
+    privs = [priv_key_from_seed(bytes([i + 31]) * 32) for i in range(n)]
+    pubs = [p.pub_key().bytes_() for p in privs]
+    msgs = [b"odd-%d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    assert dev.verify_batch_rlc(pubs, msgs, sigs).tolist() == [True] * n
+
+
+def test_empty_batch():
+    assert dev.verify_batch_rlc([], [], []).tolist() == []
+
+
+def test_native_rlc_scalars_match_python():
+    """Differential: C mulmod/accumulate vs Python big-int, including
+    excluded (z=0) rows and s/k inputs above L."""
+    lib = host_prep.load_lib()
+    if lib is None or not hasattr(lib, "tmed_rlc_scalars"):
+        pytest.skip("native edhost kernel unavailable")
+    rng = np.random.default_rng(11)
+    n = 130
+    z_rows = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+    z_rows[17] = 0
+    k_rows = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    s_rows = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+    zk_rows, c_row = host_prep.rlc_scalars_native(z_rows, k_rows, s_rows)
+    c = 0
+    for i in range(n):
+        z = int.from_bytes(z_rows[i].tobytes(), "little")
+        k = int.from_bytes(k_rows[i].tobytes(), "little")
+        s = int.from_bytes(s_rows[i].tobytes(), "little")
+        if z == 0:
+            assert not zk_rows[i].any()
+            continue
+        assert int.from_bytes(zk_rows[i].tobytes(), "little") == z * k % ref.L
+        c = (c + z * s) % ref.L
+    assert int.from_bytes(c_row.tobytes(), "little") == c
+
+
+def test_prepare_rlc_scalars_python_fallback(batch, monkeypatch):
+    """The Python big-int path (no native lib) must produce scalars the
+    device program accepts end-to-end."""
+    monkeypatch.setattr(host_prep, "rlc_scalars_native", lambda *a: None)
+    pubs, msgs, sigs = batch
+    ok = dev.verify_batch_rlc(pubs, msgs, sigs)
+    assert ok.tolist() == [True] * len(pubs)
