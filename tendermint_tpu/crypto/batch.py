@@ -260,19 +260,27 @@ class JAXBatchVerifier(_BaseBatch):
         """The ed25519-only core: device program (sharded on a mesh) or
         host fallback below the dispatch threshold.
 
-        Device batches default to the RLC batch equation
-        (ops.ed25519_jax.verify_batch_rlc — shared-doubling Straus, the
-        same cofactored check as the reference's batch verifier), which
-        falls back internally to the exact per-row program whenever the
-        combined check fails, so verdicts stay bit-identical.
-        TM_TPU_RLC=0 pins the per-row program."""
+        TM_TPU_RLC=1 routes device batches through the RLC batch
+        equation (ops.ed25519_jax.verify_batch_rlc — shared-doubling
+        Straus, the same cofactored check as the reference's batch
+        verifier, with exact per-row fallback so verdicts stay
+        bit-identical).  It is OFF by default: despite ~2x fewer
+        point-op flops, the per-window cross-batch reductions are
+        latency-bound on TPU and measured SLOWER than the uniform
+        per-row program at every accumulator width
+        (benchmarks/tpu_rlc_r04.jsonl, r4: 511-668 ms vs 313-338 ms at
+        16384; docs/tpu-verifier.md records the analysis)."""
         if len(pubs) < self._resolved_threshold(len(pubs)):
             return _ed.verify_batch_fast(pubs, msgs, sigs)
+        rlc = os.environ.get("TM_TPU_RLC", "0") == "1"
         if self._device_count() > 1:
             from tendermint_tpu.parallel import sharding
 
-            oks = sharding.verify_batch_sharded(pubs, msgs, sigs)
-        elif os.environ.get("TM_TPU_RLC", "1") != "0":
+            if rlc:
+                oks = sharding.verify_batch_rlc_sharded(pubs, msgs, sigs)
+            else:
+                oks = sharding.verify_batch_sharded(pubs, msgs, sigs)
+        elif rlc:
             oks = self._impl.verify_batch_rlc(pubs, msgs, sigs)
         else:
             oks = self._impl.verify_batch(pubs, msgs, sigs)

@@ -292,21 +292,44 @@ class _Core:
     # Accumulator width for the batch-axis reduction: every point op in
     # the window loop stays >= this many lanes (VPU-friendly), and the
     # compiler sees few distinct shapes.  The final P-wide accumulator
-    # collapses once, outside the loop.
-    REDUCE_LANES = 128
+    # collapses once, outside the loop.  Wider = shallower (lower
+    # latency) per-window trees but more doubling lanes; measured on the
+    # tunnel v5e, narrow trees are latency-bound (the 128-lane variant's
+    # 7 serial levels per window made RLC SLOWER than per-row despite
+    # ~2x fewer flops), so the default keeps every level wide.
+    REDUCE_LANES = int(os.environ.get("TM_TPU_RLC_LANES", "2048"))
 
-    def _pt_reduce_to_lanes(self, p):
-        """Fold a [N]-point down to a [P]-point (P = REDUCE_LANES, or N
-        if smaller) by pairwise tree reduction: log2(N/P) levels, each an
-        elementwise pt_add at >= P lanes."""
+    @staticmethod
+    def _reduced_width(n: int, target: int) -> int:
+        """The deterministic output width of _pt_reduce_to_lanes(n,
+        target) — n is NOT required to be a multiple of a power of two
+        (per-shard batches on 3/5/6-device meshes are odd)."""
+        while n > target:
+            n = n // 2 + (n % 2)
+        return n
+
+    def _pt_reduce_to_lanes(self, p, target: int | None = None):
+        """Fold a [N]-point down to a [_reduced_width(N, target)]-point
+        (target defaults to REDUCE_LANES) by pairwise tree reduction; an
+        odd leftover element rides along via concat so ANY N works."""
         fe = self.fe
+        if target is None:
+            target = self.REDUCE_LANES
         n = p.x.shape[0]
-        while n > self.REDUCE_LANES and n % 2 == 0:
+        while n > target:
             m = n // 2
             a = fe.Pt(p.x[:m], p.y[:m], p.z[:m], p.t[:m])
-            b = fe.Pt(p.x[m:], p.y[m:], p.z[m:], p.t[m:])
-            p = fe.pt_add(a, b)
-            n = m
+            b = fe.Pt(p.x[m : 2 * m], p.y[m : 2 * m], p.z[m : 2 * m], p.t[m : 2 * m])
+            s = fe.pt_add(a, b)
+            if n % 2:
+                s = fe.Pt(
+                    jnp.concatenate([s.x, p.x[2 * m :]], axis=0),
+                    jnp.concatenate([s.y, p.y[2 * m :]], axis=0),
+                    jnp.concatenate([s.z, p.z[2 * m :]], axis=0),
+                    jnp.concatenate([s.t, p.t[2 * m :]], axis=0),
+                )
+            p = s
+            n = m + (n % 2)
         return p
 
     def _table16(self, base):
@@ -317,7 +340,8 @@ class _Core:
             tbl.append(fe.pt_add(tbl[-1], base))
         return tbl
 
-    def verify_core_rlc(self, pub_rows, r_rows, zk_rows, z_rows, valid):
+    def verify_core_rlc(self, pub_rows, r_rows, zk_rows, z_rows, valid,
+                        *, shard_varying: bool = False):
         """Cofactored random-linear-combination batch equation:
 
             [8]( [c]B - sum_i [z_i k_i](A_i) - sum_i [z_i](R_i) ) == O
@@ -345,7 +369,7 @@ class _Core:
         128-bit z_i), valid [N] bool (host-side s<L / well-formedness;
         rows the host excluded carry z_i = 0).  Returns
         ((acc_x, acc_y, acc_z, acc_t) — the P-lane partial-sum
-        accumulator, P = min(REDUCE_LANES, N) — and prevalid [N] bool);
+        accumulator, P = _reduced_width(N, 128) — and prevalid [N] bool);
         the host finishes the equation (see the comment at the end).
         """
         fe = self.fe
@@ -369,7 +393,7 @@ class _Core:
         # P-wide accumulator: doublings and the per-window add stay
         # vector ops; the P partial sums (each over a distinct residue
         # class of the batch) collapse once after the loop.
-        lanes = min(self.REDUCE_LANES, int(pub_rows.shape[0]))
+        lanes = self._reduced_width(int(pub_rows.shape[0]), self.REDUCE_LANES)
 
         def body_hi(i, acc):
             # windows 63..32: only the 253-bit z*k digits contribute
@@ -386,8 +410,23 @@ class _Core:
             acc = fe.pt_dbl_n(acc, 4)
             return fe.pt_add(acc, self._pt_reduce_to_lanes(fe.pt_add(sel_a, sel_r)))
 
-        acc = lax.fori_loop(0, 32, body_hi, fe.pt_identity((lanes,)))
+        acc0 = fe.pt_identity((lanes,))
+        if shard_varying:
+            # under shard_map the fori_loop carry must be batch-varying
+            # like the loop outputs; derive a zero from the sharded
+            # input (XLA folds it).  Kept off the single-chip path so
+            # its compiled-program cache key is unchanged.
+            vzero = (jnp.take(zk_digits, 0, axis=-1)[:lanes, None] * 0).astype(
+                acc0.x.dtype
+            )
+            acc0 = fe.Pt(acc0.x + vzero, acc0.y + vzero,
+                         acc0.z + vzero, acc0.t + vzero)
+        acc = lax.fori_loop(0, 32, body_hi, acc0)
         acc = lax.fori_loop(32, 64, body_lo, acc)
+        # one-time fold to <=128 lanes so the host big-int finalization
+        # stays ~1 ms; a narrow serial chain ONCE (outside the 64-window
+        # loop) costs nothing measurable
+        acc = self._pt_reduce_to_lanes(acc, 128)
 
         # The final steps — collapsing the P lanes, [c]B, and the
         # cofactored identity test — are a rounding error of the batch's
@@ -602,6 +641,24 @@ def prepare_rlc_scalars(s_rows, k_rows, valid):
     return z_rows, zk_rows, c_row
 
 
+def finalize_rlc(acc_coords, c_row, impl: str) -> bool:
+    """Host finalization of the RLC equation (exact big-int): sum the
+    accumulator lanes (any count — a sharded run concatenates every
+    device's lanes), add [c]B, and apply the cofactored identity test.
+    ~1 ms at 128 lanes."""
+    fe = _field(impl)
+    ax, ay, az, at = (np.asarray(v) for v in acc_coords)
+    total = _ref.IDENTITY
+    for lane in range(ax.shape[0]):
+        p = tuple(
+            fe.int_from_limbs(coord[lane]) % _ref.P for coord in (ax, ay, az, at)
+        )
+        total = _ref.pt_add(total, p)
+    c = int.from_bytes(bytes(c_row), "little")
+    total = _ref.pt_add(total, _ref.scalar_mult(c, _ref.BASE))
+    return _ref.pt_equal(_ref.scalar_mult(8, total), _ref.IDENTITY)
+
+
 def verify_batch_rlc(pubs, msgs, sigs, impl: str | None = None) -> np.ndarray:
     """Batch verification via the cofactored RLC equation (one shared
     accumulator, no per-row doubling ladders), falling back to the exact
@@ -623,23 +680,8 @@ def verify_batch_rlc(pubs, msgs, sigs, impl: str | None = None) -> np.ndarray:
     pub_p, r_p, zk_p, z_p, valid_p = _pad_rows(
         n, b, pub_rows, r_rows, zk_rows, z_rows, valid
     )
-    (ax, ay, az, at), prevalid = _compiled_rlc(b, impl)(pub_p, r_p, zk_p, z_p, valid_p)
-
-    # host finalization (exact big-int): sum the P accumulator lanes,
-    # add [c]B, and apply the cofactored identity test
-    fe = _field(impl)
-    ax, ay, az, at = (np.asarray(v) for v in (ax, ay, az, at))
-    total = _ref.IDENTITY
-    for lane in range(ax.shape[0]):
-        p = tuple(
-            fe.int_from_limbs(coord[lane]) % _ref.P for coord in (ax, ay, az, at)
-        )
-        total = _ref.pt_add(total, p)
-    c = int.from_bytes(c_row.tobytes(), "little")
-    total = _ref.pt_add(total, _ref.scalar_mult(c, _ref.BASE))
-    rlc_ok = _ref.pt_equal(_ref.scalar_mult(8, total), _ref.IDENTITY)
-
-    if rlc_ok:
+    acc, prevalid = _compiled_rlc(b, impl)(pub_p, r_p, zk_p, z_p, valid_p)
+    if finalize_rlc(acc, c_row, impl):
         RLC_STATS["pass"] += 1
         return np.asarray(prevalid)[:n]
     RLC_STATS["fallback"] += 1

@@ -50,6 +50,56 @@ def sharded_verify_fn(mesh: Mesh):
     return jax.jit(_dev._verify_core, in_shardings=in_sh, out_shardings=batch)
 
 
+@functools.lru_cache(maxsize=8)
+def sharded_rlc_fn(mesh: Mesh, impl: str):
+    """shard_map of the RLC core: each device runs the IDENTICAL
+    single-chip program on its local batch shard (no cross-chip
+    collectives — the only fan-in is each device's P-lane accumulator,
+    ~61 KB, folded on host by ops.ed25519_jax.finalize_rlc).  out_specs
+    concatenate the per-device accumulator lanes along axis 0."""
+    import functools as _ft
+
+    from jax import shard_map
+
+    core = _ft.partial(_dev._core(impl).verify_core_rlc, shard_varying=True)
+    b2 = P("batch", None)
+    return jax.jit(
+        shard_map(
+            core,
+            mesh=mesh,
+            in_specs=(b2, b2, b2, b2, P("batch")),
+            out_specs=((b2, b2, b2, b2), P("batch")),
+        )
+    )
+
+
+def verify_batch_rlc_sharded(pubs, msgs, sigs, mesh: Mesh | None = None,
+                             impl: str | None = None) -> np.ndarray:
+    """RLC batch verification sharded over the mesh's batch axis, exact
+    per-row sharded fallback on combined-check failure (same contract
+    as ops.ed25519_jax.verify_batch_rlc)."""
+    n = len(pubs)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    if mesh is None:
+        mesh = make_mesh()
+    impl = impl or _dev.default_impl()
+    n_dev = mesh.devices.size
+    pub_rows, r_rows, s_rows, k_rows, valid = _dev.prepare_batch(pubs, msgs, sigs)
+    z_rows, zk_rows, c_row = _dev.prepare_rlc_scalars(s_rows, k_rows, valid)
+    b = max(_dev._bucket(n), pad_to_multiple(n, n_dev))
+    b = pad_to_multiple(b, n_dev)
+    pub_p, r_p, zk_p, z_p, valid_p = _dev._pad_rows(
+        n, b, pub_rows, r_rows, zk_rows, z_rows, valid
+    )
+    acc, prevalid = sharded_rlc_fn(mesh, impl)(pub_p, r_p, zk_p, z_p, valid_p)
+    if _dev.finalize_rlc(acc, c_row, impl):
+        _dev.RLC_STATS["pass"] += 1
+        return np.asarray(prevalid)[:n]
+    _dev.RLC_STATS["fallback"] += 1
+    return verify_batch_sharded(pubs, msgs, sigs, mesh=mesh)
+
+
 def verify_batch_sharded(pubs, msgs, sigs, mesh: Mesh | None = None) -> np.ndarray:
     """Like ops.ed25519_jax.verify_batch but sharded across all devices."""
     n = len(pubs)

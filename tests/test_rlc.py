@@ -101,6 +101,33 @@ def test_empty_batch():
     assert dev.verify_batch_rlc([], [], []).tolist() == []
 
 
+def test_odd_width_lane_reduction_exact():
+    """_pt_reduce_to_lanes must preserve the point SUM for any width,
+    including odd intermediate widths (per-shard batches on 3/5/6-device
+    meshes are odd — review r4 found the even-only fold crashed there)."""
+    import numpy as np
+
+    core = dev._core("int64")
+    fe = core.fe
+    rng = np.random.default_rng(3)
+    pts = [ref.scalar_mult(int(rng.integers(1, 1 << 30)), ref.BASE) for _ in range(7)]
+    arr = {c: np.stack([fe.limbs_from_int(p[i]) for p in pts])
+           for i, c in enumerate("xyzt")}
+    p = fe.Pt(arr["x"], arr["y"], arr["z"], arr["t"])
+    for target in (1, 2, 3):
+        red = core._pt_reduce_to_lanes(p, target)
+        assert red.x.shape[0] == core._reduced_width(7, target)
+        total = ref.IDENTITY
+        for lane in range(red.x.shape[0]):
+            total = ref.pt_add(total, tuple(
+                fe.int_from_limbs(np.asarray(c)[lane]) % ref.P
+                for c in (red.x, red.y, red.z, red.t)))
+        want = ref.IDENTITY
+        for q in pts:
+            want = ref.pt_add(want, q)
+        assert ref.pt_equal(total, want), target
+
+
 def test_native_rlc_scalars_match_python():
     """Differential: C mulmod/accumulate vs Python big-int, including
     excluded (z=0) rows and s/k inputs above L."""
