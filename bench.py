@@ -396,6 +396,15 @@ def main() -> None:
             # headline so each round's artifact re-records the comparison.
             _stage_set("warmup-rlc-n%d" % N)
             try:
+                # optional stage: never let it threaten the headline's
+                # spot inside the watchdog budget (cold-process compile
+                # loads can eat ~40 s; the int64 headline must be
+                # emitted whole)
+                if time.monotonic() - _t_start > 0.55 * DEADLINE:
+                    raise RuntimeError(
+                        "skipped: %.0fs elapsed of %.0fs budget"
+                        % (time.monotonic() - _t_start, DEADLINE)
+                    )
                 ok = dev.verify_batch_rlc(pubs, msgs, sigs)
                 assert ok.all(), "rlc warmup verification failed"
 
