@@ -1,0 +1,118 @@
+"""Layout hypothesis probe (round 4): is the verifier's [N, LIMBS]
+batch-major layout wasting TPU lanes?
+
+TPU memory tiles are (8 sublanes, 128 lanes) over a tensor's two minor
+dims.  The field layer stores limbs MINOR ([N, 15]) so elementwise ops
+occupy 15 of 128 lanes (~12%) — consistent with the measured kernel
+throughput sitting ~8x under VPU peak.  This probe times the SAME
+fe_mul chain (schoolbook + 19-fold + relaxation carries, the verifier's
+dominant op) in both layouts:
+
+  batch-major: ops on [N, 15]   (ops/fe25519.py as shipped)
+  limb-major:  ops on [15, N]   (limbs major, batch in lanes)
+
+Usage: python benchmarks/layout_probe.py [--n 16384] [--chain 64]
+       [--reps 5] [--platform tpu|cpu]
+Prints one JSON line with both timings and the ratio.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kernel_bench import _force_platform  # noqa: E402
+
+NLIMBS = 15
+LIMB_BITS = 17
+MASK = (1 << LIMB_BITS) - 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=16384)
+    ap.add_argument("--chain", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
+    args = ap.parse_args()
+
+    _force_platform(args.platform)
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import fe25519 as fe
+
+    def fe_mul_lm(a, b):
+        """fe25519.fe_mul transposed to limb-major [15, N]."""
+        n = a.shape[-1]
+        cols = jnp.zeros((2 * NLIMBS - 1, n), dtype=jnp.int64)
+        for i in range(NLIMBS):
+            cols = cols.at[i : i + NLIMBS].add(a[i][None, :] * b)
+        lo = cols[:NLIMBS].at[: NLIMBS - 1].add(19 * cols[NLIMBS:])
+        c = lo
+        for _ in range(3):
+            hi = c >> LIMB_BITS
+            c = (c & MASK) + jnp.concatenate([19 * hi[-1:], hi[:-1]], axis=0)
+        return c
+
+    def chain_bm(x, y):
+        for _ in range(args.chain):
+            x = fe.fe_mul(x, y)
+        return x
+
+    def chain_lm(x, y):
+        for _ in range(args.chain):
+            x = fe_mul_lm(x, y)
+        return x
+
+    rng = np.random.default_rng(5)
+    xb = rng.integers(0, 1 << 17, (args.n, NLIMBS), dtype=np.int64)
+    yb = rng.integers(0, 1 << 17, (args.n, NLIMBS), dtype=np.int64)
+
+    jb = jax.jit(chain_bm)
+    jl = jax.jit(chain_lm)
+
+    def bench(f, *inputs):
+        dp = [jax.device_put(v) for v in inputs]
+        t0 = time.perf_counter()
+        out = np.asarray(f(*dp))
+        compile_s = time.perf_counter() - t0
+        ts = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            out = np.asarray(f(*dp))
+            ts.append((time.perf_counter() - t0) * 1000.0)
+        return out, statistics.median(ts), compile_s
+
+    out_bm, bm_ms, bm_c = bench(jb, xb, yb)
+    out_lm, lm_ms, lm_c = bench(jl, xb.T.copy(), yb.T.copy())
+
+    # same math: results must agree exactly (limb vectors identical)
+    agree = bool((out_bm == out_lm.T).all())
+
+    import jax as _j
+
+    print(json.dumps({
+        "platform": _j.devices()[0].platform,
+        "n": args.n,
+        "chain": args.chain,
+        "batch_major_ms": round(bm_ms, 3),
+        "limb_major_ms": round(lm_ms, 3),
+        "limb_major_speedup": round(bm_ms / lm_ms, 3) if lm_ms else None,
+        "compile_bm_s": round(bm_c, 1),
+        "compile_lm_s": round(lm_c, 1),
+        "agree": agree,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
