@@ -98,10 +98,8 @@ def main() -> int:
     # same math: results must agree exactly (limb vectors identical)
     agree = bool((out_bm == out_lm.T).all())
 
-    import jax as _j
-
     print(json.dumps({
-        "platform": _j.devices()[0].platform,
+        "platform": jax.devices()[0].platform,
         "n": args.n,
         "chain": args.chain,
         "batch_major_ms": round(bm_ms, 3),

@@ -614,7 +614,7 @@ def cmd_abci_server(args) -> int:
     from tendermint_tpu.utils.log import new_logger
 
     logger = new_logger(level="info")
-    app = _builtin_app(args.app)
+    app = _builtin_app(args.app, snapshot_interval=args.snapshot_interval)
     if args.transport == "grpc":
         from tendermint_tpu.abci.grpc_app import GRPCAppServer
 
@@ -787,6 +787,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="kvstore | persistent_kvstore | counter")
     sp.add_argument("--addr", default="tcp://127.0.0.1:26658")
     sp.add_argument("--transport", default="socket", choices=["socket", "grpc"])
+    sp.add_argument("--snapshot-interval", type=int, default=0,
+                    help="app takes a state-sync snapshot every N heights "
+                         "(0 = never; external apps own their snapshot "
+                         "schedule, so the node's base.snapshot_interval "
+                         "does not apply to them)")
     sp.set_defaults(fn=cmd_abci_server)
 
     sp = sub.add_parser("wal2json", help="dump a consensus WAL as JSON lines")

@@ -392,7 +392,11 @@ class ConsensusReactor:
             except asyncio.CancelledError:
                 return
             except Exception as e:
-                self.logger.error("gossip data error", peer=ps.node_id[:8], err=str(e))
+                import traceback
+
+                self.logger.error("gossip data error", peer=ps.node_id[:8],
+                                  err=str(e),
+                                  tb=traceback.format_exc(limit=-3).replace("\n", " | "))
             await asyncio.sleep(self.gossip_sleep)
 
     async def _gossip_data_once(self, ps: PeerState) -> bool:
@@ -432,25 +436,33 @@ class ConsensusReactor:
         ):
             return await self._gossip_catchup(ps)
 
-        # 3. send the proposal itself
-        if rs.height == prs.height and rs.proposal is not None and not prs.proposal:
+        # 3. send the proposal itself.  Snapshot it BEFORE the first
+        # await: rs is the LIVE round state, and the consensus task can
+        # advance height/round (nulling rs.proposal) while the send is
+        # parked — re-reading rs.proposal after the await crashed this
+        # task with a None deref (seed-42 sweep logs).
+        proposal = rs.proposal
+        if rs.height == prs.height and proposal is not None and not prs.proposal:
+            pol = None
+            if proposal.pol_round >= 0 and rs.votes is not None:
+                prevotes = rs.votes.prevotes(proposal.pol_round)
+                if prevotes is not None:
+                    pol = BitArray.from_bools(prevotes.bit_array())
             await self.data_ch.send(
-                Envelope(message=ProposalMessage(rs.proposal), to=ps.node_id)
+                Envelope(message=ProposalMessage(proposal), to=ps.node_id)
             )
-            ps.apply_proposal(rs.proposal)
-            if rs.proposal.pol_round >= 0:
-                pol = rs.votes.prevotes(rs.proposal.pol_round)
-                if pol is not None:
-                    await self.data_ch.send(
-                        Envelope(
-                            message=ProposalPOLMessage(
-                                height=rs.height,
-                                proposal_pol_round=rs.proposal.pol_round,
-                                proposal_pol=BitArray.from_bools(pol.bit_array()),
-                            ),
-                            to=ps.node_id,
-                        )
+            ps.apply_proposal(proposal)
+            if pol is not None:
+                await self.data_ch.send(
+                    Envelope(
+                        message=ProposalPOLMessage(
+                            height=proposal.height,
+                            proposal_pol_round=proposal.pol_round,
+                            proposal_pol=pol,
+                        ),
+                        to=ps.node_id,
                     )
+                )
             return True
         return False
 
@@ -493,7 +505,11 @@ class ConsensusReactor:
             except asyncio.CancelledError:
                 return
             except Exception as e:
-                self.logger.error("gossip votes error", peer=ps.node_id[:8], err=str(e))
+                import traceback
+
+                self.logger.error("gossip votes error", peer=ps.node_id[:8],
+                                  err=str(e),
+                                  tb=traceback.format_exc(limit=-3).replace("\n", " | "))
             await asyncio.sleep(self.gossip_sleep)
 
     async def _gossip_votes_once(self, ps: PeerState) -> bool:
@@ -526,6 +542,8 @@ class ConsensusReactor:
         """reference gossipVotesForHeight (reactor.go:694)."""
         rs = self.cs.rs
         prs = ps.prs
+        if rs.votes is None:  # pre-start / height transition
+            return False
         # peer still in NewHeight: needs our last commit
         if prs.step == Step.NEW_HEIGHT and rs.last_commit is not None:
             if await self._pick_send_vote(ps, rs.last_commit):

@@ -97,8 +97,13 @@ def generate_manifest(rng: random.Random, index: int = 0) -> dict:
 
     # byzantine: at most one maverick (reference e2e manifests mark a
     # single misbehaving node per net), only with >= 4 validators so the
-    # honest supermajority keeps the chain live
-    if n_vals >= 4 and rng.random() < 0.5:
+    # honest supermajority keeps the chain live.  NEVER combined with
+    # statesync_join: the joiner is an ABSENT validator until well past
+    # 2*snapshot_interval, so maverick + joiner = 2 faults, over the
+    # BFT budget floor((n-1)/3) for every n < 7 — seed-42's gen-8 wedged
+    # permanently at the maverick height (3/5 prevotes < 2/3 with the
+    # joiner gated on a height the net could no longer reach).
+    if n_vals >= 4 and not statesync_join and rng.random() < 0.5:
         node = rng.randrange(1, hi_node)
         height = rng.randint(2, max(2, target - 3))
         manifest["misbehaviors"] = {str(node): {str(height): rng.choice(MISBEHAVIORS)}}
