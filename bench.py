@@ -389,6 +389,50 @@ def main() -> None:
                     # one impl failing (e.g. compile OOM) must not cost
                     # the other's headline
                     _partial[f"field_impl_{impl}_error"] = str(e)[-300:]
+            # Device-only 10k-commit latency (VERDICT r4 item 2): rows
+            # prepared and placed on device ONCE, then only the compiled
+            # chunk programs + the verdict-bit readback are timed — the
+            # number a deployment with a locally-attached TPU sees,
+            # reported alongside the tunnel-inclusive end-to-end p50.
+            _stage_set("timed-commit-device-only")
+            try:
+                import numpy as _np
+
+                import jax as _jax
+
+                impl0 = _partial.get("field_impl", "int64")
+                if impl0 in ("int64", "f32"):
+                    cn = min(COMMIT_N, N)
+                    rows = dev.prepare_batch(pubs[:cn], msgs[:cn], sigs[:cn])
+                    chunk = dev._chunk_size()
+                    plan = (dev.chunks_of(cn, chunk)
+                            if chunk and cn > chunk
+                            else [(0, cn, dev._bucket(cn))])
+                    placed = []
+                    for start, end, b in plan:
+                        sub = tuple(r[start:end] for r in rows)
+                        padded = dev._pad_rows(end - start, b, *sub)
+                        placed.append(
+                            ([_jax.device_put(_np.asarray(x)) for x in padded],
+                             b, end - start))
+                    for inputs, b, _m in placed:  # warm every bucket
+                        _np.asarray(dev._compiled(b, impl0)(*inputs))
+                    lat = []
+                    for _ in range(5):
+                        t0 = time.perf_counter()
+                        enq = [(dev._compiled(b, impl0)(*inputs), m)
+                               for inputs, b, m in placed]
+                        okd = _np.concatenate(
+                            [_np.asarray(o)[:m] for o, m in enq])
+                        lat.append(time.perf_counter() - t0)
+                        assert okd.all()
+                    _partial["commit10k_device_only_p50_ms"] = round(
+                        statistics.median(lat) * 1e3, 3)
+                    _partial["commit10k_chunk_plan"] = [
+                        [b, m] for _i, (_inp, b, m) in enumerate(placed)]
+            except Exception as e:  # noqa: BLE001
+                _partial["commit10k_device_only_error"] = str(e)[-300:]
+
             # Round 4: the RLC batch equation (ops/ed25519_jax.verify_batch_rlc,
             # shared-doubling Straus — an exactly-tested OPT-IN, measured
             # slower than per-row on r4 TPU and therefore NOT the

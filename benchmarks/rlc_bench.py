@@ -54,7 +54,9 @@ def main() -> int:
     z_rows, zk_rows, c_row = dev.prepare_rlc_scalars(s_rows, k_rows, valid)
     host_scalars_ms = (time.perf_counter() - t0) * 1000.0
 
-    core_rlc = dev._compiled_rlc(args.batch, args.impl)  # shared jit cache
+    # shared jit cache; TM_TPU_RLC_LANES resolved per call since r5
+    core_rlc = dev._compiled_rlc(args.batch, args.impl,
+                                 dev.rlc_reduce_lanes())
     core_row = jax.jit(dev._core(args.impl).verify_core)
 
     dp = jax.device_put

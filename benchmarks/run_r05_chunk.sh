@@ -1,0 +1,5 @@
+#!/bin/bash
+set -x
+cd /root/repo
+python benchmarks/chunk_probe.py --platform tpu --reps 5 --out benchmarks/tpu_kernel_r05.jsonl
+echo DONE

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Round-5 TPU probe sweep, pass 2: floor-aware scaled chains + pallas +
+# the one-hot comb (TM_TPU_BASE_MXU) which pass 1 mislabeled (it ran the
+# standard path: kernel_bench gained explicit base_mxu plumbing mid-sweep).
+set -x
+cd /root/repo
+python benchmarks/roofline_probe.py --all --skip-census --platform tpu --out benchmarks/tpu_kernel_r05.jsonl
+TM_TPU_BASE_MXU=1 python benchmarks/kernel_bench.py --impl int64 --batch 16384 --platform tpu >> benchmarks/tpu_kernel_r05.jsonl
+echo DONE

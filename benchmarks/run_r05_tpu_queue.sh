@@ -1,0 +1,8 @@
+#!/bin/bash
+set -x
+cd /root/repo
+# prewarm + measure the new 10240 north-star bucket (single dispatch)
+python benchmarks/kernel_bench.py --impl int64 --batch 10240 --platform tpu >> benchmarks/tpu_kernel_r05.jsonl
+# TPU-in-the-loop consensus nets (VERDICT r4 item 4)
+python benchmarks/tpu_e2e_probe.py
+echo QUEUE_DONE

@@ -1,0 +1,317 @@
+"""TPU-in-the-loop consensus (VERDICT r4 item 4): live nets whose crypto
+backend dispatches to the real chip, proving consensus liveness holds
+with real device RTT, the dispatch threshold, and compile/cache behavior
+in the live path (SURVEY §7 hard part 2).
+
+Two nets, both recorded in the artifact:
+
+A. **process net** — 4 node processes, 500-validator genesis (the
+   config-5 shape), TM_TPU_CRYPTO_BACKEND=jax on every node.  A subtle
+   and important truth about this shape: the 496 offline validators'
+   CommitSig slots are ABSENT — they carry no signature and are
+   (correctly) never verified — so each commit contributes 4 real
+   signatures, not 500.  TM_TPU_CPU_THRESHOLD=4 therefore pins the
+   dispatch threshold so the per-height commit verification genuinely
+   rides the chip (~100 ms tunnel RTT in the hot path each height);
+   through this tunnel the MEASURED threshold would route such batches
+   to the host, which is the right production policy and exactly what
+   the artifact's "routed" baseline rows show.
+B. **in-proc net** — 16 live validators in one process (memory
+   transport, full consensus state machines): commits carry 16 REAL
+   signatures; threshold 12 routes them (and large vote-gossip ticks)
+   to the device.  Same-process `crypto.batch._DEVICE_DISPATCHES` gives
+   exact dispatch counts.
+
+Evidence of chip use: each node's one-time "tm-tpu: first device
+dispatch" stderr line (process net), and the in-proc dispatch counter.
+
+Artifact: TPU_E2E_r05.json at the repo root.
+
+Usage: python benchmarks/tpu_e2e_probe.py [--out TPU_E2E_r05.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "benchmarks"))
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+
+def prewarm(n_sigs: int) -> dict:
+    """Compile the commit bucket for this process AND the disk cache the
+    node processes will hit; returns timing evidence."""
+    import numpy as np
+
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+    from tendermint_tpu.ops import ed25519_jax as dev
+
+    privs = [priv_key_from_seed(bytes([1 + (i % 250)]) * 32)
+             for i in range(min(64, n_sigs))]
+    pubs, msgs, sigs = [], [], []
+    for i in range(n_sigs):
+        k = privs[i % len(privs)]
+        m = b"prewarm-%d" % i
+        pubs.append(k.pub_key().bytes_())
+        msgs.append(m)
+        sigs.append(k.sign(m))
+    t0 = time.perf_counter()
+    ok = dev.verify_batch(pubs, msgs, sigs)
+    warm_s = time.perf_counter() - t0
+    assert np.asarray(ok).all()
+    t0 = time.perf_counter()
+    dev.verify_batch(pubs, msgs, sigs)
+    steady_s = time.perf_counter() - t0
+    import jax
+
+    return {"bucket": dev._bucket(n_sigs), "first_call_s": round(warm_s, 2),
+            "steady_call_s": round(steady_s, 3),
+            "backend": jax.default_backend()}
+
+
+def _safe_max_height(net) -> int:
+    """Max RPC height across nodes; a node mid-device-dispatch (or
+    starved on this 1-core box) can miss the 5 s RPC window — skip it
+    rather than kill the probe."""
+    hs = []
+    for n in net.nodes:
+        try:
+            hs.append(n.height())
+        except Exception:  # noqa: BLE001
+            pass
+    return max(hs) if hs else -1
+
+
+def _intervals(samples: list[tuple[float, int]]) -> list[float]:
+    t_by_height: dict[int, float] = {}
+    for t, h in samples:
+        t_by_height.setdefault(h, t)
+    hs = sorted(t_by_height)
+    return [round(t_by_height[b] - t_by_height[a], 2)
+            for a, b in zip(hs, hs[1:])]
+
+
+async def run_process_net(genesis_vals: int) -> dict:
+    from run_baseline import _widen_genesis
+
+    from tendermint_tpu.e2e.runner import Testnet
+
+    root = tempfile.mkdtemp(prefix="tmtpu-tpue2e-")
+    manifest = {
+        "chain_id": "tpu-e2e",
+        "validators": 4,
+        "base_port": int(os.environ.get("TM_TPU_E2E_BASE_PORT", "30180")),
+        "env": {
+            "TM_TPU_CRYPTO_BACKEND": "jax",
+            "TM_TPU_CPU_THRESHOLD": "4",
+        },
+    }
+    net = Testnet(manifest, root)
+    doc: dict = {"net": "process-4node",
+                 "env": manifest["env"], "genesis_vals": genesis_vals}
+    try:
+        net.setup()
+        _widen_genesis(root, 4, genesis_vals)
+        t_start = time.monotonic()
+        net.start()
+        await net.wait_for_height(2, timeout=600.0)
+        doc["time_to_height2_s"] = round(time.monotonic() - t_start, 1)
+
+        samples: list[tuple[float, int]] = []
+
+        async def sampler():
+            while True:
+                h = await asyncio.to_thread(_safe_max_height, net)
+                if h >= 0:
+                    samples.append((time.monotonic(), h))
+                await asyncio.sleep(0.5)
+
+        s_task = asyncio.create_task(sampler())
+        accepted = await net.load(total_txs=100, rate=10)
+
+        # keep the net running until every node's device warmup has
+        # resolved and its first REAL dispatch landed (the readiness
+        # gate routes to the host for the first ~40-60 s of PJRT init;
+        # a short net would tear down before any chip dispatch)
+        def dispatch_evidence() -> dict:
+            ev = {}
+            for i in range(4):
+                log_path = os.path.join(root, f"node{i}", "node.log")
+                lines = []
+                try:
+                    with open(log_path) as f:
+                        lines = [ln.strip() for ln in f
+                                 if "tm-tpu: first device dispatch" in ln]
+                except OSError:
+                    pass
+                ev[f"node{i}"] = lines
+            return ev
+
+        t_wait = time.monotonic()
+        while time.monotonic() - t_wait < 300.0:
+            if all(dispatch_evidence().values()):
+                break
+            await asyncio.sleep(5.0)
+        # a few more heights WITH the device in the loop
+        target = _safe_max_height(net) + 4
+        await net.wait_for_height(target, timeout=600.0)
+        s_task.cancel()
+
+        h_final = min(n.height() for n in net.nodes)
+        net.check_blocks_identical(h_final)
+        net.check_app_hashes_agree()
+        iv = _intervals(samples)
+        doc.update({
+            "txs_accepted": accepted,
+            "final_height_min": h_final,
+            "block_interval_p50_s": round(statistics.median(iv), 2) if iv else None,
+            "block_interval_max_s": max(iv) if iv else None,
+            "intervals_s": iv,
+            "blocks_identical": True,
+            "app_hashes_agree": True,
+        })
+    finally:
+        rcs = net.stop()
+        doc["exit_codes"] = rcs
+        evidence = {}
+        for i in range(4):
+            log_path = os.path.join(root, f"node{i}", "node.log")
+            lines = []
+            try:
+                with open(log_path) as f:
+                    lines = [ln.strip() for ln in f
+                             if "tm-tpu: first device dispatch" in ln]
+            except OSError:
+                pass
+            evidence[f"node{i}"] = lines
+        doc["device_dispatch_evidence"] = evidence
+        doc["all_nodes_dispatched_device"] = all(
+            evidence[f"node{i}"] for i in range(4))
+        if doc.get("blocks_identical"):
+            shutil.rmtree(root, ignore_errors=True)
+        else:
+            doc["kept_root"] = root  # keep node logs for debugging
+    return doc
+
+
+async def run_inproc_net(n_vals: int, target_height: int) -> dict:
+    from test_multinode import make_net, start_mesh, wait_all_height
+
+    from tendermint_tpu.crypto import batch
+
+    nodes = make_net(n_vals)
+    doc: dict = {"net": f"inproc-{n_vals}val",
+                 "threshold": os.environ.get("TM_TPU_CPU_THRESHOLD")}
+    d0 = batch._DEVICE_DISPATCHES
+    samples: list[tuple[float, int]] = []
+    try:
+        await start_mesh(nodes)
+
+        async def sampler():
+            while True:
+                samples.append((time.monotonic(),
+                                max(n.block_store.height() for n in nodes)))
+                await asyncio.sleep(0.5)
+
+        s_task = asyncio.create_task(sampler())
+        try:
+            await wait_all_height(nodes, target_height, timeout=600.0)
+        except TimeoutError:
+            # record how far it got — a partial result is still data
+            doc["timeout"] = True
+        s_task.cancel()
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+    upto = min(n.block_store.height() for n in nodes)
+    forks = []
+    for h in range(1, upto + 1):
+        hashes = {n.block_store.load_block(h).hash() for n in nodes}
+        if len(hashes) != 1:
+            forks.append(h)
+    iv = _intervals(samples)
+    doc.update({
+        "final_height_min": upto,
+        "device_dispatches": batch._DEVICE_DISPATCHES - d0,
+        "block_interval_p50_s": round(statistics.median(iv), 2) if iv else None,
+        "block_interval_max_s": max(iv) if iv else None,
+        "intervals_s": iv,
+        "forks": forks,
+    })
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--genesis-vals", type=int, default=500)
+    # 8 in-proc validators: 16 shared one asyncio loop on this 1-core
+    # box and the ~130 ms tunnel dispatches stacked past the consensus
+    # timeout budget (recorded timeout in the first run); 8 keeps the
+    # commit batches (7-8 sigs) on the device at threshold 6 while the
+    # round fits its timeouts
+    ap.add_argument("--inproc-vals", type=int, default=8)
+    ap.add_argument("--inproc-height", type=int, default=4)
+    ap.add_argument("--out", default=os.path.join(_ROOT, "TPU_E2E_r05.json"))
+    args = ap.parse_args()
+
+    # backend selection for the in-proc phase (and this process's prewarm)
+    os.environ["TM_TPU_CRYPTO_BACKEND"] = "jax"
+    os.environ["TM_TPU_CPU_THRESHOLD"] = "6"
+    from tendermint_tpu.crypto.batch import set_default_backend
+
+    set_default_backend("jax")
+
+    doc = {"generated_unix": int(time.time()),
+           "prewarm": {"n8": prewarm(8),
+                       "n16": prewarm(16)}}
+    # mark THIS process's device ready (the in-proc net runs here; the
+    # readiness gate otherwise routes its first commits to the host
+    # while the warmup worker runs)
+    from tendermint_tpu.crypto import batch
+
+    batch.start_device_warmup()
+    batch._DEVICE_READY.wait(timeout=300)
+    doc["device_ready"] = batch.device_ready()
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+    flush()
+    try:
+        doc["process_net"] = asyncio.run(run_process_net(args.genesis_vals))
+    except Exception as e:  # noqa: BLE001 — partial artifact beats none
+        doc["process_net"] = {"error": str(e)[-400:]}
+    flush()
+    try:
+        doc["inproc_net"] = asyncio.run(
+            run_inproc_net(args.inproc_vals, args.inproc_height))
+    except Exception as e:  # noqa: BLE001 — partial artifact beats none
+        doc["inproc_net"] = {"error": str(e)[-400:]}
+    flush()
+    ok = (doc["process_net"].get("all_nodes_dispatched_device", False)
+          and doc["inproc_net"].get("device_dispatches", 0) > 0
+          and not doc["inproc_net"].get("forks"))
+    print(json.dumps({"ok": ok, "out": args.out,
+                      "proc_p50_s": doc["process_net"].get("block_interval_p50_s"),
+                      "inproc_p50_s": doc["inproc_net"].get("block_interval_p50_s"),
+                      "inproc_dispatches": doc["inproc_net"].get("device_dispatches")}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

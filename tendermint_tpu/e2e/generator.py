@@ -9,16 +9,17 @@ Dimensions (each citing the reference generator's equivalent in
 test/e2e/generator/generate.go testnetCombinations):
   validators / target_height / load_rate   — topology + load
   perturb (kill/pause/restart)             — perturbations
-  misbehaviors (all 5 maverick hooks)      — misbehaviors
-  abci builtin/socket/grpc                 — ABCIProtocol
+  misbehaviors (all 6 maverick hooks)      — misbehaviors
+  abci builtin/socket/unix/grpc            — ABCIProtocol (r5: unix — TSP
+                                             over AF_UNIX, abci/socket.py)
   db_backend sqlite/native/memdb           — database (config_overrides)
   statesync_join                           — state_sync node mode
   key_type ed25519/secp256k1               — KeyType (r4: secp256k1 is a
                                              first-class consensus key)
 
 Not covered (audited waivers): sr25519 validator keys (no vetted
-schnorrkel implementation in-image — PARITY.md), ABCI-over-unix-socket
-(tcp only), and per-node version mixing (single binary).
+schnorrkel implementation in-image — PARITY.md) and per-node version
+mixing (single binary).
 """
 
 from __future__ import annotations
@@ -33,8 +34,9 @@ MISBEHAVIORS = (
     "amnesia",
     "nil-prevote",
     "nil-precommit",
+    "ignore-proposal",
 )
-ABCI_MODES = ("builtin", "builtin", "socket", "grpc")  # weighted to in-proc
+ABCI_MODES = ("builtin", "builtin", "socket", "unix", "grpc")  # weighted in-proc
 DB_BACKENDS = ("sqlite", "sqlite", "native", "memdb")
 
 

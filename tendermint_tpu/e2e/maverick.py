@@ -17,6 +17,11 @@ Misbehaviors:
     honest peers must stay safe because their own locks hold).
   * "nil-prevote": prevote nil regardless of the proposal.
   * "nil-precommit": precommit nil regardless of the polka.
+  * "ignore-proposal": drop every proposal received at the height — the
+    receive-side hook (reference misbehavior.go ReceiveProposal, the 6th
+    hook point of its Misbehavior struct); the maverick never completes
+    the proposal, prevotes nil, and the honest majority must keep
+    committing without it.
 """
 
 from __future__ import annotations
@@ -32,6 +37,7 @@ MISBEHAVIORS = (
     "amnesia",
     "nil-prevote",
     "nil-precommit",
+    "ignore-proposal",
 )
 
 
@@ -47,12 +53,21 @@ class MaverickConsensusState(ConsensusState):
         # reference maverick reactor broadcasts directly too).
         self.broadcast_vote = None
         self.amnesia_prevotes = 0  # diagnostics: times the lock was ignored
+        self.ignored_proposals = 0  # diagnostics: proposals dropped
         for h, name in self.misbehaviors.items():
             if name not in MISBEHAVIORS:
                 raise ValueError(f"unknown misbehavior {name!r} at height {h}")
 
     def _active(self) -> str | None:
         return self.misbehaviors.get(self.rs.height)
+
+    def set_proposal(self, proposal) -> None:
+        if self._active() == "ignore-proposal":
+            self.ignored_proposals += 1
+            self.logger.info("maverick: dropping received proposal",
+                             height=self.rs.height, round=self.rs.round)
+            return
+        super().set_proposal(proposal)
 
     def do_prevote(self, height: int, round_: int) -> None:
         if self._active() == "amnesia" and self.rs.proposal_block is not None:

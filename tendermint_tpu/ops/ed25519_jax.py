@@ -30,9 +30,12 @@ Field backends (TM_TPU_FIELD_IMPL, or the `impl=` argument):
 The curve/scalar pipeline below is field-agnostic; both backends share it and
 both are differentially tested against the pure ZIP-215 reference.
 
-Static batch sizes: inputs are padded to power-of-two buckets so XLA compiles
-one program per bucket (first call per bucket pays compile; consensus reuses
-steady-state buckets).
+Static batch sizes: inputs are padded to a bucket ladder (powers of two up
+to 64, then 3*2^(k-1) interleaved: 96, 128, 192, ...) so XLA compiles one
+program per bucket (first call per bucket pays compile; consensus reuses
+steady-state buckets) with worst-case padding 1.33x; batches over
+TM_TPU_CHUNK dispatch as a pipeline of sub-batches (host prep overlaps
+device execution — see verify_batch).
 """
 
 from __future__ import annotations
@@ -85,9 +88,15 @@ def _base_point_table() -> list[list[tuple[int, int, int, int]]]:
 # Opt-in MXU path for the fixed-base scalar mult: selection from a SHARED
 # constant table is the one shape in this kernel with a genuine shared
 # contraction dimension (docs/tpu-verifier.md "The MXU question, answered
-# with arithmetic" names it as the open avenue).  Unproven on hardware
-# until the tunnel yields a measurement — default off.
-_BASE_MXU = os.environ.get("TM_TPU_BASE_MXU", "0") == "1"
+# with arithmetic" names it as the open avenue).  Default off; resolved
+# per call (not at import) and, in production paths, gated behind the
+# golden-batch self-check below — the sibling TM_TPU_FE_MXU path was
+# measured returning WRONG verdicts on real TPU (Precision.HIGHEST f32
+# matmul exactness does not hold there), so no opt-in kernel flag is
+# trusted until it reproduces known verdicts on the device it runs on
+# (VERDICT r4 item 6).
+def _base_mxu_requested() -> bool:
+    return os.environ.get("TM_TPU_BASE_MXU", "0") == "1"
 
 
 @functools.cache
@@ -297,7 +306,12 @@ class _Core:
     # tunnel v5e, narrow trees are latency-bound (the 128-lane variant's
     # 7 serial levels per window made RLC SLOWER than per-row despite
     # ~2x fewer flops), so the default keeps every level wide.
-    REDUCE_LANES = int(os.environ.get("TM_TPU_RLC_LANES", "2048"))
+    # This class attribute is only the DEFAULT for direct verify_core_rlc
+    # calls; the production entry points (verify_batch_rlc and
+    # parallel.sharding) resolve TM_TPU_RLC_LANES per call via
+    # rlc_reduce_lanes() and key their compiled-program caches on it
+    # (ADVICE r4 #3: the env var must not bind at import time).
+    REDUCE_LANES = 2048
 
     @staticmethod
     def _reduced_width(n: int, target: int) -> int:
@@ -341,15 +355,18 @@ class _Core:
         return tbl
 
     def verify_core_rlc(self, pub_rows, r_rows, zk_rows, z_rows, valid,
-                        *, shard_varying: bool = False):
+                        *, shard_varying: bool = False,
+                        reduce_lanes: int | None = None):
         """Cofactored random-linear-combination batch equation:
 
             [8]( [c]B - sum_i [z_i k_i](A_i) - sum_i [z_i](R_i) ) == O
             with c = sum_i z_i s_i mod L, z_i random 128-bit
 
-        — the same batch equation the reference's batch verifier uses
-        (reference: crypto/ed25519/ed25519.go BatchVerifier via
-        ed25519consensus, which implements the cofactored RLC check).
+        — the standard ZIP-215 cofactored batch equation, as implemented
+        by the ed25519consensus library's upstream VerifyBatch (the
+        library whose per-signature Verify the reference calls at
+        crypto/ed25519/ed25519.go:149-156; the reference itself never
+        batches — crypto/batch.py documents that).
 
         The TPU win over the per-row program: the variable-base ladders'
         ~252 doublings per signature collapse into 4 doublings per
@@ -373,6 +390,8 @@ class _Core:
         the host finishes the equation (see the comment at the end).
         """
         fe = self.fe
+        if reduce_lanes is None:
+            reduce_lanes = self.REDUCE_LANES
         pub_bits = self._bits_of(pub_rows)
         r_bits = self._bits_of(r_rows)
         a_pt, ok_a = self.decompress(self._limbs_of(pub_bits[..., :255]), pub_bits[..., 255])
@@ -393,14 +412,14 @@ class _Core:
         # P-wide accumulator: doublings and the per-window add stay
         # vector ops; the P partial sums (each over a distinct residue
         # class of the batch) collapse once after the loop.
-        lanes = self._reduced_width(int(pub_rows.shape[0]), self.REDUCE_LANES)
+        lanes = self._reduced_width(int(pub_rows.shape[0]), reduce_lanes)
 
         def body_hi(i, acc):
             # windows 63..32: only the 253-bit z*k digits contribute
             w = 63 - i
             sel = self._select16(jnp.take(zk_digits, w, axis=-1), tbl_a)
             acc = fe.pt_dbl_n(acc, 4)
-            return fe.pt_add(acc, self._pt_reduce_to_lanes(sel))
+            return fe.pt_add(acc, self._pt_reduce_to_lanes(sel, reduce_lanes))
 
         def body_lo(i, acc):
             # windows 31..0: z*k and the 128-bit z digits both contribute
@@ -408,7 +427,10 @@ class _Core:
             sel_a = self._select16(jnp.take(zk_digits, w, axis=-1), tbl_a)
             sel_r = self._select16(jnp.take(z_digits, w, axis=-1), tbl_r)
             acc = fe.pt_dbl_n(acc, 4)
-            return fe.pt_add(acc, self._pt_reduce_to_lanes(fe.pt_add(sel_a, sel_r)))
+            return fe.pt_add(
+                acc,
+                self._pt_reduce_to_lanes(fe.pt_add(sel_a, sel_r), reduce_lanes),
+            )
 
         acc0 = fe.pt_identity((lanes,))
         if shard_varying:
@@ -439,10 +461,15 @@ class _Core:
         # [8]·==O test.
         return acc.astuple(), prevalid
 
-    def verify_core(self, pub_rows, r_rows, s_rows, k_rows, valid):
+    def verify_core(self, pub_rows, r_rows, s_rows, k_rows, valid,
+                    *, base_mxu: bool = False):
         """Inputs are PACKED byte rows ([N,32] uint8 each) — unpacking to
         bits/limbs happens on device, so the host→device transfer is 128
-        bytes/signature instead of ~2.3KB of pre-expanded tensors."""
+        bytes/signature instead of ~2.3KB of pre-expanded tensors.
+
+        base_mxu selects the opt-in one-hot-comb fixed-base path; it is
+        a trace-time constant, so compiled-program caches must key on it
+        (_compiled does)."""
         fe = self.fe
         pub_bits = self._bits_of(pub_rows)
         r_bits = self._bits_of(r_rows)
@@ -452,7 +479,7 @@ class _Core:
         k_digits = self._nibbles_of(k_rows)
         a_pt, ok_a = self.decompress(y_a, sign_a)
         r_pt, ok_r = self.decompress(y_r, sign_r)
-        sb = (self._scalarmul_base_mxu(s_rows) if _BASE_MXU
+        sb = (self._scalarmul_base_mxu(s_rows) if base_mxu
               else self._scalarmul_base(s_digits))
         w = fe.pt_add(sb, self._scalarmul_var(k_digits, fe.pt_neg(a_pt)))
         q = fe.pt_add(w, fe.pt_neg(r_pt))
@@ -471,16 +498,45 @@ def _verify_core(pub_rows, r_rows, s_rows, k_rows, valid):
 
 
 @functools.cache
-def _compiled(n: int, impl: str | None = None):
+def _compiled(n: int, impl: str | None = None, base_mxu: bool = False):
     # NOTE: callers that care about TM_TPU_FIELD_IMPL changing mid-process
     # must resolve the impl themselves (verify_batch does); this default
-    # resolves once per (n, None) cache entry.
-    return jax.jit(_core(impl or default_impl()).verify_core)
+    # resolves once per (n, None) cache entry.  base_mxu is part of the
+    # cache key because it is baked into the trace.
+    core = _core(impl or default_impl())
+
+    # a named wrapper, NOT functools.partial: jit derives the HLO module
+    # name from __name__, and the persistent compile cache keys on it —
+    # a partial would rename every program and cold-recompile the world
+    def verify_core(pub_rows, r_rows, s_rows, k_rows, valid):
+        return core.verify_core(pub_rows, r_rows, s_rows, k_rows, valid,
+                                base_mxu=base_mxu)
+
+    return jax.jit(verify_core)
+
+
+def rlc_reduce_lanes() -> int:
+    """TM_TPU_RLC_LANES resolved per call (ADVICE r4 #3 — the companion
+    TM_TPU_RLC flag is read per call in crypto/batch.py, and an env var
+    that silently binds at import is a footgun in tests/benchmarks)."""
+    try:
+        return int(os.environ.get("TM_TPU_RLC_LANES", "2048"))
+    except ValueError:
+        return 2048
 
 
 @functools.cache
-def _compiled_rlc(n: int, impl: str):
-    return jax.jit(_core(impl).verify_core_rlc)
+def _compiled_rlc(n: int, impl: str, reduce_lanes: int = 2048):
+    # reduce_lanes is baked into the trace -> part of the cache key.
+    # Named wrapper (not partial) to keep the HLO module name stable —
+    # see _compiled.
+    core = _core(impl)
+
+    def verify_core_rlc(pub_rows, r_rows, zk_rows, z_rows, valid):
+        return core.verify_core_rlc(pub_rows, r_rows, zk_rows, z_rows,
+                                    valid, reduce_lanes=reduce_lanes)
+
+    return jax.jit(verify_core_rlc)
 
 
 # ---------------------------------------------------------------------------
@@ -552,10 +608,49 @@ def prepare_batch(pubs, msgs, sigs):
 
 
 def _bucket(n: int) -> int:
+    """Smallest compiled bucket >= n: powers of two up to 64, then
+    3*2^(k-1) rungs interleaved (96, 128, 192, ...), then 5*2^(k-2)
+    rungs too from 320 up (320, 384, 512, 640, 768, 1024, ...), so
+    worst-case padding drops from 2.0x to 1.33x (<=256) / 1.25x above.
+    The north-star 10,000-sig commit runs the 10,240 bucket (1.024x
+    padded) instead of 16,384 (1.64x) — VERDICT r4 item 2.  Each bucket
+    compiles once (persistent XLA cache); steady-state consensus reuses
+    a handful."""
     b = 8
     while b < n:
+        if b >= 256 and 5 * (b // 4) >= n:
+            return 5 * (b // 4)
+        if b >= 64 and 3 * (b // 2) >= n:
+            return 3 * (b // 2)
         b *= 2
     return b
+
+
+def _chunk_size() -> int:
+    """TM_TPU_CHUNK: sub-batch size for pipelined large-batch dispatch.
+    Default 0 (disabled), BY MEASUREMENT: through the tunnel each extra
+    dispatch costs ~45-120 ms even with every chunk program enqueued
+    before the first verdict read (benchmarks/tpu_kernel_r05.jsonl
+    "chunk" probes: 10k commit single 346 ms e2e vs 4k-chunks 396 ms vs
+    2k-chunks 512 ms), and the 1.25x bucket ladder already holds padding
+    to <=2.4%, so the pipeline's host-prep overlap (~13 ms) cannot pay
+    for even one extra dispatch.  Set TM_TPU_CHUNK=4096 on a
+    locally-attached deployment (dispatch ~3 ms) to re-enable.
+    Resolved per call."""
+    try:
+        return int(os.environ.get("TM_TPU_CHUNK", "0"))
+    except ValueError:
+        return 0
+
+
+def chunks_of(n: int, chunk: int) -> list[tuple[int, int, int]]:
+    """[(start, end, bucket)] covering [0, n) in `chunk`-sized pieces;
+    the tail lands in its own (smaller) bucket."""
+    out = []
+    for start in range(0, n, chunk):
+        end = min(start + chunk, n)
+        out.append((start, end, _bucket(end - start)))
+    return out
 
 
 def _pad_rows(n: int, b: int, *arrays):
@@ -566,22 +661,115 @@ def _pad_rows(n: int, b: int, *arrays):
     return tuple(np.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) for x in arrays)
 
 
+# ---------------------------------------------------------------------------
+# Golden-batch self-check for opt-in kernel flags (VERDICT r4 item 6)
+# ---------------------------------------------------------------------------
+#
+# TM_TPU_FE_MXU was measured computing WRONG verdicts on real TPU
+# (benchmarks/tpu_kernel_r04.jsonl: verify_ok=false — Precision.HIGHEST
+# does not deliver exact f32 dots on the TPU MXU the way XLA-CPU does),
+# and TM_TPU_BASE_MXU leans on the same exactness assumption.  Default-off
+# is not a safety mechanism: an operator who sets the flag on a TPU must
+# not get silently-wrong crypto.  So production paths run each opt-in
+# kernel ONCE per process against a known mixed-validity batch and
+# refuse the flag (loudly, with fallback to the standard program) on any
+# verdict mismatch.  Bench harnesses (kernel_bench) bypass the gate on
+# purpose — their job is to measure and report the raw path.
+
+_OPTIN_STATE: dict[tuple[str, str], bool] = {}
+
+
+def _golden_batch():
+    """8 deterministic signatures, rows 3 and 6 corrupted."""
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+
+    pubs, msgs, sigs, want = [], [], [], []
+    for i in range(8):
+        k = priv_key_from_seed(bytes([i + 41]) * 32)
+        m = b"optin-golden-%d" % i
+        s = k.sign(m)
+        ok = True
+        if i in (3, 6):
+            s = s[:-1] + bytes([s[-1] ^ 1])
+            ok = False
+        pubs.append(k.pub_key().bytes_())
+        msgs.append(m)
+        sigs.append(s)
+        want.append(ok)
+    return prepare_batch(pubs, msgs, sigs), want
+
+
+def _optin_safe(flag: str, impl: str) -> bool:
+    """True iff the opt-in kernel `flag` reproduces the golden verdicts
+    for `impl` on the current backend.  Memoized per process; a mismatch
+    warns and pins False (the caller falls back to the standard path)."""
+    key = (flag, impl)
+    if key in _OPTIN_STATE:
+        return _OPTIN_STATE[key]
+    import warnings
+
+    try:
+        inputs, want = _golden_batch()
+        if flag == "base_mxu":
+            got = _compiled(8, impl, True)(*inputs)
+        else:  # fe_mxu — the flag lives inside the f32 field backend
+            got = _compiled(8, impl)(*inputs)
+        ok = [bool(v) for v in np.asarray(got)] == want
+    except Exception as e:  # noqa: BLE001 — a crash is also a refusal
+        warnings.warn(f"opt-in kernel {flag!r} ({impl}) failed its golden "
+                      f"self-check with an error; disabled: {e}")
+        ok = False
+    if not ok:
+        warnings.warn(
+            f"opt-in kernel {flag!r} ({impl}) computed WRONG verdicts on "
+            "this backend (golden-batch self-check); the flag is disabled "
+            "for this process and the standard program is used instead")
+        if flag == "fe_mxu":
+            # the flag is a trace-time global inside the field module:
+            # flip it and drop every compiled program that may have
+            # baked it in
+            _field("f32")._USE_MXU = False
+            _compiled.cache_clear()
+            _compiled_rlc.cache_clear()
+    _OPTIN_STATE[key] = ok
+    return ok
+
+
+def _resolve_optin(impl: str) -> bool:
+    """Gate the opt-in kernel flags for a production dispatch; returns
+    the base_mxu trace flag to compile with."""
+    base_mxu = False
+    if _base_mxu_requested():
+        base_mxu = _optin_safe("base_mxu", impl)
+    if impl == "f32" and getattr(_field("f32"), "_USE_MXU", False):
+        _optin_safe("fe_mxu", impl)  # flips the module flag on mismatch
+    return base_mxu
+
+
 def _verify_rows(pub_rows, r_rows, s_rows, k_rows, valid, impl: str) -> np.ndarray:
     """Per-row device program on already-prepared rows (bucket-padded
     here); shared by verify_batch and the RLC fallback."""
+    base_mxu = _resolve_optin(impl)
     n = len(valid)
     b = _bucket(n)
     pub_rows, r_rows, s_rows, k_rows, valid_p = _pad_rows(
         n, b, pub_rows, r_rows, s_rows, k_rows, valid
     )
-    ok = _compiled(b, impl)(pub_rows, r_rows, s_rows, k_rows, valid_p)
+    ok = _compiled(b, impl, base_mxu)(pub_rows, r_rows, s_rows, k_rows, valid_p)
     return np.asarray(ok)[:n]
 
 
 def verify_batch(pubs, msgs, sigs, impl: str | None = None) -> np.ndarray:
-    """ZIP-215 verification of the whole batch in one device call.
+    """ZIP-215 verification of the whole batch on device.
 
     Returns bool[N].  Inputs are bytes-like sequences of equal length N.
+
+    Batches larger than TM_TPU_CHUNK (default 0 = off; see _chunk_size
+    for the measurement behind the default) are dispatched as a pipeline
+    of sub-batches: each chunk's host prep (SHA-512, s<L) runs while the
+    device executes the previous chunk — JAX dispatch is async, so
+    enqueueing returns immediately and the final verdict collection
+    drains the queue (VERDICT r4 item 2).
     """
     n = len(pubs)
     if n == 0:
@@ -590,8 +778,25 @@ def verify_batch(pubs, msgs, sigs, impl: str | None = None) -> np.ndarray:
     # to TM_TPU_FIELD_IMPL is honored (and impl=None vs impl="int64"
     # share one compiled program per bucket)
     impl = impl or default_impl()
+    chunk = _chunk_size()
+    if chunk and n > chunk:
+        return _verify_batch_pipelined(pubs, msgs, sigs, impl, chunk)
     pub_rows, r_rows, s_rows, k_rows, valid = prepare_batch(pubs, msgs, sigs)
     return _verify_rows(pub_rows, r_rows, s_rows, k_rows, valid, impl)
+
+
+def _verify_batch_pipelined(pubs, msgs, sigs, impl: str, chunk: int) -> np.ndarray:
+    """Chunked large-batch dispatch: prep chunk i+1 on host while the
+    device runs chunk i.  Every chunk program is enqueued before any
+    verdict is read; np.asarray at the end drains the device queue in
+    submission order."""
+    base_mxu = _resolve_optin(impl)
+    pending = []
+    for start, end, b in chunks_of(len(pubs), chunk):
+        rows = prepare_batch(pubs[start:end], msgs[start:end], sigs[start:end])
+        padded = _pad_rows(end - start, b, *rows)
+        pending.append((_compiled(b, impl, base_mxu)(*padded), end - start))
+    return np.concatenate([np.asarray(ok)[:m] for ok, m in pending])
 
 
 # ---------------------------------------------------------------------------
@@ -668,19 +873,24 @@ def verify_batch_rlc(pubs, msgs, sigs, impl: str | None = None) -> np.ndarray:
     The fallback fires only when the batch actually contains an invalid
     signature (or with probability ~2^-125 on a valid batch), i.e. the
     steady-state consensus path — honest commits — always takes the
-    cheap equation.  Same contract as the reference's switch to batch
-    verification (crypto/ed25519 BatchVerifier + VerifyBatch callers)."""
+    cheap equation.  Same accept/reject contract as the ed25519consensus
+    library's upstream VerifyBatch (the reference repo itself has no
+    batch verifier; it calls that library's per-signature Verify,
+    crypto/ed25519/ed25519.go:149-156)."""
     n = len(pubs)
     if n == 0:
         return np.zeros(0, dtype=bool)
     impl = impl or default_impl()
+    _resolve_optin(impl)  # fe_mxu golden gate (RLC has no device [s]B)
     pub_rows, r_rows, s_rows, k_rows, valid = prepare_batch(pubs, msgs, sigs)
     z_rows, zk_rows, c_row = prepare_rlc_scalars(s_rows, k_rows, valid)
     b = _bucket(n)
     pub_p, r_p, zk_p, z_p, valid_p = _pad_rows(
         n, b, pub_rows, r_rows, zk_rows, z_rows, valid
     )
-    acc, prevalid = _compiled_rlc(b, impl)(pub_p, r_p, zk_p, z_p, valid_p)
+    acc, prevalid = _compiled_rlc(b, impl, rlc_reduce_lanes())(
+        pub_p, r_p, zk_p, z_p, valid_p
+    )
     if finalize_rlc(acc, c_row, impl):
         RLC_STATS["pass"] += 1
         return np.asarray(prevalid)[:n]

@@ -92,24 +92,129 @@ def test_cpu_threshold_malformed_env_defers(monkeypatch):
 
 
 def test_cpu_threshold_lazy_resolution(monkeypatch):
-    """Deferred threshold: sub-floor batches resolve to the static 64
-    without touching the device; the first >=64 batch measures once and
-    pins the instance threshold."""
+    """Deferred threshold (r5 shape, VERDICT r4 item 5): sub-floor
+    batches resolve to the static 64 without touching the device; the
+    first >=64 batch kicks the measurement on a WORKER thread and itself
+    routes to the host path (n+1); once the worker resolves, the
+    instance pins the measured value."""
+    import threading
+
     from tendermint_tpu.crypto import batch
 
     monkeypatch.delenv("TM_TPU_CPU_THRESHOLD", raising=False)
+    monkeypatch.setattr(batch, "_MEASURED_THRESHOLD", None)
+    monkeypatch.setattr(batch, "_MEASURE_STARTED", False)
     v = batch.JAXBatchVerifier()
     assert v.cpu_threshold is None
+    done = threading.Event()
     called = []
 
     def fake_measure():
         called.append(1)
+        batch._MEASURED_THRESHOLD = 999
+        done.set()
         return 999
 
     monkeypatch.setattr(batch, "measured_cpu_threshold", fake_measure)
     assert v._resolved_threshold(8) == 64      # floor, no measurement
     assert not called
-    assert v._resolved_threshold(64) == 999    # measured once
+    assert v._resolved_threshold(64) == 65     # host path, worker kicked
+    assert done.wait(5.0)
+    assert v._resolved_threshold(64) == 999    # measured result pinned
     assert v.cpu_threshold == 999
     assert v._resolved_threshold(8) == 999     # pinned thereafter
     assert len(called) == 1
+
+
+def test_device_readiness_gates_dispatch(monkeypatch):
+    """r5 TPU-in-the-loop finding: the FIRST device contact (backend
+    init + compile-cache load) wedged a live node ~3 min and got it
+    evicted.  Production dispatch is therefore gated on _DEVICE_READY:
+    >=threshold batches route to the host and kick a warmup worker
+    until the device has answered once; then they dispatch."""
+    import threading
+
+    from tendermint_tpu.crypto import batch
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+
+    monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "8")
+    monkeypatch.setattr(batch, "_DEVICE_READY", threading.Event())
+    monkeypatch.setattr(batch, "_WARMUP_STARTED", False)
+    warmups = []
+    monkeypatch.setattr(batch, "start_device_warmup",
+                        lambda: warmups.append(1))
+
+    v = batch.JAXBatchVerifier()
+    assert v.cpu_threshold == 8
+
+    class FakeImpl:
+        calls = 0
+
+        @staticmethod
+        def verify_batch(pubs, msgs, sigs):
+            FakeImpl.calls += 1
+            return [True] * len(pubs)
+
+        @staticmethod
+        def verify_batch_rlc(pubs, msgs, sigs):
+            raise AssertionError("rlc not expected")
+
+    monkeypatch.setattr(v, "_impl", FakeImpl)
+    monkeypatch.setattr(v, "_n_devices", 1)
+
+    privs = [priv_key_from_seed(bytes([i + 1]) * 32) for i in range(16)]
+    batch16 = [(p.pub_key(), b"m%d" % i, p.sign(b"m%d" % i))
+               for i, p in enumerate(privs)]
+
+    for pub, m, s in batch16:
+        v.add(pub, m, s)
+    ok, _ = v.verify()
+    assert ok
+    assert FakeImpl.calls == 0, "dispatched before the device was ready"
+    assert warmups, "warmup never kicked"
+
+    batch._DEVICE_READY.set()
+    for pub, m, s in batch16:
+        v.add(pub, m, s)
+    ok, _ = v.verify()
+    assert ok
+    assert FakeImpl.calls == 1, "ready device was not dispatched to"
+
+
+def test_threshold_measurement_never_blocks_verify(monkeypatch):
+    """VERDICT r4 item 5 acceptance: the first >=64-sig batch completes
+    on the host path while a SLOW measurement (2 s, standing in for the
+    tunnel warm-up) runs behind it; the verify call must not wait on
+    it."""
+    import time
+
+    from tendermint_tpu.crypto import batch
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+
+    monkeypatch.delenv("TM_TPU_CPU_THRESHOLD", raising=False)
+    monkeypatch.setattr(batch, "_MEASURED_THRESHOLD", None)
+    monkeypatch.setattr(batch, "_MEASURE_STARTED", False)
+
+    started = []
+
+    def slow_measure():
+        started.append(time.monotonic())
+        time.sleep(2.0)
+        batch._MEASURED_THRESHOLD = 4096
+        return 4096
+
+    monkeypatch.setattr(batch, "measured_cpu_threshold", slow_measure)
+
+    v = batch.JAXBatchVerifier()
+    privs = [priv_key_from_seed(bytes([i + 1]) * 32) for i in range(64)]
+    for i, p in enumerate(privs):
+        m = b"block-%d" % i
+        v.add(p.pub_key(), m, p.sign(m))
+    t0 = time.monotonic()
+    all_ok, oks = v.verify()
+    elapsed = time.monotonic() - t0
+    assert all_ok and len(oks) == 64
+    # host path: 64 native verifies ~3 ms; generous bound far below the
+    # 2 s the measurement needs
+    assert elapsed < 0.5, f"verify blocked {elapsed:.3f}s on measurement"
+    assert started, "measurement worker was never kicked"

@@ -143,6 +143,39 @@ def test_four_node_testnet_with_perturbation(tmp_path):
     asyncio.run(run())
 
 
+def test_abci_unix_socket_testnet(tmp_path):
+    """ABCI over AF_UNIX (reference ABCIProtocol "unix"): 2 validators,
+    each with an external kvstore app server on unix:///<home>/app.sock,
+    commit blocks under load and agree on app hashes — the TSP transport
+    is identical to tcp-socket; only the address family differs
+    (abci/socket.py parse_abci_laddr)."""
+
+    async def run():
+        net = Testnet(
+            {"chain_id": "unix-net", "validators": 2, "base_port": 29660,
+             "abci": "unix"},
+            str(tmp_path / "net"),
+        )
+        net.setup()
+        # the runner must have produced unix:// proxy_app addresses
+        assert all(a.startswith("unix://") for a in net._app_addrs.values())
+        net.start()
+        try:
+            await net.wait_for_height(3, timeout=180)
+            accepted = await net.load(total_txs=5, rate=10)
+            assert accepted >= 1, "no load txs accepted over unix abci"
+            h = max(n.height() for n in net.nodes)
+            await net.wait_for_height(h + 1, timeout=120)
+            upto = min(n.height() for n in net.nodes)
+            net.check_blocks_identical(upto)
+            net.check_app_hashes_agree()
+        finally:
+            rcs = net.stop()
+        assert all(rc == 0 for rc in rcs), f"exit codes {rcs}"
+
+    asyncio.run(run())
+
+
 def test_two_node_testnet_jax_backend(tmp_path):
     """A multi-process net whose nodes run with TM_TPU_CRYPTO_BACKEND=jax
     (VERDICT round-1 item 3, e2e half): the JAX verifier is constructed
@@ -353,6 +386,46 @@ def test_maverick_amnesia_net_stays_safe():
         finally:
             for n in nodes:
                 await n.stop()
+        for h in range(1, 5):
+            hashes = {n.block_store.load_block(h).hash() for n in nodes}
+            assert len(hashes) == 1, f"fork at height {h}"
+
+    asyncio.run(run())
+
+
+def test_maverick_ignore_proposal_net_keeps_committing():
+    """The 6th maverick hook (reference misbehavior.go ReceiveProposal):
+    one validator drops every proposal it receives at heights 2-3,
+    prevotes nil, and must catch up via the committed-block part gossip
+    (enter_commit resets the part set from the +2/3 precommit block ID);
+    the honest majority keeps committing identical blocks throughout."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_multinode import make_net, start_mesh, wait_all_height
+
+    from tendermint_tpu.consensus.wal import NopWAL
+    from tendermint_tpu.e2e.maverick import MaverickConsensusState
+
+    async def run():
+        nodes = make_net(4)
+        byz = nodes[1]
+        cs = byz.cs
+        byz.cs = MaverickConsensusState(
+            cs.config, cs.state, cs.block_exec, cs.block_store,
+            wal=NopWAL(), priv_validator=cs.priv_validator,
+            evidence_pool=cs.evpool,
+            misbehaviors={2: "ignore-proposal", 3: "ignore-proposal"},
+            raw_key=byz.key,
+        )
+        byz.reactor.cs = byz.cs
+        byz.cs.event_bus = cs.event_bus
+        byz.cs.on_event = byz.reactor._on_cs_event
+        await start_mesh(nodes)
+        try:
+            await wait_all_height(nodes, 5)
+        finally:
+            for n in nodes:
+                await n.stop()
+        assert byz.cs.ignored_proposals >= 1, "hook never fired"
         for h in range(1, 5):
             hashes = {n.block_store.load_block(h).hash() for n in nodes}
             assert len(hashes) == 1, f"fork at height {h}"

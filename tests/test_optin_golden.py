@@ -1,0 +1,118 @@
+"""Golden-batch self-check for opt-in kernel flags (VERDICT r4 item 6).
+
+TM_TPU_FE_MXU was measured computing WRONG verdicts on real TPU
+(benchmarks/tpu_kernel_r04.jsonl verify_ok=false), and TM_TPU_BASE_MXU
+relies on the same Precision.HIGHEST-f32-matmul exactness assumption.
+Production paths must therefore run any opt-in kernel once against a
+known mixed-validity batch and refuse it — loudly, falling back to the
+standard program — when verdicts mismatch.  These tests pin both arms:
+the flag is honored where the kernel is exact (XLA-CPU), and a wrong
+kernel is disabled without a single wrong verdict escaping.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.ops import ed25519_jax as dev
+
+
+def _small_batch(n=8, bad=(2,)):
+    pubs, msgs, sigs, want = [], [], [], []
+    for i in range(n):
+        k = priv_key_from_seed(bytes([i + 91]) * 32)
+        m = b"optin-test-%d" % i
+        s = k.sign(m)
+        ok = True
+        if i in bad:
+            s = s[:-1] + bytes([s[-1] ^ 1])
+            ok = False
+        pubs.append(k.pub_key().bytes_())
+        msgs.append(m)
+        sigs.append(s)
+        want.append(ok)
+    return pubs, msgs, sigs, want
+
+
+@pytest.fixture
+def clean_optin(monkeypatch):
+    """Isolate the per-process opt-in memo + compiled-program caches."""
+    monkeypatch.setattr(dev, "_OPTIN_STATE", {})
+    dev._compiled.cache_clear()
+    yield
+    dev._compiled.cache_clear()
+    dev._OPTIN_STATE.clear()
+
+
+def test_base_mxu_honored_where_exact(monkeypatch, clean_optin):
+    """On XLA-CPU (true f32 dots) the comb passes its self-check and the
+    flag stays enabled."""
+    monkeypatch.setenv("TM_TPU_BASE_MXU", "1")
+    pubs, msgs, sigs, want = _small_batch()
+    got = [bool(v) for v in dev.verify_batch(pubs, msgs, sigs, impl="int64")]
+    assert got == want
+    assert dev._OPTIN_STATE[("base_mxu", "int64")] is True
+
+
+def test_base_mxu_refused_when_wrong(monkeypatch, clean_optin):
+    """A comb that computes garbage is caught by the golden batch: the
+    flag is disabled with a warning and verdicts stay correct via the
+    standard program."""
+    monkeypatch.setenv("TM_TPU_BASE_MXU", "1")
+
+    def broken_comb(self, s_rows):
+        # structurally valid points (the identity), wrong results
+        return self.fe.pt_identity(s_rows.shape[:-1])
+
+    monkeypatch.setattr(dev._Core, "_scalarmul_base_mxu", broken_comb)
+    pubs, msgs, sigs, want = _small_batch()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = [bool(v) for v in
+               dev.verify_batch(pubs, msgs, sigs, impl="int64")]
+    assert got == want, "wrong verdicts escaped the golden gate"
+    assert dev._OPTIN_STATE[("base_mxu", "int64")] is False
+    assert any("WRONG verdicts" in str(x.message) for x in w)
+
+
+def test_fe_mxu_refused_when_wrong(monkeypatch, clean_optin):
+    """The f32 field backend's MXU fe_mul (hardware-refuted in r4) is
+    disabled by the gate: module flag flipped, caches dropped, verdicts
+    correct."""
+    fe32 = dev._field("f32")
+    dev._compiled_rlc.cache_clear()
+
+    def broken_mul(a, b):
+        return a * b * 0.0  # right shape/dtype, garbage value
+
+    monkeypatch.setattr(fe32, "_fe_mul_mxu", broken_mul)
+    monkeypatch.setattr(fe32, "_USE_MXU", True)
+    pubs, msgs, sigs, want = _small_batch()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = [bool(v) for v in
+               dev.verify_batch(pubs, msgs, sigs, impl="f32")]
+    assert got == want
+    assert dev._OPTIN_STATE[("fe_mxu", "f32")] is False
+    assert fe32._USE_MXU is False  # flipped so later traces are clean
+    assert any("WRONG verdicts" in str(x.message) for x in w)
+
+
+def test_bench_path_bypasses_gate(monkeypatch, clean_optin):
+    """kernel_bench measures the RAW opt-in path (its verify_ok reports
+    wrongness); the gate must not be consulted by a direct
+    _Core.verify_core call."""
+    import functools
+
+    import jax
+
+    monkeypatch.setenv("TM_TPU_BASE_MXU", "1")
+    pubs, msgs, sigs, want = _small_batch()
+    inputs = dev.prepare_batch(pubs, msgs, sigs)
+    core = jax.jit(functools.partial(dev._core("int64").verify_core,
+                                     base_mxu=True))
+    got = [bool(v) for v in np.asarray(core(*inputs))]
+    assert got == want  # exact on XLA-CPU
+    assert ("base_mxu", "int64") not in dev._OPTIN_STATE

@@ -4,10 +4,12 @@ verdicts bit-identical to the pure ZIP-215 reference — the honest path
 takes the cheap shared-doubling program, every adversarial shape routes
 to the exact per-row fallback.
 
-Reference parity: the reference's batch verifier computes the same
-cofactored RLC check (crypto/ed25519/ed25519.go BatchVerifier via
-ed25519consensus); its callers also fall back to per-signature
-verification when the combined check fails.
+Reference parity: the reference repo has NO batch verifier — it calls
+ed25519consensus.Verify per signature (crypto/ed25519/ed25519.go:149-156).
+The RLC equation here is the standard ZIP-215 cofactored batch check,
+the one the ed25519consensus library's upstream VerifyBatch implements;
+like that implementation's callers, a combined-check failure routes to
+exact per-signature (here: per-row) verification.
 """
 
 import numpy as np
