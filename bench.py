@@ -97,6 +97,15 @@ def _stage_set(name: str) -> None:
     print(f"[bench] stage={name} t={time.monotonic() - _t_start:.1f}s", file=sys.stderr)
 
 
+def _deadline_left() -> float:
+    """Seconds of watchdog budget remaining.  Optional stages budget
+    themselves against this (BENCH_r05 overran the 480 s deadline inside
+    timed-throughput-rlc and the artifact line reported the watchdog
+    error instead of the already-measured headline): a stage that cannot
+    afford its runs skips or shrinks, so the final JSON reports clean."""
+    return DEADLINE - (time.monotonic() - _t_start)
+
+
 _PROBE_TIMEOUT = float(os.environ.get("TM_BENCH_PROBE_TIMEOUT", "150"))
 
 
@@ -389,6 +398,14 @@ def main() -> None:
                     # one impl failing (e.g. compile OOM) must not cost
                     # the other's headline
                     _partial[f"field_impl_{impl}_error"] = str(e)[-300:]
+            if headline_pairs:
+                # stash now: a watchdog firing in any later (optional)
+                # stage must not cost the already-measured ratio — same
+                # hardening the CPU branch has had since r3
+                _partial["vs_baseline"] = round(
+                    statistics.median(p / b for p, b in headline_pairs), 3
+                )
+                _partial["baseline_sampling"] = "interleaved-pair-median"
             # Device-only 10k-commit latency (VERDICT r4 item 2): rows
             # prepared and placed on device ONCE, then only the compiled
             # chunk programs + the verdict-bit readback are timed — the
@@ -396,6 +413,9 @@ def main() -> None:
             # reported alongside the tunnel-inclusive end-to-end p50.
             _stage_set("timed-commit-device-only")
             try:
+                if _deadline_left() < 60:
+                    raise RuntimeError(
+                        "skipped: %.0fs left" % _deadline_left())
                 import numpy as _np
 
                 import jax as _jax
@@ -449,13 +469,35 @@ def main() -> None:
                         "skipped: %.0fs elapsed of %.0fs budget"
                         % (time.monotonic() - _t_start, DEADLINE)
                     )
+                t_warm = time.perf_counter()
                 ok = dev.verify_batch_rlc(pubs, msgs, sigs)
+                warm_dt = time.perf_counter() - t_warm
                 assert ok.all(), "rlc warmup verification failed"
+
+                # budget the timed stages against the remaining deadline
+                # (BENCH_r05 overran HERE): each throughput run costs the
+                # run itself plus a matched-duration baseline window, so
+                # ~2x the measured warm run; keep a reserve for the
+                # emit path and shrink/skip instead of tripping the
+                # watchdog
+                reserve = 25.0
+                per_run = 2.0 * warm_dt
+                affordable = int(
+                    max(0.0, _deadline_left() - reserve) * 0.6 / max(per_run, 1e-6)
+                )
+                if affordable < 1:
+                    raise RuntimeError(
+                        "timed stage skipped: %.0fs left, run costs ~%.1fs"
+                        % (_deadline_left(), per_run)
+                    )
+                rlc_runs = min(TIMED_RUNS, affordable)
+                if rlc_runs < TIMED_RUNS:
+                    _partial["rlc_runs_shrunk_to"] = rlc_runs
 
                 _stage_set("timed-throughput-rlc")
                 times = []
                 rlc_pairs = []
-                for _ in range(TIMED_RUNS):
+                for _ in range(rlc_runs):
                     t0 = time.perf_counter()
                     ok = dev.verify_batch_rlc(pubs, msgs, sigs)
                     dt = time.perf_counter() - t0
@@ -466,17 +508,32 @@ def main() -> None:
                 rate = N / statistics.median(times)
                 _partial["rlc_sigs_per_sec"] = round(rate, 1)
 
-                _stage_set("timed-commit-latency-rlc")
                 cn = min(COMMIT_N, N)
-                lat = []
-                for _ in range(max(TIMED_RUNS, 5)):
-                    t0 = time.perf_counter()
-                    ok = dev.verify_batch_rlc(pubs[:cn], msgs[:cn], sigs[:cn])
-                    lat.append(time.perf_counter() - t0)
-                    assert ok.all()
-                rlc_p50 = statistics.median(lat) * 1e3
-                _partial["rlc_commit_p50_ms"] = round(rlc_p50, 3)
-                if rate > ours:
+                lat_per_run = warm_dt * cn / N
+                lat_runs = min(
+                    max(TIMED_RUNS, 5),
+                    int(max(0.0, _deadline_left() - reserve) * 0.6
+                        / max(lat_per_run, 1e-6)),
+                )
+                rlc_p50 = None
+                if lat_runs >= 1:
+                    _stage_set("timed-commit-latency-rlc")
+                    lat = []
+                    for _ in range(lat_runs):
+                        t0 = time.perf_counter()
+                        ok = dev.verify_batch_rlc(pubs[:cn], msgs[:cn], sigs[:cn])
+                        lat.append(time.perf_counter() - t0)
+                        assert ok.all()
+                    rlc_p50 = statistics.median(lat) * 1e3
+                    _partial["rlc_commit_p50_ms"] = round(rlc_p50, 3)
+                else:
+                    _partial["rlc_commit_latency_skipped"] = (
+                        "budget: %.0fs left" % _deadline_left()
+                    )
+                # only a fully-measured RLC (throughput AND latency) may
+                # carry the headline — the headline's p50 key must never
+                # be missing
+                if rate > ours and rlc_p50 is not None:
                     ours = rate
                     p50_ms = rlc_p50
                     headline_pairs = rlc_pairs
@@ -490,6 +547,111 @@ def main() -> None:
             cn = min(COMMIT_N, N)
             lat_key = "commit10k_p50_ms" if cn == COMMIT_N else f"commit{cn}_p50_ms"
             _partial[lat_key] = round(p50_ms, 3)
+
+        # Concurrent-submitter coalescing (round 6): N parallel streams,
+        # each repeatedly verifying its own 64-sig slice — the gossip /
+        # blocksync / commit-verify shape, where every individual batch
+        # sits below the dispatch threshold and a per-caller verifier
+        # can never amortize anything.  Arm A: one verifier per stream
+        # (the pre-r6 production shape).  Arm B: every stream submits to
+        # the async verification service (crypto.async_verify), which
+        # coalesces the streams into single flushes.  Same backend, same
+        # threshold policy; only the batching point differs — this is
+        # the win the single-caller throughput stages above cannot see.
+        _stage_set("async-coalesce")
+        try:
+            if _deadline_left() < 60:
+                raise RuntimeError("skipped: %.0fs left" % _deadline_left())
+            from tendermint_tpu.crypto import async_verify as _av
+            from tendermint_tpu.crypto import batch as _cbatch
+            from tendermint_tpu.crypto import ed25519 as _ced
+
+            streams = int(os.environ.get("TM_BENCH_STREAMS", "16"))
+            rounds = int(os.environ.get("TM_BENCH_STREAM_ROUNDS", "4"))
+            per = min(64, N)
+            streams = max(1, min(streams, N // per))
+            rounds = max(1, min(rounds, N // (streams * per)))
+            # every (stream, round) slice is a distinct set of triples so
+            # the service's verified-signature cache cannot shortcut the
+            # timed arm (dedup is measured separately below)
+            data = []
+            base = 0
+            for _s in range(streams):
+                rows = []
+                for _r in range(rounds):
+                    sl = slice(base, base + per)
+                    rows.append(list(zip(pubs[sl], msgs[sl], sigs[sl])))
+                    base += per
+                data.append(rows)
+            # XLA-CPU's device program is a diagnostic path (and a fresh
+            # bucket compile costs minutes): pin both arms to the host
+            # route there; real accelerators keep the production policy
+            thr_pin = (1 << 30) if platform == "cpu" else None
+            _ced.verify_batch_fast(pubs[:per], msgs[:per], sigs[:per])  # warm
+
+            def _run_arm(worker) -> float:
+                errs: list = []
+                ths = [
+                    threading.Thread(target=worker, args=(s, errs))
+                    for s in range(streams)
+                ]
+                t0 = time.perf_counter()
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+                dt = time.perf_counter() - t0
+                assert not errs, errs[0]
+                return streams * rounds * per / dt
+
+            def indep_worker(s: int, errs: list) -> None:
+                try:
+                    bv = (_cbatch.JAXBatchVerifier(cpu_threshold=thr_pin)
+                          if thr_pin is not None
+                          else _cbatch.new_batch_verifier())
+                    for tri in data[s]:
+                        for p, m, g in tri:
+                            bv.add(p, m, g)
+                        ok, _oks = bv.verify()
+                        assert ok, "independent arm verification failed"
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+            indep_rate = _run_arm(indep_worker)
+
+            svc = _av.reset_service(cpu_threshold=thr_pin)
+
+            def svc_worker(s: int, errs: list) -> None:
+                try:
+                    for tri in data[s]:
+                        oks = svc.verify_many(tri)
+                        assert all(oks), "service arm verification failed"
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+            svc_rate = _run_arm(svc_worker)
+            st = _av.service_stats()
+            # dedup demonstration: resubmitting an already-verified slice
+            # must resolve from the cache without any host/device work
+            hits0, host0, dev0 = (st["cache_hits"], st["host_flushes"],
+                                  st["device_batches"])
+            assert all(svc.verify_many(data[0][0]))
+            st2 = _av.service_stats()
+            _partial.update({
+                "async_svc_sigs_per_sec": round(svc_rate, 1),
+                "independent_sigs_per_sec": round(indep_rate, 1),
+                "async_coalesce_speedup": round(svc_rate / indep_rate, 3),
+                "async_streams": streams,
+                "async_stream_rounds": rounds,
+                "async_flushes": st["flushes"],
+                "async_coalesced_max": st["coalesced_max"],
+                "async_device_batches": st["device_batches"],
+                "async_cache_hits_on_resubmit": st2["cache_hits"] - hits0,
+                "async_work_on_resubmit": (st2["host_flushes"] - host0
+                                           + st2["device_batches"] - dev0),
+            })
+        except Exception as e:  # noqa: BLE001
+            _partial["async_coalesce_error"] = str(e)[-300:]
 
         _stage_set("pair-median")
         assert headline_pairs, "headline path recorded no (prod, baseline) pairs"

@@ -13,6 +13,12 @@ one light pair-check for the newest block — then the window is applied
 with signature checks already done (strictly ≥ the reference's checks:
 it light-verifies each pair AND full-verifies each commit one height
 later; we full-verify each commit exactly once, in the batch).
+
+Round 6: batch_verify_commits submits through the async verification
+service (crypto.async_verify), so a blocksync window verifying while
+consensus or a light client is also active coalesces into shared device
+dispatches, and catching up over blocks whose commits were already
+verified (restart replay) resolves from the verified-signature cache.
 """
 
 from __future__ import annotations

@@ -18,7 +18,10 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: same API from the backport
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 from tendermint_tpu.consensus.config import ConsensusConfig

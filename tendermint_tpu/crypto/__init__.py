@@ -1,3 +1,10 @@
 from .tmhash import sum_sha256, sum_truncated, ADDRESS_SIZE
 from .keys import PrivKey, PubKey, gen_priv_key, priv_key_from_seed
 from .batch import BatchVerifier, CPUBatchVerifier, new_batch_verifier
+from .async_verify import (  # noqa: F401 — the async service surface
+    ServiceBatchVerifier,
+    VerifyService,
+    get_service,
+    new_service_batch_verifier,
+    service_stats,
+)

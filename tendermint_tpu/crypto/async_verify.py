@@ -1,0 +1,501 @@
+"""Asynchronous verification service: cross-caller micro-batching,
+host/device pipelining, and a verified-signature cache.
+
+Round-5 closed the kernel question (the per-signature program runs
+within ~10-20% of the VPU's elementwise floor — ROUND5_NOTES.md §1), so
+the next end-to-end win has to come from the dispatch pattern: every
+device round trip costs ~45-120 ms through the tunnel, yet the hot
+callers (VoteSet.add_votes slices, gossip prechecks, blocksync windows)
+each construct their own BatchVerifier and submit batches that are
+individually below the CPU/TPU breakeven — so no caller ever amortizes
+a dispatch, even when several of them are verifying at the same moment.
+
+This module is the continuous-batching answer (the Orca-style
+iteration-level scheduling of inference serving, applied to signature
+verification; PAPERS.md):
+
+  * `submit(pub, msg, sig) -> Future[bool]` never blocks.  Requests
+    from independent callers land in ONE submission queue; a daemon
+    worker coalesces them into a single batch and dispatches when the
+    queue reaches a size rung from the `_bucket` ladder or when a
+    linger deadline (`TM_TPU_LINGER_MS`) expires.  Below-threshold
+    flushes route to the host path exactly as today.
+  * Double-buffered host/device pipelining: the worker ENQUEUES the
+    compiled device program for batch i (JAX dispatch is async) and
+    immediately starts host prep (sign-bytes SHA-512, s<L) for batch
+    i+1; verdicts are drained when a second batch is in flight or the
+    queue runs dry.  Batches over TM_TPU_CHUNK reuse the r5 chunk
+    machinery (ops.ed25519_jax.chunks_of).
+  * A bounded verified-signature LRU cache keyed by
+    (pub, sha256(msg), sig) is consulted before enqueue and populated
+    ONLY on success — gossip duplicates and replay re-verification
+    never reach the device (and a corrupted signature can never be
+    cached as valid, by construction).
+
+Degradation contract (the `_DEVICE_READY` guarantee, one level up): the
+worker only dispatches to the device after crypto.batch's warmup has
+proven it answers; until then — and forever, on a wedged tunnel —
+every flush runs the host path, so a submitter is never blocked by
+backend init, compile-cache loads, or a hung transport.
+
+Env knobs:
+  TM_TPU_ASYNC_VERIFY   1 (default) routes the framework's verify
+                        surfaces through the service; 0 restores
+                        per-caller BatchVerifier instances.
+  TM_TPU_LINGER_MS      coalescing window in milliseconds (default 1.0).
+  TM_TPU_VERIFY_CACHE   verified-signature cache capacity in entries
+                        (default 65536; 0 disables the cache).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+
+from . import ed25519 as _ed
+from . import batch as _batch
+from .batch import _pub_bytes, _split_verify
+
+DEFAULT_LINGER_MS = 1.0
+DEFAULT_CACHE_SIZE = 65536
+MAX_COALESCE = 16384  # per-flush cap == the bucket ladder's top rung
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(0, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+class _Request:
+    __slots__ = ("pub", "msg", "sig", "key", "future")
+
+    def __init__(self, pub: bytes, msg: bytes, sig: bytes, key, future: Future):
+        self.pub = pub
+        self.msg = msg
+        self.sig = sig
+        self.key = key
+        self.future = future
+
+
+class VerifiedSigCache:
+    """Bounded thread-safe LRU of (pub, sha256(msg), sig) triples proven
+    VALID.  Only True verdicts are ever stored: a rejected signature is
+    re-verified on every appearance, so a corrupted signature cannot be
+    cached as valid no matter what races occur."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(pub: bytes, msg: bytes, sig: bytes) -> tuple:
+        return (pub, hashlib.sha256(msg).digest(), sig)
+
+    def get(self, key) -> bool:
+        if self.maxsize <= 0:
+            self.misses += 1
+            return False
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                self.hits += 1
+                return True
+            self.misses += 1
+            return False
+
+    def put(self, key) -> None:
+        if self.maxsize <= 0:
+            return
+        with self._lock:
+            self._d[key] = True
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class VerifyService:
+    """The process-wide verification daemon.  See the module docstring
+    for the batching/pipelining/caching design; `get_service()` returns
+    the shared instance."""
+
+    def __init__(self, *, linger_ms: float | None = None,
+                 cache_size: int | None = None,
+                 cpu_threshold: int | None = None):
+        self.linger_s = (linger_ms if linger_ms is not None
+                         else _env_float("TM_TPU_LINGER_MS",
+                                         DEFAULT_LINGER_MS)) / 1e3
+        self.cache = VerifiedSigCache(
+            cache_size if cache_size is not None
+            else _env_int("TM_TPU_VERIFY_CACHE", DEFAULT_CACHE_SIZE))
+        self._cv = threading.Condition()
+        self._queue: deque[_Request] = deque()
+        self._worker: threading.Thread | None = None
+        self._closed = False
+        self.stats = {
+            "submitted": 0,
+            "flushes": 0,
+            "host_flushes": 0,
+            "device_batches": 0,
+            "coalesced_max": 0,
+            "pipelined_drains": 0,
+        }
+        # the threshold/readiness arbitration reuses JAXBatchVerifier's
+        # lazy measurement machinery; on a jax-less box every flush
+        # routes to the host path
+        try:
+            self._jax_bv = _batch.JAXBatchVerifier(cpu_threshold=cpu_threshold)
+        except Exception:  # noqa: BLE001 — no jax: host-only service
+            self._jax_bv = None
+
+    # -- submission (caller side; never blocks) -----------------------
+
+    def submit(self, pub, msg: bytes, sig: bytes) -> Future:
+        """Queue one verification; resolves to bool.  Cache hits resolve
+        immediately without queueing."""
+        return self.submit_many([(pub, msg, sig)])[0]
+
+    def submit_many(self, items) -> list[Future]:
+        """Bulk submit: one cache pass + one queue append under a single
+        lock acquisition — the large-batch path (a 10k commit) must not
+        pay per-item lock traffic."""
+        futures: list[Future] = []
+        fresh: list[_Request] = []
+        for pub, msg, sig in items:
+            pub_b = _pub_bytes(pub)
+            msg_b = bytes(msg)
+            sig_b = bytes(sig)
+            key = VerifiedSigCache.key(pub_b, msg_b, sig_b)
+            fut: Future = Future()
+            futures.append(fut)
+            if self.cache.get(key):
+                fut.set_result(True)
+            else:
+                fresh.append(_Request(pub_b, msg_b, sig_b, key, fut))
+        if fresh:
+            with self._cv:
+                if self._closed:
+                    raise RuntimeError("verify service is closed")
+                self.stats["submitted"] += len(fresh)
+                self._queue.extend(fresh)
+                self._ensure_worker_locked()
+                self._cv.notify()
+        return futures
+
+    def verify_many(self, items) -> list[bool]:
+        """Sync convenience wrapper: submit all, wait for all.  Blocks
+        only on verification work the host path could also perform —
+        never on device warmup (the worker routes around a cold or
+        wedged device)."""
+        futs = self.submit_many(items)
+        return [bool(f.result()) for f in futs]
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    # -- worker -------------------------------------------------------
+
+    def _ensure_worker_locked(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, daemon=True, name="tm-verify-service")
+            self._worker.start()
+
+    def _flush_rung(self) -> int:
+        """Stop lingering once the queue can fill a device-worthy bucket:
+        the smallest `_bucket` rung at/above the dispatch threshold (64
+        while the threshold is unmeasured or on a host-only service)."""
+        target = 64
+        bv = self._jax_bv
+        if bv is not None:
+            thr = bv.cpu_threshold
+            if thr is None:
+                thr = _batch.measured_cpu_threshold_ready()
+            if thr is not None:
+                target = max(64, min(MAX_COALESCE, thr))
+        try:
+            from tendermint_tpu.ops.ed25519_jax import _bucket
+
+            return min(MAX_COALESCE, _bucket(target))
+        except Exception:  # noqa: BLE001
+            return target
+
+    def _collect(self, block: bool) -> list[_Request]:
+        """Take the next coalesced batch off the queue: wait (if `block`)
+        for the first request, then linger until the rung fills or the
+        deadline passes."""
+        import time
+
+        with self._cv:
+            if block:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+            if not self._queue:
+                return []
+            if self.linger_s > 0:
+                rung = self._flush_rung()
+                deadline = time.monotonic() + self.linger_s
+                while (len(self._queue) < rung and not self._closed):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+            batch = [self._queue.popleft()
+                     for _ in range(min(len(self._queue), MAX_COALESCE))]
+        self.stats["flushes"] += 1
+        self.stats["coalesced_max"] = max(self.stats["coalesced_max"],
+                                          len(batch))
+        return batch
+
+    def _run(self) -> None:
+        # in-flight device batches awaiting verdict readback:
+        # (pending_device_value, reqs).  Depth 2 = double buffering —
+        # batch i executes on device while batch i+1 is host-prepped and
+        # enqueued behind it.
+        inflight: deque = deque()
+        while True:
+            with self._cv:
+                if self._closed and not self._queue and not inflight:
+                    return
+                queue_empty = not self._queue
+            if inflight and queue_empty:
+                self._drain_one(inflight)
+                continue
+            reqs = self._collect(block=not inflight)
+            if reqs:
+                try:
+                    self._flush(reqs, inflight)
+                except BaseException as e:  # noqa: BLE001
+                    self._resolve_failed(reqs, e)
+            while len(inflight) >= 2:
+                self._drain_one(inflight)
+
+    def _flush(self, reqs: list[_Request], inflight: deque) -> None:
+        """Route one coalesced batch: host below threshold / before
+        device readiness; async device enqueue otherwise."""
+        n = len(reqs)
+        bv = self._jax_bv
+        if bv is None:
+            self._host_verify(reqs)
+            return
+        thr = bv._resolved_threshold(n)
+        if n < thr:
+            self._host_verify(reqs)
+            return
+        if not _batch._DEVICE_READY.is_set():
+            # identical degradation to JAXBatchVerifier._ed_batch: kick
+            # the warmup worker, verify on host meanwhile — a wedged
+            # tunnel must never block a submitter
+            _batch.start_device_warmup()
+            self._host_verify(reqs)
+            return
+        mixed = any(len(r.pub) != 32 for r in reqs)
+        if mixed or bv._device_count() > 1 or \
+                os.environ.get("TM_TPU_RLC", "0") == "1":
+            # rarer shapes (secp-mixed batches, mesh sharding, RLC) run
+            # the existing synchronous routing — bit-identical verdicts,
+            # no pipelining
+            self._sync_device_verify(reqs, bv)
+            return
+        try:
+            self._enqueue_device(reqs, inflight)
+        except Exception:  # noqa: BLE001 — device hiccup: host fallback
+            self._host_verify(reqs)
+
+    def _enqueue_device(self, reqs: list[_Request], inflight: deque) -> None:
+        """Host prep + async enqueue of the per-row device program,
+        chunked via the r5 machinery when TM_TPU_CHUNK is set.  Verdict
+        readback happens in _drain_one — by then the worker has already
+        host-prepped the NEXT batch behind the executing one."""
+        from tendermint_tpu.ops import ed25519_jax as dev
+
+        n = len(reqs)
+        impl = dev.default_impl()
+        base_mxu = dev._resolve_optin(impl)
+        chunk = dev._chunk_size()
+        plan = (dev.chunks_of(n, chunk) if chunk and n > chunk
+                else [(0, n, dev._bucket(n))])
+        for start, end, b in plan:
+            sub = reqs[start:end]
+            rows = dev.prepare_batch([r.pub for r in sub],
+                                     [r.msg for r in sub],
+                                     [r.sig for r in sub])
+            padded = dev._pad_rows(end - start, b, *rows)
+            while len(inflight) >= 2:
+                self._drain_one(inflight)
+            pending = dev._compiled(b, impl, base_mxu)(*padded)
+            inflight.append((pending, sub))
+            self.stats["device_batches"] += 1
+
+    def _drain_one(self, inflight: deque) -> None:
+        import numpy as np
+
+        pending, reqs = inflight.popleft()
+        self.stats["pipelined_drains"] += 1
+        try:
+            oks = np.asarray(pending)[:len(reqs)]
+        except Exception:  # noqa: BLE001 — readback failed: host verdicts
+            self._host_verify(reqs, count_flush=False)
+            return
+        self._resolve(reqs, oks)
+
+    def _sync_device_verify(self, reqs: list[_Request], bv) -> None:
+        try:
+            oks = _split_verify([r.pub for r in reqs],
+                                [r.msg for r in reqs],
+                                [r.sig for r in reqs], bv._ed_batch)
+            self.stats["device_batches"] += 1
+        except Exception:  # noqa: BLE001
+            self._host_verify(reqs)
+            return
+        self._resolve(reqs, oks)
+
+    def _host_verify(self, reqs: list[_Request], count_flush: bool = True) -> None:
+        if count_flush:
+            self.stats["host_flushes"] += 1
+        try:
+            oks = _split_verify([r.pub for r in reqs],
+                                [r.msg for r in reqs],
+                                [r.sig for r in reqs],
+                                _ed.verify_batch_fast)
+        except BaseException as e:  # noqa: BLE001
+            self._resolve_failed(reqs, e)
+            return
+        self._resolve(reqs, oks)
+
+    def _resolve(self, reqs: list[_Request], oks) -> None:
+        for req, ok in zip(reqs, oks):
+            ok = bool(ok)
+            if ok:
+                self.cache.put(req.key)
+            req.future.set_result(ok)
+
+    def _resolve_failed(self, reqs: list[_Request], err: BaseException) -> None:
+        """Catastrophic path: even the batched host verify raised.  Fall
+        back to per-item verification so one poisoned row cannot take
+        the whole flush down; anything still failing propagates the
+        error to its submitter (same contract as the sync path, which
+        would have raised to the caller)."""
+        for req in reqs:
+            try:
+                ok = bool(_ed.verify_fast(req.pub, req.msg, req.sig))
+                if ok:
+                    self.cache.put(req.key)
+                req.future.set_result(ok)
+            except BaseException:  # noqa: BLE001
+                req.future.set_exception(err)
+
+
+class ServiceBatchVerifier:
+    """BatchVerifier-protocol adapter over the shared service: existing
+    call sites keep their add/count/verify shape, but the actual crypto
+    is submitted to the cross-caller queue — concurrent verifiers'
+    batches coalesce into one device dispatch, and duplicates resolve
+    from the verified-signature cache."""
+
+    def __init__(self, service: "VerifyService | None" = None):
+        self._svc = service or get_service()
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+
+    def add(self, pub_key, msg: bytes, sig: bytes) -> None:
+        self._items.append((_pub_bytes(pub_key), bytes(msg), bytes(sig)))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        items, self._items = self._items, []
+        if not items:
+            return False, []
+        oks = self._svc.verify_many(items)
+        return all(oks), oks
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton
+# ---------------------------------------------------------------------------
+
+_SERVICE: VerifyService | None = None
+_SERVICE_LOCK = threading.Lock()
+
+
+def service_enabled() -> bool:
+    """TM_TPU_ASYNC_VERIFY gates the routing of the framework's verify
+    surfaces through the service (default on); resolved per call so
+    tests/benches can flip it."""
+    return os.environ.get("TM_TPU_ASYNC_VERIFY", "1") != "0"
+
+
+def get_service() -> VerifyService:
+    global _SERVICE
+    svc = _SERVICE
+    if svc is not None:
+        return svc
+    with _SERVICE_LOCK:
+        if _SERVICE is None:
+            _SERVICE = VerifyService()
+        return _SERVICE
+
+
+def reset_service(**kwargs) -> VerifyService:
+    """Replace the singleton (tests/benchmarks): closes the old worker
+    and builds a fresh service with the given constructor overrides."""
+    global _SERVICE
+    with _SERVICE_LOCK:
+        if _SERVICE is not None:
+            _SERVICE.close()
+        _SERVICE = VerifyService(**kwargs)
+        return _SERVICE
+
+
+def verify_many(items) -> list[bool]:
+    """Module-level sync wrapper over the shared service."""
+    return get_service().verify_many(items)
+
+
+def submit(pub, msg: bytes, sig: bytes) -> Future:
+    return get_service().submit(pub, msg, sig)
+
+
+def service_stats() -> dict:
+    """Counters for metrics/bench scraping; zeros before first use (the
+    metrics server must not instantiate the service)."""
+    svc = _SERVICE
+    if svc is None:
+        return {"submitted": 0, "flushes": 0, "host_flushes": 0,
+                "device_batches": 0, "coalesced_max": 0,
+                "pipelined_drains": 0, "cache_hits": 0, "cache_misses": 0,
+                "cache_size": 0}
+    out = dict(svc.stats)
+    out["cache_hits"] = svc.cache.hits
+    out["cache_misses"] = svc.cache.misses
+    out["cache_size"] = len(svc.cache)
+    return out
+
+
+def new_service_batch_verifier():
+    """A BatchVerifier routed through the shared service when enabled,
+    else a plain per-caller verifier — THE constructor every verify
+    surface (vote slices, commit windows, evidence) should use."""
+    if service_enabled():
+        return ServiceBatchVerifier()
+    return _batch.new_batch_verifier()

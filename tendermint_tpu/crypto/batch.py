@@ -110,7 +110,17 @@ import threading as _threading
 
 _MEASURED_THRESHOLD: int | None = None
 _THRESHOLD_DIAG: dict = {}
+# Two locks with distinct jobs (ADVICE r5 high): _MEASURE_LOCK serializes
+# the actual device measurement and is held for its whole duration
+# (seconds-to-minutes through a tunnel, unbounded if it wedges);
+# _FLAG_LOCK guards only the started-flags and is held for nanoseconds.
+# start_threshold_measurement/start_device_warmup touch ONLY _FLAG_LOCK
+# (after a benign racy fast-path read), so a >=64-sig verify arriving
+# while the measurement worker holds _MEASURE_LOCK never blocks behind
+# it — the r5 single-lock shape wedged the consensus receive loop for
+# the measurement duration.
 _MEASURE_LOCK = _threading.Lock()
+_FLAG_LOCK = _threading.Lock()
 _MEASURE_STARTED = False
 _DEVICE_DISPATCHES = 0  # process-wide count of device-path batches
 
@@ -134,7 +144,12 @@ def start_device_warmup() -> None:
     _DEVICE_READY.  Failure (or a hang) leaves it unset — callers keep
     using the host path."""
     global _WARMUP_STARTED
-    with _MEASURE_LOCK:
+    # fast path WITHOUT any lock (benign racy read — worst case two
+    # threads reach the flag lock): callers are the verify hot path and
+    # must never queue behind an in-flight measurement (ADVICE r5 high)
+    if _WARMUP_STARTED or _MEASURE_STARTED or _DEVICE_READY.is_set():
+        return
+    with _FLAG_LOCK:
         if (_WARMUP_STARTED or _MEASURE_STARTED
                 or _DEVICE_READY.is_set()):
             return  # a measurement worker doubles as warmup
@@ -171,7 +186,14 @@ def start_threshold_measurement() -> None:
     route batches to the host path until `measured_cpu_threshold_ready()`
     reports the result."""
     global _MEASURE_STARTED
-    with _MEASURE_LOCK:
+    # fast path WITHOUT any lock (benign racy read): while the worker
+    # measures — holding _MEASURE_LOCK for the full device round trip —
+    # every >=64-sig verify lands here, and queueing on that lock would
+    # wedge the consensus receive loop for the measurement duration
+    # (ADVICE r5 high)
+    if _MEASURE_STARTED or _MEASURED_THRESHOLD is not None:
+        return
+    with _FLAG_LOCK:
         if _MEASURE_STARTED or _MEASURED_THRESHOLD is not None:
             return
         _MEASURE_STARTED = True
@@ -198,9 +220,14 @@ def measured_cpu_threshold() -> int:
     kept in `threshold_diagnostics()` and logged by callers.
 
     Thread-safe: the background worker (start_threshold_measurement) and
-    direct callers (bench harnesses) serialize on one lock, so the
-    device warm-up runs exactly once per process.
+    direct callers (bench harnesses) serialize on _MEASURE_LOCK, so the
+    device warm-up runs exactly once per process.  _MEASURE_STARTED is
+    raised first so concurrent start_* fast paths return without ever
+    touching this (long-held) lock.
     """
+    global _MEASURE_STARTED
+    with _FLAG_LOCK:
+        _MEASURE_STARTED = True
     with _MEASURE_LOCK:
         return _measure_cpu_threshold_locked()
 

@@ -19,13 +19,33 @@ from __future__ import annotations
 
 import hashlib
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.hazmat.primitives.hashes import SHA256
+try:
+    # Gated, not required at import (the minimal container lacks the
+    # `cryptography` package): pubkey wire handling, addresses, and the
+    # length-discriminated batch split work without it — importing this
+    # module eagerly from crypto.encoding used to take down every
+    # verify surface (pure-ed25519 batches included) on such a box.
+    # Only actual ECDSA operations (sign/verify/privkey derivation)
+    # need the backend and raise ImportError at the point of use.
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    _HAVE_ECDSA = True
+except Exception:  # pragma: no cover — ModuleNotFoundError and kin
+    _HAVE_ECDSA = False
+
+
+def _require_ecdsa() -> None:
+    if not _HAVE_ECDSA:
+        raise ImportError(
+            "secp256k1 ECDSA operations require the 'cryptography' "
+            "package, which is not installed in this environment"
+        )
 
 KEY_TYPE = "secp256k1"
 PUB_KEY_SIZE = 33
@@ -69,6 +89,7 @@ class PubKeySecp256k1:
             return False
         if s > _HALF_N:  # reject malleable high-S (reference :40-44)
             return False
+        _require_ecdsa()
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(
                 ec.SECP256K1(), self._bytes
@@ -100,6 +121,7 @@ class PrivKeySecp256k1:
         d = int.from_bytes(data, "big")
         if not 0 < d < _N:
             raise ValueError("secp256k1 privkey out of range")
+        _require_ecdsa()
         self._priv = ec.derive_private_key(d, ec.SECP256K1())
         from cryptography.hazmat.primitives.serialization import (
             Encoding,

@@ -21,9 +21,24 @@ from __future__ import annotations
 import os
 import struct
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.poly1305 import Poly1305
+try:
+    # Gated (see secp256k1.py): importers must survive a container
+    # without the `cryptography` package; AEAD operations raise at use.
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.poly1305 import Poly1305
+
+    _HAVE_AEAD = True
+except Exception:  # pragma: no cover — ModuleNotFoundError and kin
+    _HAVE_AEAD = False
+
+
+def _require_aead() -> None:
+    if not _HAVE_AEAD:
+        raise ImportError(
+            "symmetric AEAD operations require the 'cryptography' "
+            "package, which is not installed in this environment"
+        )
 
 _SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)  # "expand 32-byte k"
 _MASK = 0xFFFFFFFF
@@ -109,7 +124,8 @@ class XChaCha20Poly1305:
     def nonce_size(self) -> int:
         return XCHACHA_NONCE_SIZE
 
-    def _inner(self, nonce: bytes) -> tuple[ChaCha20Poly1305, bytes]:
+    def _inner(self, nonce: bytes) -> "tuple[ChaCha20Poly1305, bytes]":
+        _require_aead()
         if len(nonce) != XCHACHA_NONCE_SIZE:
             raise ValueError(f"xchacha20poly1305: bad nonce length {len(nonce)}")
         subkey = hchacha20(self._key, nonce[:16])
@@ -191,6 +207,7 @@ def secretbox_seal(plaintext: bytes, nonce: bytes, key: bytes) -> bytes:
         raise ValueError(f"secret must be {KEY_SIZE} bytes, got {len(key)}")
     if len(nonce) != XSALSA_NONCE_SIZE:
         raise ValueError(f"nonce must be {XSALSA_NONCE_SIZE} bytes, got {len(nonce)}")
+    _require_aead()
     stream = _xsalsa20_keystream(key, nonce, 32 + len(plaintext))
     cipher = bytes(a ^ b for a, b in zip(plaintext, stream[32:]))
     tag = Poly1305.generate_tag(stream[:32], cipher)
@@ -204,6 +221,7 @@ def secretbox_open(boxed: bytes, nonce: bytes, key: bytes) -> bytes:
         raise ValueError(f"nonce must be {XSALSA_NONCE_SIZE} bytes, got {len(nonce)}")
     if len(boxed) < TAG_SIZE:
         raise ValueError("ciphertext is too short")
+    _require_aead()
     tag, cipher = boxed[:TAG_SIZE], boxed[TAG_SIZE:]
     stream = _xsalsa20_keystream(key, nonce, 32 + len(cipher))
     try:

@@ -12,7 +12,6 @@ block's evidence list.
 
 from __future__ import annotations
 
-from tendermint_tpu.crypto import new_batch_verifier
 from tendermint_tpu.types.evidence import (
     DuplicateVoteEvidence,
     LightClientAttackEvidence,
@@ -78,8 +77,12 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str, val_set: Val
     if ev.total_voting_power != val_set.total_voting_power():
         raise ValueError("total voting power mismatch")
 
-    # both signatures as one batched device call
-    bv = new_batch_verifier()
+    # both signatures as one batched call, submitted via the async
+    # verification service so they coalesce with whatever else the node
+    # is verifying this moment
+    from tendermint_tpu.crypto.async_verify import new_service_batch_verifier
+
+    bv = new_service_batch_verifier()
     bv.add(val.pub_key, a.sign_bytes(chain_id), a.signature)
     bv.add(val.pub_key, b.sign_bytes(chain_id), b.signature)
     ok, per_sig = bv.verify()

@@ -110,6 +110,46 @@ class NodeMetrics:
                         for cid, v in sorted(node.router.bytes_sent.items())],
         ))
 
+        # -- crypto: the async verification service ---------------------
+        # counters scraped from crypto.async_verify.service_stats() —
+        # all zeros until the first verify touches the service, and the
+        # scrape itself never instantiates it
+        from tendermint_tpu.crypto import async_verify as _av
+
+        def _svc(key: str):
+            return lambda: _av.service_stats()[key]
+
+        self.verify_submitted = reg.register(Gauge(
+            "verify_submitted_total",
+            "Signatures submitted to the async verification service",
+            namespace=ns, subsystem="crypto", fn=_svc("submitted"),
+        ))
+        self.verify_cache_hits = reg.register(Gauge(
+            "verify_cache_hits_total",
+            "Verifications resolved from the verified-signature cache",
+            namespace=ns, subsystem="crypto", fn=_svc("cache_hits"),
+        ))
+        self.verify_cache_misses = reg.register(Gauge(
+            "verify_cache_misses_total",
+            "Verification cache lookups that missed",
+            namespace=ns, subsystem="crypto", fn=_svc("cache_misses"),
+        ))
+        self.verify_cache_size = reg.register(Gauge(
+            "verify_cache_size",
+            "Entries in the verified-signature cache",
+            namespace=ns, subsystem="crypto", fn=_svc("cache_size"),
+        ))
+        self.verify_flushes = reg.register(Gauge(
+            "verify_flushes_total",
+            "Coalesced batches flushed by the verification service",
+            namespace=ns, subsystem="crypto", fn=_svc("flushes"),
+        ))
+        self.verify_device_batches = reg.register(Gauge(
+            "verify_device_batches_total",
+            "Service flushes dispatched to the device path",
+            namespace=ns, subsystem="crypto", fn=_svc("device_batches"),
+        ))
+
         # -- state ------------------------------------------------------
         self.state = StateMetrics(reg, ns)
 

@@ -412,6 +412,19 @@ class Node:
             )
         else:
             self.logger.info("batch verifier ready", backend="cpu")
+        # the async verification service every verify surface submits to
+        # (crypto.async_verify): constructed here so its native-lib load
+        # also stays off the event loop; its worker thread spins up
+        # lazily at the first submission
+        from tendermint_tpu.crypto import async_verify as _av
+
+        if _av.service_enabled():
+            svc = await asyncio.to_thread(_av.get_service)
+            self.logger.info(
+                "async verify service ready",
+                linger_ms=svc.linger_s * 1e3,
+                cache_entries=svc.cache.maxsize,
+            )
         if self._pv_remote == "socket":
             # block until the remote signer dials in and the pubkey primes
             await asyncio.to_thread(self.priv_validator.wait_for_signer, 30.0)

@@ -24,13 +24,23 @@ import asyncio
 import hashlib
 import struct
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.hashes import SHA256
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    # Gated, not required at import: without the `cryptography` package
+    # an encrypted connection is impossible, but eagerly importing it
+    # here used to take the whole p2p/node package down with it on a
+    # minimal container.  Handshake/seal paths raise at the point of
+    # use instead.
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    _HAVE_AEAD = True
+except Exception:  # pragma: no cover — ModuleNotFoundError and kin
+    _HAVE_AEAD = False
 
 from tendermint_tpu.crypto.keys import PrivKey, PubKey
 
@@ -85,6 +95,11 @@ class SecretConnection:
 
     @classmethod
     async def _handshake(cls, reader, writer, priv_key: PrivKey) -> "SecretConnection":
+        if not _HAVE_AEAD:
+            raise HandshakeError(
+                "secret connections require the 'cryptography' package, "
+                "which is not installed in this environment"
+            )
         # 1. exchange ephemeral X25519 pubkeys in the clear
         eph_priv = X25519PrivateKey.generate()
         eph_pub = eph_priv.public_key().public_bytes_raw()

@@ -58,7 +58,10 @@ def sharded_rlc_fn(mesh: Mesh, impl: str, reduce_lanes: int = 2048):
     ~61 KB, folded on host by ops.ed25519_jax.finalize_rlc).  out_specs
     concatenate the per-device accumulator lanes along axis 0.
     reduce_lanes is baked into the trace, hence part of the cache key."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it in the experimental namespace
+        from jax.experimental.shard_map import shard_map
 
     _raw = _dev._core(impl)
 
@@ -92,6 +95,11 @@ def verify_batch_rlc_sharded(pubs, msgs, sigs, mesh: Mesh | None = None,
     if mesh is None:
         mesh = make_mesh()
     impl = impl or _dev.default_impl()
+    # opt-in kernel gate (ADVICE r5): a direct sharded call must run the
+    # same golden-batch self-check as the single-chip entry points — a
+    # wrong-verdict TM_TPU_FE_MXU program is disabled (and the sharded
+    # jit caches cleared) before any mesh trace is built
+    _dev._resolve_optin(impl)
     n_dev = mesh.devices.size
     pub_rows, r_rows, s_rows, k_rows, valid = _dev.prepare_batch(pubs, msgs, sigs)
     z_rows, zk_rows, c_row = _dev.prepare_rlc_scalars(s_rows, k_rows, valid)
@@ -138,4 +146,8 @@ def verify_batch_sharded(pubs, msgs, sigs, mesh: Mesh | None = None) -> np.ndarr
         return np.zeros(0, dtype=bool)
     if mesh is None:
         mesh = make_mesh()
+    # fe_mxu golden gate before any sharded trace (ADVICE r5): the
+    # mismatch branch flips the field-module flag and clears this
+    # module's jit caches, so the program built below is the safe one
+    _dev._resolve_optin(_dev.default_impl())
     return _verify_rows_sharded(_dev.prepare_batch(pubs, msgs, sigs), n, mesh)

@@ -3,6 +3,13 @@
 Parity: reference types/block.go:583-870 (CommitSig :603, VoteSignBytes
 :815, CommitToVoteSet in vote_set.py), wire form types.proto Commit{1..4},
 CommitSig{1..4}.
+
+Verification of a commit's signatures (ValidatorSet.verify_commit and
+the batched multi-commit surface, types/validator.batch_verify_commits)
+routes through the async verification service since round 6: the
+sign-bytes assembled here feed crypto.async_verify, where a replayed
+commit's (pub, msg, sig) triples hit the verified-signature cache and
+never reach host or device again.
 """
 
 from __future__ import annotations

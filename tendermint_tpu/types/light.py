@@ -4,6 +4,12 @@ Semantics parity: reference types/light.go (LightBlock :18-98,
 SignedHeader :100-175).  A SignedHeader is a header plus the commit that
 signed it; a LightBlock adds the validator set that produced the commit,
 with the cross-check that the set hashes to the header's ValidatorsHash.
+
+Signature verification of these bundles (light/verifier.py via
+ValidatorSet.verify_commit_light*) submits through the async
+verification service since round 6, so a light-client range verifying
+concurrently with consensus or blocksync coalesces into the same device
+batches and shares the verified-signature cache.
 """
 
 from __future__ import annotations

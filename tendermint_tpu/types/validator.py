@@ -21,7 +21,6 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from fractions import Fraction
 
-from tendermint_tpu.crypto import new_batch_verifier
 from tendermint_tpu.crypto import merkle
 from tendermint_tpu.crypto.keys import PubKey
 from tendermint_tpu.wire.proto import (
@@ -362,7 +361,9 @@ class ValidatorSet:
         if commit is None:
             raise ValueError("nil commit")
         needed = self.total_voting_power() * trust_level.numerator // trust_level.denominator
-        bv = new_batch_verifier()
+        from tendermint_tpu.crypto.async_verify import new_service_batch_verifier
+
+        bv = new_service_batch_verifier()
         entries = []
         seen: dict[int, int] = {}
         running = 0
@@ -480,8 +481,16 @@ def batch_verify_commits(jobs: list[CommitVerifyJob]) -> None:
     Accept/reject semantics per commit are identical to calling
     verify_commit / verify_commit_light individually; raises ValueError
     naming the first failing job's height.
+
+    Submits through the async verification service (crypto.async_verify)
+    by default, so a blocksync window, a light-client range, and a
+    consensus VerifyCommit arriving concurrently coalesce into one
+    device dispatch, and replayed commits resolve from the
+    verified-signature cache.
     """
-    bv = new_batch_verifier()
+    from tendermint_tpu.crypto.async_verify import new_service_batch_verifier
+
+    bv = new_service_batch_verifier()
     plans = []  # (job, entries=[(sig_batch_idx, val_idx, power)], needed)
     n = 0
     for job in jobs:

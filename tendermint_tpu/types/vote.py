@@ -155,10 +155,17 @@ def batch_verify_votes(chain_id: str, pairs: list[tuple["Vote", PubKey]]) -> lis
     returns a verdict per pair.  The single shared crypto path for every
     vote-slice verifier: VoteSet.add_votes and the consensus tick
     precheck (state._precheck_vote_sigs) — admission rules differ per
-    caller, the batched crypto must not."""
-    from tendermint_tpu.crypto import new_batch_verifier
+    caller, the batched crypto must not.
 
-    bv = new_batch_verifier()
+    Routed through the async verification service (crypto.async_verify)
+    by default: concurrent slices from independent callers (gossip
+    ticks, blocksync, replay) coalesce into one device batch, and
+    re-gossiped duplicates resolve from the verified-signature cache
+    without touching host or device.  TM_TPU_ASYNC_VERIFY=0 restores a
+    per-caller BatchVerifier."""
+    from tendermint_tpu.crypto.async_verify import new_service_batch_verifier
+
+    bv = new_service_batch_verifier()
     for v, pk in pairs:
         bv.add(pk, v.sign_bytes(chain_id), v.signature)
     _, oks = bv.verify()

@@ -9,6 +9,12 @@ addVote (:203).  Here `add_votes` pre-verifies a whole slice of votes —
 everything a gossip scheduler tick delivered — as ONE BatchVerifier device
 call, then applies the identical admission state machine with signatures
 already checked.  `add_vote` is the single-vote convenience wrapper.
+
+Round 6: the slice's crypto (vote.batch_verify_votes) submits to the
+async verification service (crypto.async_verify), so concurrent slices
+from independent VoteSets coalesce into one device dispatch and
+re-gossiped duplicate signatures resolve from the verified-signature
+cache without re-verification.
 """
 
 from __future__ import annotations

@@ -182,10 +182,14 @@ def test_device_readiness_gates_dispatch(monkeypatch):
 
 
 def test_threshold_measurement_never_blocks_verify(monkeypatch):
-    """VERDICT r4 item 5 acceptance: the first >=64-sig batch completes
-    on the host path while a SLOW measurement (2 s, standing in for the
-    tunnel warm-up) runs behind it; the verify call must not wait on
-    it."""
+    """VERDICT r4 item 5 acceptance, hardened per ADVICE r5 (high): the
+    first >=64-sig batch completes on the host path while a SLOW
+    measurement (2 s, standing in for the tunnel warm-up) runs behind
+    it — and, crucially, the measurement worker HOLDS _MEASURE_LOCK for
+    its whole duration exactly like the real measured_cpu_threshold, so
+    a SECOND concurrent verify (whose start_threshold_measurement must
+    fast-path on the started flag without touching that lock) cannot
+    queue behind the in-flight measurement either."""
     import time
 
     from tendermint_tpu.crypto import batch
@@ -196,11 +200,17 @@ def test_threshold_measurement_never_blocks_verify(monkeypatch):
     monkeypatch.setattr(batch, "_MEASURE_STARTED", False)
 
     started = []
+    lock_held = __import__("threading").Event()
 
     def slow_measure():
-        started.append(time.monotonic())
-        time.sleep(2.0)
-        batch._MEASURED_THRESHOLD = 4096
+        # mimic the real shape: the WHOLE measurement runs under
+        # _MEASURE_LOCK (the ADVICE r5 regression was precisely that
+        # callers queued on this lock)
+        with batch._MEASURE_LOCK:
+            started.append(time.monotonic())
+            lock_held.set()
+            time.sleep(2.0)
+            batch._MEASURED_THRESHOLD = 4096
         return 4096
 
     monkeypatch.setattr(batch, "measured_cpu_threshold", slow_measure)
@@ -218,3 +228,63 @@ def test_threshold_measurement_never_blocks_verify(monkeypatch):
     # 2 s the measurement needs
     assert elapsed < 0.5, f"verify blocked {elapsed:.3f}s on measurement"
     assert started, "measurement worker was never kicked"
+
+    # second verify while the lock-holding measurement is in flight:
+    # must also complete on the host path without queueing on the lock
+    assert lock_held.wait(5.0)
+    for i, p in enumerate(privs):
+        m = b"block2-%d" % i
+        v.add(p.pub_key(), m, p.sign(m))
+    t0 = time.monotonic()
+    all_ok, oks = v.verify()
+    elapsed = time.monotonic() - t0
+    assert all_ok and len(oks) == 64
+    assert elapsed < 0.5, (
+        f"second verify blocked {elapsed:.3f}s behind the in-flight "
+        "measurement (start_threshold_measurement queued on _MEASURE_LOCK)"
+    )
+
+
+def test_wedged_device_never_blocks_submitters(monkeypatch):
+    """Async-service acceptance (round 6): a deliberately WEDGED device
+    — warmup hangs forever, standing in for a dead tunnel — must never
+    block `submit()` callers: flushes at/above the dispatch threshold
+    route to the host path while the wedged warmup dangles, and the
+    futures resolve promptly."""
+    import threading
+    import time
+
+    from tendermint_tpu.crypto import async_verify as av
+    from tendermint_tpu.crypto import batch
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+
+    monkeypatch.setattr(batch, "_DEVICE_READY", threading.Event())  # unset
+    monkeypatch.setattr(batch, "_WARMUP_STARTED", False)
+    warmups = []
+
+    def wedged_warmup():
+        warmups.append(1)
+        # the REAL warmup would now hang on backend init forever; the
+        # service must not be waiting on it
+
+    monkeypatch.setattr(batch, "start_device_warmup", wedged_warmup)
+
+    svc = av.reset_service(linger_ms=1.0, cpu_threshold=8)
+    try:
+        privs = [priv_key_from_seed(bytes([i + 1]) * 32) for i in range(16)]
+        items = []
+        for i, p in enumerate(privs):
+            m = b"wedged-%d" % i
+            items.append((p.pub_key().bytes_(), m, p.sign(m)))
+        t0 = time.monotonic()
+        futs = [svc.submit(*it) for it in items]
+        submit_dt = time.monotonic() - t0
+        assert submit_dt < 0.25, f"submit blocked {submit_dt:.3f}s"
+        oks = [f.result(timeout=10.0) for f in futs]
+        assert oks == [True] * 16
+        assert warmups, "warmup was never kicked for the >=threshold flush"
+        st = av.service_stats()
+        assert st["device_batches"] == 0, "dispatched to an unproven device"
+        assert st["host_flushes"] >= 1
+    finally:
+        av.reset_service()
