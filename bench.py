@@ -653,6 +653,30 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["async_coalesce_error"] = str(e)[-300:]
 
+        # Per-stage trace summary (round 7): with TM_TPU_TRACE=1 the
+        # async-coalesce stage above ran with span tracing live, so the
+        # verify pipeline's submit/coalesce/flush/host/device spans are
+        # in the utils.trace ring.  Fold p50/p95/p99 per span name into
+        # the BENCH json and dump the Perfetto-loadable Chrome trace next
+        # to it — per-stage timing now ships in the artifact instead of
+        # living in ad-hoc bench code.
+        _stage_set("trace-export")
+        try:
+            from tendermint_tpu.utils import trace as _tr
+
+            if _tr.enabled():
+                summ = _tr.summary()
+                _partial["trace_summary"] = summ
+                _partial["trace_spans"] = sum(
+                    v["count"] for v in summ.values())
+                out_path = os.environ.get("TM_TPU_TRACE_OUT",
+                                          "bench_trace.json")
+                with open(out_path, "w") as f:
+                    f.write(_tr.export_chrome())
+                _partial["trace_out"] = out_path
+        except Exception as e:  # noqa: BLE001
+            _partial["trace_error"] = str(e)[-300:]
+
         _stage_set("pair-median")
         assert headline_pairs, "headline path recorded no (prod, baseline) pairs"
         base = statistics.median(b for _p, b in headline_pairs)

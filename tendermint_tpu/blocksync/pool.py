@@ -21,11 +21,23 @@ import time
 from dataclasses import dataclass, field
 
 from tendermint_tpu.types.block import Block
+from tendermint_tpu.utils import trace as _trace
+from tendermint_tpu.utils.metrics import Histogram
 
 # reference pool.go:31-35: bounds on outstanding requests
 MAX_PENDING_AHEAD = 600  # how far past the apply point we request
 MAX_PENDING_PER_PEER = 20
 REQUEST_TIMEOUT_S = 15.0  # ban a peer that sits on a request this long
+
+# Schedule-to-arrival round trip per block request (process-wide;
+# registered by node/metrics.py).  Top bucket == the ban deadline.
+REQUEST_DURATION_SECONDS = Histogram(
+    "request_duration_seconds",
+    "Block request round trip, schedule to block arrival",
+    namespace="tendermint", subsystem="blocksync",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             REQUEST_TIMEOUT_S),
+)
 
 
 @dataclass
@@ -161,6 +173,11 @@ class BlockPool:
         if r is None or r.peer_id != peer_id or r.block is not None:
             return False
         r.block = block
+        dur = time.monotonic() - r.sent_at
+        REQUEST_DURATION_SECONDS.observe(dur)
+        if _trace.enabled():
+            _trace.record("blocksync.request", time.perf_counter() - dur,
+                          dur, height=h, peer=peer_id)
         # wake the sync loop whenever the apply point has a block — NOT
         # only when h == self.height: the loop may have drained the event
         # on a too-short window, and a later height extending the run must

@@ -21,9 +21,12 @@ signature inline per addVote, types/vote_set.go:203).
 from __future__ import annotations
 
 import asyncio
+import time
 
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.state import State
+from tendermint_tpu.utils import trace as _trace
+from tendermint_tpu.utils.metrics import Histogram
 from tendermint_tpu.types import (
     Block,
     BlockID,
@@ -51,6 +54,21 @@ from .ticker import TimeoutTicker
 from .wal import NopWAL
 
 TIME_IOTA_NS = 1_000_000  # 1ms minimum inter-block time grain
+
+# Matches upstream Tendermint's consensus_step_duration_seconds
+# (consensus/metrics.go StepDuration): time spent in each FSM step,
+# labeled by the step being LEFT.  Process-wide like the verify-service
+# histograms; node/metrics.py registers it for /metrics exposition.
+# Observed only at step transitions (a handful per block), so this does
+# not violate the "no metrics code in the hot path" rule.
+STEP_DURATION_SECONDS = Histogram(
+    "step_duration_seconds",
+    "Time spent per consensus step, labeled by the step being left",
+    namespace="tendermint", subsystem="consensus",
+    label_names=("step",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0),
+)
 
 
 class ConsensusFailureError(Exception):
@@ -92,6 +110,7 @@ class ConsensusState:
         self.event_bus = None  # types.events.EventBus — external observers
         self._task: asyncio.Task | None = None
         self._stopping = False
+        self._step_t0: float | None = None  # when the current step began
 
         self.reconstruct_last_commit(state)
         self.update_to_state(state)
@@ -373,9 +392,12 @@ class ConsensusState:
         if height == 1:
             height = state.initial_height
 
+        self._observe_step()  # COMMIT (or startup) -> NEW_HEIGHT
         rs.height = height
         rs.round = 0
         rs.step = Step.NEW_HEIGHT
+        if _trace.enabled() and not self.replay_mode:
+            _trace.instant("consensus.new_height", height=height)
         if rs.commit_time_ns == 0:
             rs.start_time_ns = now_ns() + self.config.timeout_commit_ms * 1_000_000
         else:
@@ -407,9 +429,25 @@ class ConsensusState:
     def _update_round_step(self, round_: int, step: Step) -> None:
         if not self.replay_mode:
             pass  # (reference fires newStep events here)
+        self._observe_step()
         self.rs.round = round_
         self.rs.step = step
         self._emit("new_round_step")
+
+    def _observe_step(self) -> None:
+        """Record how long the step we are leaving lasted — the
+        step_duration histogram plus (when tracing) a complete span
+        carrying height/round.  WAL replay transitions are synthetic and
+        are excluded, same as event publication."""
+        now = time.perf_counter()
+        t0, self._step_t0 = self._step_t0, now
+        if self.replay_mode or t0 is None:
+            return
+        prev = self.rs.step
+        STEP_DURATION_SECONDS.observe(now - t0, step=prev.name)
+        if _trace.enabled():
+            _trace.record("consensus.step", t0, now - t0, step=prev.name,
+                          height=self.rs.height, round=self.rs.round)
 
     def _emit(self, name: str, payload=None) -> None:
         if self.on_event is not None:
@@ -469,6 +507,8 @@ class ConsensusState:
         if rs.round < round_:
             validators = validators.copy_increment_proposer_priority(round_ - rs.round)
         rs.validators = validators
+        if _trace.enabled() and not self.replay_mode:
+            _trace.instant("consensus.new_round", height=height, round=round_)
         self._update_round_step(round_, Step.NEW_ROUND)
         if round_ != 0:
             # round 0 keeps proposals from NewHeight; later rounds start over

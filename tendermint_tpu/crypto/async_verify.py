@@ -45,6 +45,10 @@ Env knobs:
   TM_TPU_LINGER_MS      coalescing window in milliseconds (default 1.0).
   TM_TPU_VERIFY_CACHE   verified-signature cache capacity in entries
                         (default 65536; 0 disables the cache).
+  TM_TPU_TRACE          1 additionally records submit/coalesce/flush/
+                        host-prep/device-execute spans into the
+                        utils.trace ring (docs/observability.md); the
+                        latency histograms below are always on.
 """
 
 from __future__ import annotations
@@ -52,8 +56,12 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
+
+from tendermint_tpu.utils import trace as _trace
+from tendermint_tpu.utils.metrics import Histogram
 
 from . import ed25519 as _ed
 from . import batch as _batch
@@ -62,6 +70,46 @@ from .batch import _pub_bytes, _split_verify
 DEFAULT_LINGER_MS = 1.0
 DEFAULT_CACHE_SIZE = 65536
 MAX_COALESCE = 16384  # per-flush cap == the bucket ladder's top rung
+
+# -- pipeline latency histograms (process-wide, like the service itself;
+# node/metrics.py registers them so every node's /metrics scrape exposes
+# them).  Buckets reach down to 50us: host flushes of small rungs finish
+# well under the default prometheus grid.
+_FAST_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+VERIFY_QUEUE_WAIT_SECONDS = Histogram(
+    "verify_queue_wait_seconds",
+    "Time a request sits in the submission queue before its flush",
+    namespace="tendermint", subsystem="crypto", buckets=_FAST_BUCKETS)
+VERIFY_LINGER_SECONDS = Histogram(
+    "verify_linger_seconds",
+    "How long a flush lingered coalescing before dispatch",
+    namespace="tendermint", subsystem="crypto", buckets=_FAST_BUCKETS)
+VERIFY_HOST_PREP_SECONDS = Histogram(
+    "verify_host_prep_seconds",
+    "Host-side device-batch preparation (sign-bytes SHA-512, s<L, padding)",
+    namespace="tendermint", subsystem="crypto", buckets=_FAST_BUCKETS)
+VERIFY_DEVICE_EXECUTE_SECONDS = Histogram(
+    "verify_device_execute_seconds",
+    "Device enqueue to verdict readback per chunk, by bucket rung",
+    namespace="tendermint", subsystem="crypto", label_names=("rung",),
+    buckets=_FAST_BUCKETS)
+VERIFY_E2E_SECONDS = Histogram(
+    "verify_e2e_seconds",
+    "Submit to resolve end to end, by resolution path",
+    namespace="tendermint", subsystem="crypto", label_names=("path",),
+    buckets=_FAST_BUCKETS)
+
+PIPELINE_HISTOGRAMS = (
+    VERIFY_QUEUE_WAIT_SECONDS,
+    VERIFY_LINGER_SECONDS,
+    VERIFY_HOST_PREP_SECONDS,
+    VERIFY_DEVICE_EXECUTE_SECONDS,
+    VERIFY_E2E_SECONDS,
+)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -79,14 +127,16 @@ def _env_int(name: str, default: int) -> int:
 
 
 class _Request:
-    __slots__ = ("pub", "msg", "sig", "key", "future")
+    __slots__ = ("pub", "msg", "sig", "key", "future", "t_submit")
 
-    def __init__(self, pub: bytes, msg: bytes, sig: bytes, key, future: Future):
+    def __init__(self, pub: bytes, msg: bytes, sig: bytes, key, future: Future,
+                 t_submit: float):
         self.pub = pub
         self.msg = msg
         self.sig = sig
         self.key = key
         self.future = future
+        self.t_submit = t_submit
 
 
 class VerifiedSigCache:
@@ -176,6 +226,7 @@ class VerifyService:
         """Bulk submit: one cache pass + one queue append under a single
         lock acquisition — the large-batch path (a 10k commit) must not
         pay per-item lock traffic."""
+        t_sub = time.perf_counter()  # one stamp per bulk submit, not per item
         futures: list[Future] = []
         fresh: list[_Request] = []
         for pub, msg, sig in items:
@@ -187,8 +238,10 @@ class VerifyService:
             futures.append(fut)
             if self.cache.get(key):
                 fut.set_result(True)
+                VERIFY_E2E_SECONDS.observe(time.perf_counter() - t_sub,
+                                           path="cache")
             else:
-                fresh.append(_Request(pub_b, msg_b, sig_b, key, fut))
+                fresh.append(_Request(pub_b, msg_b, sig_b, key, fut, t_sub))
         if fresh:
             with self._cv:
                 if self._closed:
@@ -197,6 +250,10 @@ class VerifyService:
                 self._queue.extend(fresh)
                 self._ensure_worker_locked()
                 self._cv.notify()
+        if _trace.enabled():
+            _trace.record("verify.submit", t_sub,
+                          time.perf_counter() - t_sub,
+                          n=len(futures), fresh=len(fresh))
         return futures
 
     def verify_many(self, items) -> list[bool]:
@@ -243,14 +300,13 @@ class VerifyService:
         """Take the next coalesced batch off the queue: wait (if `block`)
         for the first request, then linger until the rung fills or the
         deadline passes."""
-        import time
-
         with self._cv:
             if block:
                 while not self._queue and not self._closed:
                     self._cv.wait()
             if not self._queue:
                 return []
+            t_linger0 = time.perf_counter()
             if self.linger_s > 0:
                 rung = self._flush_rung()
                 deadline = time.monotonic() + self.linger_s
@@ -261,9 +317,18 @@ class VerifyService:
                     self._cv.wait(remaining)
             batch = [self._queue.popleft()
                      for _ in range(min(len(self._queue), MAX_COALESCE))]
-        self.stats["flushes"] += 1
-        self.stats["coalesced_max"] = max(self.stats["coalesced_max"],
-                                          len(batch))
+            # counter updates stay inside the lock so service_stats()
+            # snapshots are never torn across a flush boundary
+            self.stats["flushes"] += 1
+            self.stats["coalesced_max"] = max(self.stats["coalesced_max"],
+                                              len(batch))
+        now = time.perf_counter()
+        VERIFY_LINGER_SECONDS.observe(now - t_linger0)
+        for r in batch:
+            VERIFY_QUEUE_WAIT_SECONDS.observe(now - r.t_submit)
+        if _trace.enabled():
+            _trace.record("verify.coalesce", t_linger0, now - t_linger0,
+                          n=len(batch))
         return batch
 
     def _run(self) -> None:
@@ -291,23 +356,32 @@ class VerifyService:
 
     def _flush(self, reqs: list[_Request], inflight: deque) -> None:
         """Route one coalesced batch: host below threshold / before
-        device readiness; async device enqueue otherwise."""
+        device readiness; async device enqueue otherwise.  The flush
+        span records which path won and WHY (the question the raw
+        counters could never answer)."""
+        t0 = time.perf_counter()
+        path, reason = self._route(reqs, inflight)
+        if _trace.enabled():
+            _trace.record("verify.flush", t0, time.perf_counter() - t0,
+                          path=path, reason=reason, n=len(reqs))
+
+    def _route(self, reqs: list[_Request], inflight: deque) -> tuple[str, str]:
         n = len(reqs)
         bv = self._jax_bv
         if bv is None:
             self._host_verify(reqs)
-            return
+            return "host", "no_jax"
         thr = bv._resolved_threshold(n)
         if n < thr:
             self._host_verify(reqs)
-            return
+            return "host", "below_threshold"
         if not _batch._DEVICE_READY.is_set():
             # identical degradation to JAXBatchVerifier._ed_batch: kick
             # the warmup worker, verify on host meanwhile — a wedged
             # tunnel must never block a submitter
             _batch.start_device_warmup()
             self._host_verify(reqs)
-            return
+            return "host", "device_not_ready"
         mixed = any(len(r.pub) != 32 for r in reqs)
         if mixed or bv._device_count() > 1 or \
                 os.environ.get("TM_TPU_RLC", "0") == "1":
@@ -315,11 +389,13 @@ class VerifyService:
             # the existing synchronous routing — bit-identical verdicts,
             # no pipelining
             self._sync_device_verify(reqs, bv)
-            return
+            return "device", "sync_routing"
         try:
             self._enqueue_device(reqs, inflight)
+            return "device", "pipelined"
         except Exception:  # noqa: BLE001 — device hiccup: host fallback
             self._host_verify(reqs)
+            return "host", "device_error"
 
     def _enqueue_device(self, reqs: list[_Request], inflight: deque) -> None:
         """Host prep + async enqueue of the per-row device program,
@@ -336,42 +412,67 @@ class VerifyService:
                 else [(0, n, dev._bucket(n))])
         for start, end, b in plan:
             sub = reqs[start:end]
+            t_prep = time.perf_counter()
             rows = dev.prepare_batch([r.pub for r in sub],
                                      [r.msg for r in sub],
                                      [r.sig for r in sub])
             padded = dev._pad_rows(end - start, b, *rows)
+            prep_dt = time.perf_counter() - t_prep
+            VERIFY_HOST_PREP_SECONDS.observe(prep_dt)
+            if _trace.enabled():
+                _trace.record("verify.host_prep", t_prep, prep_dt,
+                              n=end - start, rung=b)
             while len(inflight) >= 2:
                 self._drain_one(inflight)
+            t_enq = time.perf_counter()
             pending = dev._compiled(b, impl, base_mxu)(*padded)
-            inflight.append((pending, sub))
-            self.stats["device_batches"] += 1
+            inflight.append((pending, sub, t_enq, b))
+            with self._cv:
+                self.stats["device_batches"] += 1
 
     def _drain_one(self, inflight: deque) -> None:
         import numpy as np
 
-        pending, reqs = inflight.popleft()
-        self.stats["pipelined_drains"] += 1
+        pending, reqs, t_enq, rung = inflight.popleft()
+        with self._cv:
+            self.stats["pipelined_drains"] += 1
         try:
             oks = np.asarray(pending)[:len(reqs)]
         except Exception:  # noqa: BLE001 — readback failed: host verdicts
             self._host_verify(reqs, count_flush=False)
             return
-        self._resolve(reqs, oks)
+        dt = time.perf_counter() - t_enq
+        VERIFY_DEVICE_EXECUTE_SECONDS.observe(dt, rung=rung)
+        if _trace.enabled():
+            # enqueue-to-readback: includes time queued behind the other
+            # in-flight batch, i.e. what a submitter actually experiences
+            _trace.record("verify.device_execute", t_enq, dt,
+                          n=len(reqs), rung=rung)
+        self._resolve(reqs, oks, path="device")
 
     def _sync_device_verify(self, reqs: list[_Request], bv) -> None:
+        t0 = time.perf_counter()
         try:
             oks = _split_verify([r.pub for r in reqs],
                                 [r.msg for r in reqs],
                                 [r.sig for r in reqs], bv._ed_batch)
-            self.stats["device_batches"] += 1
+            with self._cv:
+                self.stats["device_batches"] += 1
         except Exception:  # noqa: BLE001
             self._host_verify(reqs)
             return
-        self._resolve(reqs, oks)
+        dt = time.perf_counter() - t0
+        VERIFY_DEVICE_EXECUTE_SECONDS.observe(dt, rung="sync")
+        if _trace.enabled():
+            _trace.record("verify.device_execute", t0, dt,
+                          n=len(reqs), rung="sync")
+        self._resolve(reqs, oks, path="device")
 
     def _host_verify(self, reqs: list[_Request], count_flush: bool = True) -> None:
         if count_flush:
-            self.stats["host_flushes"] += 1
+            with self._cv:
+                self.stats["host_flushes"] += 1
+        t0 = time.perf_counter()
         try:
             oks = _split_verify([r.pub for r in reqs],
                                 [r.msg for r in reqs],
@@ -380,13 +481,18 @@ class VerifyService:
         except BaseException as e:  # noqa: BLE001
             self._resolve_failed(reqs, e)
             return
-        self._resolve(reqs, oks)
+        if _trace.enabled():
+            _trace.record("verify.host_verify", t0,
+                          time.perf_counter() - t0, n=len(reqs))
+        self._resolve(reqs, oks, path="host")
 
-    def _resolve(self, reqs: list[_Request], oks) -> None:
+    def _resolve(self, reqs: list[_Request], oks, path: str = "host") -> None:
+        now = time.perf_counter()
         for req, ok in zip(reqs, oks):
             ok = bool(ok)
             if ok:
                 self.cache.put(req.key)
+            VERIFY_E2E_SECONDS.observe(now - req.t_submit, path=path)
             req.future.set_result(ok)
 
     def _resolve_failed(self, reqs: list[_Request], err: BaseException) -> None:
@@ -478,17 +584,23 @@ def submit(pub, msg: bytes, sig: bytes) -> Future:
 
 def service_stats() -> dict:
     """Counters for metrics/bench scraping; zeros before first use (the
-    metrics server must not instantiate the service)."""
+    metrics server must not instantiate the service).  The service
+    counters are snapshotted under the service lock and the cache
+    counters under the cache lock, so a scrape never observes a torn
+    counter set (e.g. a flush counted but its coalesced_max not yet)."""
     svc = _SERVICE
     if svc is None:
         return {"submitted": 0, "flushes": 0, "host_flushes": 0,
                 "device_batches": 0, "coalesced_max": 0,
                 "pipelined_drains": 0, "cache_hits": 0, "cache_misses": 0,
                 "cache_size": 0}
-    out = dict(svc.stats)
-    out["cache_hits"] = svc.cache.hits
-    out["cache_misses"] = svc.cache.misses
-    out["cache_size"] = len(svc.cache)
+    with svc._cv:
+        out = dict(svc.stats)
+    cache = svc.cache
+    with cache._lock:
+        out["cache_hits"] = cache.hits
+        out["cache_misses"] = cache.misses
+        out["cache_size"] = len(cache._d)
     return out
 
 

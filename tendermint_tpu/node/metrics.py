@@ -113,23 +113,25 @@ class NodeMetrics:
         # -- crypto: the async verification service ---------------------
         # counters scraped from crypto.async_verify.service_stats() —
         # all zeros until the first verify touches the service, and the
-        # scrape itself never instantiates it
+        # scrape itself never instantiates it.  Monotonic *_total series
+        # are CallbackCounter so the exposition advertises `counter`.
         from tendermint_tpu.crypto import async_verify as _av
+        from tendermint_tpu.utils.metrics import CallbackCounter
 
         def _svc(key: str):
             return lambda: _av.service_stats()[key]
 
-        self.verify_submitted = reg.register(Gauge(
+        self.verify_submitted = reg.register(CallbackCounter(
             "verify_submitted_total",
             "Signatures submitted to the async verification service",
             namespace=ns, subsystem="crypto", fn=_svc("submitted"),
         ))
-        self.verify_cache_hits = reg.register(Gauge(
+        self.verify_cache_hits = reg.register(CallbackCounter(
             "verify_cache_hits_total",
             "Verifications resolved from the verified-signature cache",
             namespace=ns, subsystem="crypto", fn=_svc("cache_hits"),
         ))
-        self.verify_cache_misses = reg.register(Gauge(
+        self.verify_cache_misses = reg.register(CallbackCounter(
             "verify_cache_misses_total",
             "Verification cache lookups that missed",
             namespace=ns, subsystem="crypto", fn=_svc("cache_misses"),
@@ -139,16 +141,35 @@ class NodeMetrics:
             "Entries in the verified-signature cache",
             namespace=ns, subsystem="crypto", fn=_svc("cache_size"),
         ))
-        self.verify_flushes = reg.register(Gauge(
+        self.verify_flushes = reg.register(CallbackCounter(
             "verify_flushes_total",
             "Coalesced batches flushed by the verification service",
             namespace=ns, subsystem="crypto", fn=_svc("flushes"),
         ))
-        self.verify_device_batches = reg.register(Gauge(
+        self.verify_device_batches = reg.register(CallbackCounter(
             "verify_device_batches_total",
             "Service flushes dispatched to the device path",
             namespace=ns, subsystem="crypto", fn=_svc("device_batches"),
         ))
+
+        # -- latency histograms fed at their source ---------------------
+        # Process-wide module singletons (the verify service, the FSM,
+        # blocksync and RPC observe them where the timing happens); this
+        # registry only EXPOSES them.  They carry the "tendermint"
+        # namespace baked in at definition, matching the default ns here.
+        from tendermint_tpu.blocksync.pool import (
+            REQUEST_DURATION_SECONDS as _bsync_hist,
+        )
+        from tendermint_tpu.consensus.state import STEP_DURATION_SECONDS
+        from tendermint_tpu.rpc.server import (
+            REQUEST_DURATION_SECONDS as _rpc_hist,
+        )
+
+        self.step_duration = reg.register(STEP_DURATION_SECONDS)
+        self.blocksync_request_duration = reg.register(_bsync_hist)
+        self.rpc_request_duration = reg.register(_rpc_hist)
+        for hist in _av.PIPELINE_HISTOGRAMS:
+            reg.register(hist)
 
         # -- state ------------------------------------------------------
         self.state = StateMetrics(reg, ns)

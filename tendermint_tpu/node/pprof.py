@@ -7,6 +7,9 @@ The Python equivalents of goroutine/heap profiles:
     GET /debug/pprof/          index
     GET /debug/pprof/goroutine all thread stacks + live asyncio tasks
     GET /debug/pprof/heap      gc object counts by type (top 50)
+    GET /debug/pprof/trace     recent span ring (utils.trace) as JSONL;
+                               ?fmt=chrome returns the Perfetto-loadable
+                               Chrome trace-event JSON
 
 Plain text responses, stdlib only.
 """
@@ -16,10 +19,17 @@ from __future__ import annotations
 import asyncio
 import gc
 import sys
+import time
 import traceback
+import urllib.parse
 from collections import Counter
 
 from tendermint_tpu.utils.log import Logger, nop_logger
+
+# _heap_dump scans at most this many gc objects per request: walking the
+# full heap is unbounded on large nodes, and this endpoint gets scraped
+# exactly when the node is loaded.
+HEAP_SCAN_LIMIT = 200_000
 
 
 def _goroutine_dump() -> str:
@@ -43,10 +53,33 @@ def _goroutine_dump() -> str:
     return "\n".join(out) + "\n"
 
 
-def _heap_dump(top: int = 50) -> str:
-    counts = Counter(type(o).__name__ for o in gc.get_objects())
+def _heap_dump(top: int = 50, max_objects: int = HEAP_SCAN_LIMIT) -> str:
+    t0 = time.perf_counter()
+    objs = gc.get_objects()
+    total = len(objs)
+    scanned = min(total, max_objects)
+    counts = Counter(type(o).__name__ for o in objs[:scanned])
+    del objs
+    dt_ms = (time.perf_counter() - t0) * 1e3
     lines = [f"{n:>10}  {name}" for name, n in counts.most_common(top)]
-    return f"gc objects by type (top {top}):\n" + "\n".join(lines) + "\n"
+    return (
+        f"gc objects by type (top {top}; scanned {scanned}/{total} "
+        f"objects in {dt_ms:.1f}ms):\n" + "\n".join(lines) + "\n"
+    )
+
+
+def _trace_dump(fmt: str) -> tuple[str, str]:
+    """(content_type, body) for the span-ring dump."""
+    from tendermint_tpu.utils import trace as tmtrace
+
+    if fmt == "chrome":
+        return "application/json", tmtrace.export_chrome()
+    head = (
+        f"# trace ring: enabled={int(tmtrace.enabled())} "
+        f"spans={len(tmtrace.spans())} capacity={tmtrace.ring_size()} "
+        f"(TM_TPU_TRACE / TM_TPU_TRACE_RING; ?fmt=chrome for Perfetto)\n"
+    )
+    return "text/plain", head + tmtrace.export_jsonl() + "\n"
 
 
 class PprofServer:
@@ -68,15 +101,22 @@ class PprofServer:
         await self._http.stop()
 
     async def _route(self, path: str):
-        if path.startswith("/debug/pprof/goroutine"):
+        parsed = urllib.parse.urlsplit(path)
+        route = parsed.path
+        if route.startswith("/debug/pprof/goroutine"):
             body = _goroutine_dump()
-        elif path.startswith("/debug/pprof/heap"):
+        elif route.startswith("/debug/pprof/heap"):
             # off the event loop: walking the gc heap can take seconds on
             # a loaded node, exactly when this endpoint gets scraped
             body = await asyncio.to_thread(_heap_dump)
-        elif path.startswith("/debug/pprof"):
+        elif route.startswith("/debug/pprof/trace"):
+            fmt = urllib.parse.parse_qs(parsed.query).get("fmt", [""])[0]
+            ctype, body = _trace_dump(fmt)
+            return 200, ctype, body.encode()
+        elif route.startswith("/debug/pprof"):
             body = ("pprof analog endpoints:\n"
-                    "/debug/pprof/goroutine\n/debug/pprof/heap\n")
+                    "/debug/pprof/goroutine\n/debug/pprof/heap\n"
+                    "/debug/pprof/trace[?fmt=chrome]\n")
         else:
             return None
         return 200, "text/plain", body.encode()

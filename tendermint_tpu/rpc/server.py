@@ -14,12 +14,15 @@ from __future__ import annotations
 import asyncio
 import inspect
 import json
+import time
 import urllib.parse
 
 from tendermint_tpu.pubsub import SubscriptionCancelledError
 from tendermint_tpu.pubsub.query import parse as parse_query
 from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.utils import trace as _tmtrace
 from tendermint_tpu.utils.log import Logger, nop_logger
+from tendermint_tpu.utils.metrics import Histogram
 
 from . import core
 from .jsonrpc import (
@@ -39,6 +42,18 @@ from .websocket import OP_TEXT, WSConnection, accept_key
 # hash (reference decodes by the handler's declared arg type,
 # http_uri_handler.go jsonStringToArg; we key off the param name instead).
 _RAW_STRING_PARAMS = frozenset({"tx", "hash", "data", "evidence", "path", "query"})
+
+# Handler latency per RPC method (process-wide; registered by
+# node/metrics.py).  Only KNOWN methods are observed — unknown method
+# strings must not mint label cardinality.
+REQUEST_DURATION_SECONDS = Histogram(
+    "request_duration_seconds",
+    "RPC handler latency by method",
+    namespace="tendermint", subsystem="rpc",
+    label_names=("method",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0),
+)
 
 
 def _coerce_uri_value(name: str, v: str):
@@ -321,6 +336,7 @@ class RPCServer:
             _route_signature(fn).bind(self.env, **kwargs)
         except TypeError as e:
             return response_json(req_id, error=RPCError(INVALID_PARAMS, str(e)))
+        t0 = time.perf_counter()
         try:
             if asyncio.iscoroutinefunction(fn):
                 result = await fn(self.env, **kwargs)
@@ -332,6 +348,11 @@ class RPCServer:
         except Exception as e:
             self.logger.error("RPC handler error", method=name, err=str(e))
             return response_json(req_id, error=RPCError(INTERNAL_ERROR, str(e)))
+        finally:
+            dur = time.perf_counter() - t0
+            REQUEST_DURATION_SECONDS.observe(dur, method=name)
+            if _tmtrace.enabled():
+                _tmtrace.record("rpc.request", t0, dur, method=name)
 
     # -- WebSocket subscriptions -----------------------------------------
     async def _handle_websocket(self, reader, writer, headers):

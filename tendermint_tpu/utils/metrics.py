@@ -97,10 +97,14 @@ class Gauge(_Metric):
 
     def samples(self):
         if self._fn is not None:
+            # a callback raising at scrape time (e.g. a round-state field
+            # read mid-transition) omits THIS sample; the rest of the
+            # /metrics scrape must still succeed (same contract as
+            # LabeledCallbackGauge.samples)
             try:
                 return [("", {}, float(self._fn()))]
             except Exception:
-                return [("", {}, 0.0)]
+                return []
         if not self._values:
             return [("", {}, 0.0)] if not self.label_names else []
         return [("", dict(zip(self.label_names, k)), v)
@@ -108,33 +112,52 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
+    """Cumulative-bucket histogram, optionally labeled: with label_names
+    set, each distinct labelset gets its own bucket/sum/count series
+    (verify-pipeline latencies split by flush path / bucket rung).
+    Unlabeled histograms expose a zeroed series before the first
+    observation, matching the previous behavior."""
+
     kind = "histogram"
 
-    def __init__(self, *args, buckets: tuple[float, ...] = _DEFAULT_BUCKETS, **kw):
+    def __init__(self, *args, buckets: tuple[float, ...] = _DEFAULT_BUCKETS,
+                 label_names: tuple[str, ...] = (), **kw):
         super().__init__(*args, **kw)
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._n = 0
+        self.label_names = label_names
+        # labelset key -> [per-bucket counts (+overflow), sum, n]
+        self._series: dict[tuple, list] = {}
+        if not label_names:
+            self._series[()] = [[0] * (len(self.buckets) + 1), 0.0, 0]
 
-    def observe(self, value: float) -> None:
-        self._sum += value
-        self._n += 1
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        cell = self._series.get(key)
+        if cell is None:
+            cell = self._series[key] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+        cell[1] += value
+        cell[2] += 1
+        counts = cell[0]
         for i, b in enumerate(self.buckets):
             if value <= b:
-                self._counts[i] += 1
+                counts[i] += 1
                 return
-        self._counts[-1] += 1
+        counts[-1] += 1
 
     def samples(self):
-        out, cum = [], 0
-        for b, c in zip(self.buckets, self._counts):
-            cum += c
-            out.append(("_bucket", {"le": _fmt_value(float(b))}, float(cum)))
-        cum += self._counts[-1]
-        out.append(("_bucket", {"le": "+Inf"}, float(cum)))
-        out.append(("_sum", {}, self._sum))
-        out.append(("_count", {}, float(self._n)))
+        out = []
+        for key in sorted(self._series):
+            counts, total, n = self._series[key]
+            lbl = dict(zip(self.label_names, key))
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                out.append(("_bucket", {**lbl, "le": _fmt_value(float(b))},
+                            float(cum)))
+            cum += counts[-1]
+            out.append(("_bucket", {**lbl, "le": "+Inf"}, float(cum)))
+            out.append(("_sum", dict(lbl), total))
+            out.append(("_count", dict(lbl), float(n)))
         return out
 
 
@@ -157,6 +180,18 @@ class LabeledCallbackGauge(_Metric):
             return [("", labels, float(v)) for labels, v in self._fn()]
         except Exception:
             return []
+
+
+class CallbackCounter(LabeledCallbackGauge):
+    """Scalar monotonic counter sampled from a callback at scrape time:
+    *_total series whose value lives in application state (the verify
+    service's counters) expose `# TYPE ... counter` instead of
+    masquerading as gauges.  Reuses LabeledCallbackGauge's kind=
+    mechanism and its omit-on-error sampling."""
+
+    def __init__(self, *args, fn: Callable[[], float] = None, **kw):
+        super().__init__(*args, kind="counter",
+                         fn=(lambda: [({}, fn())]), **kw)
 
 
 class Registry:

@@ -15,7 +15,14 @@ from tendermint_tpu.crypto.batch import set_default_backend
 from tendermint_tpu.crypto.keys import priv_key_from_seed
 from tendermint_tpu.node import Node
 from tendermint_tpu.types import GenesisDoc, GenesisValidator
-from tendermint_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+from tendermint_tpu.utils.metrics import (
+    CallbackCounter,
+    Counter,
+    Gauge,
+    Histogram,
+    LabeledCallbackGauge,
+    Registry,
+)
 
 
 @pytest.fixture(autouse=True)
@@ -61,6 +68,93 @@ def test_exposition_format():
     assert "live 9" in reg2.expose()
 
 
+def _parse_exposition(text):
+    """Parse exposition 0.0.4 text into ({name: type}, [(name, labels,
+    value)]).  Minimal but strict enough for conformance checks."""
+    types, samples = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        labels = {}
+        if "{" in series:
+            name, _, rest = series.partition("{")
+            for pair in rest.rstrip("}").split(","):
+                k, _, v = pair.partition("=")
+                labels[k] = v.strip('"')
+        else:
+            name = series
+        samples.append((name, labels, float(value)))
+    return types, samples
+
+
+def test_exposition_conformance():
+    """Prometheus text-format conformance: _total series are typed
+    counter, histogram buckets are cumulative and +Inf-terminated per
+    labelset, and a raising callback gauge omits its sample without
+    failing the scrape."""
+    reg = Registry()
+    c = reg.register(Counter("reqs_total", "plain counter", namespace="tm"))
+    reg.register(CallbackCounter("flushes_total", "callback counter",
+                                 namespace="tm", fn=lambda: 5))
+    reg.register(LabeledCallbackGauge(
+        "bytes_total", "labeled callback counter", namespace="tm",
+        kind="counter", fn=lambda: [({"ch": "0x1"}, 7.0)]))
+    h = reg.register(Histogram("lat_seconds", "labeled histogram",
+                               namespace="tm", label_names=("path",),
+                               buckets=(0.01, 0.1, 1.0)))
+    reg.register(Gauge("fragile", "raising callback", namespace="tm",
+                       fn=lambda: 1 / 0))
+    reg.register(Gauge("ok", "working callback", namespace="tm",
+                       fn=lambda: 3))
+    c.inc(2)
+    h.observe(0.05, path="host")
+    h.observe(0.5, path="host")
+    h.observe(2.0, path="device")
+
+    text = reg.expose()
+    types, samples = _parse_exposition(text)
+
+    # every *_total family is advertised as a counter
+    total_families = [n for n in types if n.endswith("_total")]
+    assert sorted(total_families) == [
+        "tm_bytes_total", "tm_flushes_total", "tm_reqs_total"]
+    for name in total_families:
+        assert types[name] == "counter", (name, types[name])
+    assert types["tm_lat_seconds"] == "histogram"
+
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["tm_flushes_total"] == [({}, 5.0)]
+    # the raising callback omitted its sample; the scrape still carried
+    # every other family
+    assert "tm_fragile" not in by_name
+    assert by_name["tm_ok"] == [({}, 3.0)]
+
+    # histogram conformance per labelset: cumulative, +Inf-terminated,
+    # +Inf bucket == _count
+    for path, want_count in (("host", 2.0), ("device", 1.0)):
+        buckets = [(labels["le"], v)
+                   for labels, v in by_name["tm_lat_seconds_bucket"]
+                   if labels.get("path") == path]
+        assert buckets[-1][0] == "+Inf"
+        values = [v for _le, v in buckets]
+        assert values == sorted(values), values  # cumulative
+        count = next(v for labels, v in by_name["tm_lat_seconds_count"]
+                     if labels.get("path") == path)
+        assert buckets[-1][1] == count == want_count
+    host_sum = next(v for labels, v in by_name["tm_lat_seconds_sum"]
+                    if labels.get("path") == "host")
+    assert host_sum == pytest.approx(0.55)
+
+
 def test_node_serves_prometheus(tmp_path):
     async def run():
         key = priv_key_from_seed(b"\x55" * 32)
@@ -102,6 +196,20 @@ def test_node_serves_prometheus(tmp_path):
             assert float(lines["tendermint_p2p_peers"]) == 0
             assert float(lines["tendermint_state_block_processing_time_count"]) >= 3
             assert float(lines["tendermint_consensus_block_interval_seconds_count"]) >= 1
+            # monotonic service counters are exposition-typed counter
+            # (not gauge), and the per-step duration histogram populated
+            # while the node committed its blocks
+            assert "# TYPE tendermint_crypto_verify_submitted_total counter" in text
+            assert "# TYPE tendermint_crypto_verify_flushes_total counter" in text
+            assert "# TYPE tendermint_consensus_step_duration_seconds histogram" in text
+            assert "# TYPE tendermint_crypto_verify_e2e_seconds histogram" in text
+            assert "# TYPE tendermint_blocksync_request_duration_seconds histogram" in text
+            assert "# TYPE tendermint_rpc_request_duration_seconds histogram" in text
+            step_counts = [
+                float(v) for k, v in lines.items()
+                if k.startswith("tendermint_consensus_step_duration_seconds_count")
+            ]
+            assert step_counts and sum(step_counts) >= 1
             # non-metrics path 404s
             def miss():
                 try:
