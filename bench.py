@@ -677,6 +677,49 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["trace_error"] = str(e)[-300:]
 
+        # Journal overhead (round 8, ISSUE 3): prove the cost contract of
+        # the consensus event journal — the DISABLED path is one
+        # attribute-load + branch per event site (nanoseconds), and the
+        # ENABLED path (json dump + buffered write + flush) stays under a
+        # stated per-event budget, so journaling a live net is safe.
+        _stage_set("journal-overhead")
+        try:
+            import tempfile
+
+            from tendermint_tpu.consensus import eventlog as _el
+
+            N_EV = 20_000
+            nop = _el.NOP
+            # measure the guard as event sites actually write it:
+            # `if journal.enabled: journal.log(...)`
+            t0 = time.perf_counter()
+            for _ in range(N_EV):
+                if nop.enabled:
+                    nop.log("vote", h=1, r=0)
+            disabled_ns = (time.perf_counter() - t0) / N_EV * 1e9
+
+            with tempfile.TemporaryDirectory() as td:
+                jr = _el.EventJournal(os.path.join(td, "bench.jsonl"),
+                                      node="bench")
+                t0 = time.perf_counter()
+                for i in range(N_EV):
+                    if jr.enabled:
+                        jr.log("vote", h=i, r=0, type="prevote", val=i % 4,
+                               block="ab" * 8, at_r=0, **{"from": "peer"})
+                enabled_us = (time.perf_counter() - t0) / N_EV * 1e6
+                jr.close()
+            budget_us = 150.0  # per-event ceiling; ~40 events/block today
+            _partial.update({
+                "journal_disabled_ns_per_event": round(disabled_ns, 1),
+                "journal_enabled_us_per_event": round(enabled_us, 2),
+                "journal_budget_us_per_event": budget_us,
+                "journal_within_budget": bool(enabled_us <= budget_us),
+            })
+            assert enabled_us <= budget_us, (
+                f"journal {enabled_us:.1f}us/event exceeds {budget_us}us")
+        except Exception as e:  # noqa: BLE001
+            _partial["journal_overhead_error"] = str(e)[-300:]
+
         _stage_set("pair-median")
         assert headline_pairs, "headline path recorded no (prod, baseline) pairs"
         base = statistics.median(b for _p, b in headline_pairs)

@@ -724,6 +724,59 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_timeline(args) -> int:
+    """Merge N nodes' consensus event journals (TM_TPU_JOURNAL output;
+    consensus/eventlog.py) into one cross-node timeline: proposal
+    propagation, per-node polka and commit times, timeout distribution,
+    vote-arrival skew, anomaly flags.  With --wal the inputs are raw
+    consensus WAL files instead and the journal subset is reconstructed
+    offline (post-mortems where the journal was off)."""
+    import json as _json
+
+    from tendermint_tpu.consensus.eventlog import (
+        events_from_wal_file,
+        read_events,
+    )
+    from tendermint_tpu.cli.timeline import (
+        build_timeline,
+        render_timeline,
+        report_json,
+    )
+
+    names = [n.strip() for n in (args.names or "").split(",") if n.strip()]
+    journals = {}
+    for i, path in enumerate(args.journals):
+        if i < len(names):
+            name = names[i]
+        else:
+            # default node name: the file's directory (testnet layouts
+            # put each journal under its node home) or the file stem
+            d = os.path.basename(os.path.dirname(os.path.abspath(path)))
+            stem = os.path.splitext(os.path.basename(path))[0]
+            name = d if len(args.journals) > 1 and d else stem
+            if name in journals:
+                name = f"{name}#{i}"
+        try:
+            events = (events_from_wal_file(path, node=name) if args.wal
+                      else read_events(path))
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 1
+        except Exception as e:
+            print(f"cannot decode {path}: {e}", file=sys.stderr)
+            return 1
+        journals[name] = events
+    if not any(journals.values()):
+        print("no events found in any input", file=sys.stderr)
+        return 1
+    report = build_timeline(journals)
+    if args.json:
+        print(_json.dumps(report_json(report), indent=2))
+    else:
+        print(render_timeline(report, height=args.height))
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -793,6 +846,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "schedule, so the node's base.snapshot_interval "
                          "does not apply to them)")
     sp.set_defaults(fn=cmd_abci_server)
+
+    sp = sub.add_parser(
+        "timeline",
+        help="merge N nodes' event journals into a cross-node timeline")
+    sp.add_argument("journals", nargs="+",
+                    help="journal.jsonl files (one per node); with --wal, "
+                         "raw consensus WAL files")
+    sp.add_argument("--names", default="",
+                    help="comma-separated node names matching the inputs")
+    sp.add_argument("--height", type=int, default=None,
+                    help="render only this height")
+    sp.add_argument("--wal", action="store_true",
+                    help="inputs are consensus WALs; reconstruct the "
+                         "journal subset offline")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the merged report as JSON")
+    sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser("wal2json", help="dump a consensus WAL as JSON lines")
     sp.add_argument("wal_file")

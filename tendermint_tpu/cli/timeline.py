@@ -1,0 +1,299 @@
+"""Multi-node consensus timeline analyzer.
+
+Merges the structured event journals (consensus/eventlog.py) of N nodes,
+aligns them per height/round on the wall clock, and renders a text
+timeline: proposal propagation → per-node polka formation → per-node
+commit, plus timeout distribution, per-validator vote-arrival skew, and
+anomaly flags (rounds > 0, late votes, equivocation, peers whose votes
+consistently arrive last).
+
+This is the cross-node debugging substrate the per-process spans (PR 2)
+cannot provide: "which peer's votes arrived late, who relayed them, and
+where the prevote polka actually formed" is answerable only by merging
+every node's record of the same height.
+
+Alignment uses wall-clock ns (`w`).  In-process test nets share one
+clock; across real machines the skew is whatever NTP leaves (document
+says: read offsets relative to each height's first event, so a constant
+per-node clock offset shifts that node's column but never reorders its
+own events).
+
+Everything here is pure data-in/data-out so tests can drive it without
+a CLI process; `cmd_timeline` in cli/main.py is a thin arg-parsing shell.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeHeightView:
+    """One node's record of one height."""
+
+    proposal_w: int | None = None       # first proposal event (wall ns)
+    proposal_from: str = ""             # who delivered it ("" = self)
+    polka_w: int | None = None          # first non-nil polka
+    polka_round: int | None = None
+    commit_maj_w: int | None = None     # +2/3 precommits seen
+    commit_w: int | None = None         # block committed
+    commit_round: int | None = None
+    block: str = ""
+    rounds: set = field(default_factory=set)
+    timeouts: list = field(default_factory=list)   # (round, step, w)
+    votes: list = field(default_factory=list)      # vote event dicts
+    late_votes: int = 0
+
+
+@dataclass
+class HeightView:
+    """All nodes' records of one height, merged."""
+
+    height: int
+    proposer: str = ""                  # hex address (prefix) of proposer
+    proposer_val: int | None = None     # validator index
+    max_round: int = 0
+    nodes: dict = field(default_factory=dict)   # name -> NodeHeightView
+    # (validator, type) -> {node: first-arrival wall ns}
+    vote_arrivals: dict = field(default_factory=dict)
+    equivocations: list = field(default_factory=list)
+    t0: int | None = None               # earliest event wall ns
+
+
+@dataclass
+class TimelineReport:
+    nodes: list
+    heights: dict                       # height -> HeightView
+    anomalies: list = field(default_factory=list)
+
+
+def merge_events(journals: dict[str, list[dict]]) -> list[dict]:
+    """Tag each event with its node (overriding any stale `n` from a
+    copied journal file) and sort the union by wall clock."""
+    merged = []
+    for name, events in journals.items():
+        for ev in events:
+            ev = dict(ev)
+            ev["n"] = name
+            merged.append(ev)
+    merged.sort(key=lambda e: (e.get("w", 0), e.get("h", 0)))
+    return merged
+
+
+def build_timeline(journals: dict[str, list[dict]]) -> TimelineReport:
+    """Fold merged journals into per-height views + anomaly list."""
+    merged = merge_events(journals)
+    heights: dict[int, HeightView] = {}
+    report = TimelineReport(nodes=sorted(journals), heights=heights)
+
+    # (h, r, type, val) -> {block_prefix}: equivocation detector
+    vote_blocks: dict[tuple, set] = {}
+
+    for ev in merged:
+        h = ev.get("h")
+        if h is None:
+            continue
+        hv = heights.get(h)
+        if hv is None:
+            hv = heights[h] = HeightView(height=h)
+        node = ev["n"]
+        nv = hv.nodes.get(node)
+        if nv is None:
+            nv = hv.nodes[node] = NodeHeightView()
+        w = ev.get("w", 0)
+        if hv.t0 is None or w < hv.t0:
+            hv.t0 = w
+        r = ev.get("r", 0)
+        kind = ev.get("e")
+
+        if kind == "new_round":
+            nv.rounds.add(r)
+            hv.max_round = max(hv.max_round, r)
+            if r == 0 and not hv.proposer:
+                hv.proposer = ev.get("proposer", "")
+                hv.proposer_val = ev.get("val")
+        elif kind == "proposal":
+            if nv.proposal_w is None:
+                nv.proposal_w = w
+                nv.proposal_from = ev.get("from", "")
+            if not hv.proposer and ev.get("proposer"):
+                hv.proposer = ev["proposer"]
+        elif kind == "polka":
+            if ev.get("block") and nv.polka_w is None:
+                nv.polka_w = w
+                nv.polka_round = r
+        elif kind == "commit_maj":
+            if nv.commit_maj_w is None:
+                nv.commit_maj_w = w
+        elif kind == "commit":
+            if nv.commit_w is None:
+                nv.commit_w = w
+                nv.commit_round = ev.get("r")
+                nv.block = ev.get("block", "")
+        elif kind == "timeout":
+            nv.timeouts.append((r, ev.get("step", ""), w))
+        elif kind == "vote":
+            nv.votes.append(ev)
+            val = ev.get("val")
+            key = (val, ev.get("type"))
+            arr = hv.vote_arrivals.setdefault(key, {})
+            if node not in arr:
+                arr[node] = w
+            if ev.get("at_r", 0) > r:
+                nv.late_votes += 1
+            bkey = (h, r, ev.get("type"), val)
+            blocks = vote_blocks.setdefault(bkey, set())
+            blocks.add(ev.get("block", ""))
+            if len(blocks) > 1:
+                eq = {"h": h, "r": r, "type": ev.get("type"), "val": val,
+                      "blocks": sorted(blocks)}
+                if eq not in hv.equivocations:
+                    hv.equivocations.append(eq)
+
+    _collect_anomalies(report)
+    return report
+
+
+def _collect_anomalies(report: TimelineReport) -> None:
+    slow_counts: dict[str, int] = {}
+    slow_chances = 0
+    for h in sorted(report.heights):
+        hv = report.heights[h]
+        if hv.max_round > 0:
+            report.anomalies.append(
+                f"height {h}: reached round {hv.max_round} (> 0)")
+        for nv_name, nv in sorted(hv.nodes.items()):
+            if nv.late_votes:
+                report.anomalies.append(
+                    f"height {h}: {nv_name} admitted {nv.late_votes} "
+                    "late vote(s) (vote round behind the node's round)")
+        for eq in hv.equivocations:
+            report.anomalies.append(
+                f"height {h}: validator {eq['val']} equivocated "
+                f"({eq['type']} r{eq['r']}: blocks {', '.join(b or 'nil' for b in eq['blocks'])})")
+        # which delivering peer is last, per (validator, prevote) arrival
+        # across nodes: count "slowest deliverer" per height
+        last_by: dict[str, int] = {}
+        for (_val, vtype), arr in hv.vote_arrivals.items():
+            if vtype != "prevote" or len(arr) < 2:
+                continue
+            last_node = max(arr, key=arr.get)
+            last_by[last_node] = last_by.get(last_node, 0) + 1
+        if last_by:
+            slow_chances += 1
+            worst = max(last_by, key=last_by.get)
+            slow_counts[worst] = slow_counts.get(worst, 0) + 1
+    for node, n in sorted(slow_counts.items()):
+        if slow_chances >= 2 and n >= max(2, slow_chances - 1):
+            report.anomalies.append(
+                f"{node}: votes arrived last at {n}/{slow_chances} heights "
+                "(consistently slowest)")
+
+
+def _rel_ms(w: int | None, t0: int | None) -> str:
+    if w is None or t0 is None:
+        return "-"
+    return f"+{(w - t0) / 1e6:.1f}ms"
+
+
+def vote_skew_ms(hv: HeightView) -> dict:
+    """Per-validator prevote arrival skew across nodes (max - min wall
+    arrival, ms): how unevenly each validator's vote reached the net."""
+    out = {}
+    for (val, vtype), arr in sorted(hv.vote_arrivals.items()):
+        if vtype != "prevote" or len(arr) < 2 or val is None:
+            continue
+        out[val] = round((max(arr.values()) - min(arr.values())) / 1e6, 2)
+    return out
+
+
+def render_timeline(report: TimelineReport, height: int | None = None) -> str:
+    """Text rendering, one block per height (offsets relative to the
+    height's earliest event across all journals)."""
+    lines: list[str] = []
+    nodes = report.nodes
+    lines.append(f"nodes: {', '.join(nodes)}")
+    wanted = ([height] if height is not None
+              else sorted(report.heights))
+    for h in wanted:
+        hv = report.heights.get(h)
+        if hv is None:
+            lines.append(f"height {h}: no events")
+            continue
+        prop = hv.proposer[:16] if hv.proposer else "?"
+        val = f" (val {hv.proposer_val})" if hv.proposer_val is not None else ""
+        lines.append("")
+        lines.append(f"height {h}  proposer {prop}{val}  "
+                     f"rounds 0..{hv.max_round}")
+        for label, getter in (
+            ("proposal", lambda nv: nv.proposal_w),
+            ("polka", lambda nv: nv.polka_w),
+            ("commit", lambda nv: nv.commit_w),
+        ):
+            cells = []
+            for n in nodes:
+                nv = hv.nodes.get(n)
+                cells.append(f"{n} {_rel_ms(getter(nv) if nv else None, hv.t0)}")
+            lines.append(f"  {label:<9}" + "  ".join(cells))
+        n_timeouts = sum(len(nv.timeouts) for nv in hv.nodes.values())
+        if n_timeouts:
+            per = ", ".join(
+                f"{n}:{len(hv.nodes[n].timeouts)}"
+                for n in nodes if n in hv.nodes and hv.nodes[n].timeouts)
+            lines.append(f"  timeouts  {n_timeouts} ({per})")
+        skew = vote_skew_ms(hv)
+        if skew:
+            lines.append("  prevote skew  " + "  ".join(
+                f"val{v} {ms}ms" for v, ms in sorted(skew.items())))
+        # vote delivery attribution: who handed each node its votes
+        for n in nodes:
+            nv = hv.nodes.get(n)
+            if nv is None or not nv.votes:
+                continue
+            by_peer: dict[str, int] = {}
+            for ev in nv.votes:
+                src = ev.get("from", "") or "self"
+                by_peer[src] = by_peer.get(src, 0) + 1
+            att = ", ".join(f"{p[:8] if p != 'self' else p}:{c}"
+                            for p, c in sorted(by_peer.items()))
+            lines.append(f"  votes@{n}  {att}")
+    if report.anomalies:
+        lines.append("")
+        lines.append("anomalies:")
+        for a in report.anomalies:
+            lines.append(f"  ! {a}")
+    else:
+        lines.append("")
+        lines.append("anomalies: none")
+    return "\n".join(lines)
+
+
+def report_json(report: TimelineReport) -> dict:
+    """JSON-ready dump of the report (the --json CLI path)."""
+    out = {"nodes": report.nodes, "anomalies": report.anomalies,
+           "heights": {}}
+    for h, hv in sorted(report.heights.items()):
+        out["heights"][str(h)] = {
+            "proposer": hv.proposer,
+            "proposer_val": hv.proposer_val,
+            "max_round": hv.max_round,
+            "t0_wall_ns": hv.t0,
+            "prevote_skew_ms": vote_skew_ms(hv),
+            "equivocations": hv.equivocations,
+            "nodes": {
+                n: {
+                    "proposal_w": nv.proposal_w,
+                    "proposal_from": nv.proposal_from,
+                    "polka_w": nv.polka_w,
+                    "polka_round": nv.polka_round,
+                    "commit_w": nv.commit_w,
+                    "commit_round": nv.commit_round,
+                    "block": nv.block,
+                    "timeouts": len(nv.timeouts),
+                    "votes": len(nv.votes),
+                    "late_votes": nv.late_votes,
+                }
+                for n, nv in sorted(hv.nodes.items())
+            },
+        }
+    return out

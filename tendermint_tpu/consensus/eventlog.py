@@ -1,0 +1,269 @@
+"""Structured consensus event journal: a bounded JSONL log of
+consensus-significant events with wall + monotonic timestamps and
+per-peer attribution.
+
+PR 2's spans answer "where does the time go inside THIS process"; the
+journal answers the replicated-system questions — which peer's votes
+arrived late, who relayed the proposal, where the prevote polka actually
+formed — by giving every node a merge-able record that the `timeline`
+CLI subcommand aligns across a net (upstream Tendermint debugs this with
+per-peer metrics plus consensus event logs; here the journal is the
+merge substrate).
+
+One JSON object per line.  Common fields on every record:
+
+  e     event type (see EVENT_TYPES)
+  n     node name/moniker (who wrote the line)
+  w     wall-clock ns  (time.time_ns — cross-node alignment)
+  m     monotonic ns   (time.perf_counter_ns — in-process deltas)
+  span  innermost open trace span id (only when TM_TPU_TRACE=1)
+
+Event-specific fields are documented in docs/observability.md (one line
+per event type).  Heights/rounds ride as `h`/`r`; validator indices as
+`val`; peer attribution as `from` ("" = our own message via the
+internal queue); block hashes as 16-hex-char prefixes (`block`).
+
+Cost contract: the journal is OFF by default and every event site pays
+ONE branch — `ConsensusState.journal` is the shared `NOP` singleton
+whose `.enabled` is False, and sites guard with `if self.journal.enabled:`
+(same rule as utils/trace and node/metrics; bench.py's
+`journal-overhead` stage enforces both arms).
+
+Storage: utils/autofile.Group — the WAL's rotating-chunk substrate — so
+the journal is bounded (`head_size_limit` rotation, `total_size_limit`
+pruning of oldest chunks) and crash-tolerant (a torn final line is
+skipped by the reader).
+
+Env knobs (read by node/node.py at construction):
+  TM_TPU_JOURNAL        "1"/"true" = journal to <data_dir>/journal.jsonl;
+                        any other non-empty value = journal to that path.
+  TM_TPU_JOURNAL_LIMIT  total size bound in bytes (default 64 MiB).
+
+Offline reconstruction: `events_from_wal` maps a consensus WAL (which is
+always on for a real node) to the journal's vote/proposal/timeout/commit
+subset — peer attribution included, since MsgInfo records carry their
+origin peer_id — so post-mortems work even where the journal was off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from tendermint_tpu.utils import trace as _trace
+from tendermint_tpu.utils.autofile import Group
+
+ENV_FLAG = "TM_TPU_JOURNAL"
+ENV_LIMIT = "TM_TPU_JOURNAL_LIMIT"
+DEFAULT_TOTAL_LIMIT = 64 * 1024 * 1024
+DEFAULT_HEAD_LIMIT = 8 * 1024 * 1024
+
+# every event type the journal (or the WAL reconstruction) can emit;
+# docs/observability.md documents the per-type fields
+EVENT_TYPES = (
+    "step",       # FSM step transition: h, r, step (entered), prev
+    "new_round",  # h, r, proposer (hex addr), val (proposer index)
+    "proposal",   # h, r, proposer?, block, pol_round, from
+    "vote",       # h, r, type (prevote|precommit), val, from, block, at_r
+    "polka",      # +2/3 prevotes: h, r, block ("" = nil polka)
+    "commit_maj", # +2/3 precommits for a block: h, r, block
+    "timeout",    # timeout fired: h, r, step, dur_ms
+    "commit",     # block committed: h, r, block, txs
+)
+
+# Rotation/pruning checks stat() files, so they are amortized — but on a
+# BYTES cadence, not a write count: the check must fire several times per
+# head_size_limit or chunks grow to whatever accumulated between checks
+# and the pruner can overshoot the total bound.
+_CHECK_BYTES_CAP = 256 * 1024
+
+
+class EventJournal:
+    """A live journal bound to one node.  `enabled` is True so the
+    one-branch guard at event sites passes; the module-level `NOP`
+    singleton is the disabled counterpart."""
+
+    enabled = True
+
+    def __init__(self, path: str, node: str = "",
+                 head_size_limit: int = DEFAULT_HEAD_LIMIT,
+                 total_size_limit: int = DEFAULT_TOTAL_LIMIT):
+        self.path = path
+        self.node = node or os.path.splitext(os.path.basename(path))[0]
+        self.group = Group(path, head_size_limit, total_size_limit)
+        self._bytes_since_check = 0
+        self._check_every = max(4096, min(head_size_limit // 4,
+                                          _CHECK_BYTES_CAP))
+
+    def log(self, event: str, **fields) -> None:
+        rec = {
+            "e": event,
+            "n": self.node,
+            "w": time.time_ns(),
+            "m": time.perf_counter_ns(),
+        }
+        if _trace.enabled():
+            span = _trace.current_span_id()
+            if span is not None:
+                rec["span"] = span
+        rec.update(fields)
+        line = (json.dumps(rec, separators=(",", ":"), default=str).encode()
+                + b"\n")
+        self.group.write(line)
+        self.group.flush()
+        self._bytes_since_check += len(line)
+        if self._bytes_since_check >= self._check_every:
+            self._bytes_since_check = 0
+            self.group.check_limits()
+
+    def close(self) -> None:
+        self.group.close()
+
+
+class _NopJournal:
+    """Disabled journal: `.enabled` is False and the (never-taken) log
+    path is a no-op, so a site costs one attribute load + branch."""
+
+    enabled = False
+    node = ""
+
+    def log(self, event: str, **fields) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+NOP = _NopJournal()
+
+
+def from_env(node: str = "", data_dir: str = "") -> "EventJournal | _NopJournal":
+    """Build a journal from TM_TPU_JOURNAL (see module docstring), or
+    return the NOP singleton when unset/empty/0."""
+    raw = os.environ.get(ENV_FLAG, "")
+    if raw in ("", "0"):
+        return NOP
+    if raw.lower() in ("1", "true"):
+        path = os.path.join(data_dir or ".", "journal.jsonl")
+    else:
+        path = raw
+    try:
+        limit = int(os.environ.get(ENV_LIMIT, DEFAULT_TOTAL_LIMIT))
+    except ValueError:
+        limit = DEFAULT_TOTAL_LIMIT
+    return EventJournal(path, node=node, total_size_limit=max(1, limit))
+
+
+# ---------------------------------------------------------------------------
+# reading
+# ---------------------------------------------------------------------------
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse one journal file (head + any rotated chunks next to it),
+    oldest first.  A torn final line (crash mid-write) and any
+    undecodable line are skipped — same tolerance as the WAL decoder's
+    truncated-tail rule."""
+    # reuse Group's chunk discovery without holding the head open
+    dir_ = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    chunks = []
+    if os.path.isdir(dir_):
+        for name in os.listdir(dir_):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    chunks.append((int(suffix), os.path.join(dir_, name)))
+    paths = [p for _i, p in sorted(chunks)]
+    if os.path.exists(path):
+        paths.append(path)
+    out: list[dict] = []
+    for p in paths:
+        with open(p, "rb") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail / corruption: skip the line
+                if isinstance(rec, dict) and "e" in rec:
+                    out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# offline reconstruction from the consensus WAL
+# ---------------------------------------------------------------------------
+
+
+def _block_prefix(h: bytes) -> str:
+    return h[:8].hex() if h else ""
+
+
+def events_from_wal(records, node: str = "") -> list[dict]:
+    """Map WAL records (TimedWALMessage iterable) to journal-shaped
+    events — the subset the WAL can witness: votes (with `from` peer
+    attribution, straight off MsgInfo.peer_id), proposals, timeouts, and
+    commit barriers.  Step transitions and polka detection are FSM
+    outputs the WAL doesn't record; post-mortems that need those must
+    run with the journal on.  `w` is the WAL record's write time; `m` is
+    absent (the writing process's monotonic clock is gone)."""
+    from tendermint_tpu.types.basic import SignedMsgType
+
+    from .messages import (
+        EndHeightMessage,
+        MsgInfo,
+        ProposalMessage,
+        TimeoutInfo,
+        VoteMessage,
+    )
+
+    out: list[dict] = []
+    for tm in records:
+        msg = tm.msg
+        base = {"n": node, "w": tm.time_ns, "wal": True}
+        if isinstance(msg, MsgInfo):
+            inner = msg.msg
+            if isinstance(inner, VoteMessage):
+                v = inner.vote
+                out.append({
+                    "e": "vote", **base,
+                    "h": v.height, "r": v.round,
+                    "type": ("prevote" if v.type == SignedMsgType.PREVOTE
+                             else "precommit"),
+                    "val": v.validator_index,
+                    "from": msg.peer_id,
+                    "block": _block_prefix(v.block_id.hash),
+                })
+            elif isinstance(inner, ProposalMessage):
+                p = inner.proposal
+                out.append({
+                    "e": "proposal", **base,
+                    "h": p.height, "r": p.round,
+                    "pol_round": p.pol_round,
+                    "from": msg.peer_id,
+                    "block": _block_prefix(p.block_id.hash),
+                })
+        elif isinstance(msg, TimeoutInfo):
+            out.append({
+                "e": "timeout", **base,
+                "h": msg.height, "r": msg.round, "step": msg.step,
+                "dur_ms": msg.duration_ms,
+            })
+        elif isinstance(msg, EndHeightMessage):
+            if msg.height > 0:  # height-0 creation barrier is not a commit
+                out.append({"e": "commit", **base, "h": msg.height})
+    return out
+
+
+def events_from_wal_file(path: str, node: str = "") -> list[dict]:
+    """`events_from_wal` over a raw WAL file on disk (tolerates a
+    truncated tail exactly like WAL replay does)."""
+    from .wal import decode_records
+
+    with open(path, "rb") as fh:
+        data = fh.read()
+    return events_from_wal(decode_records(data), node=node)

@@ -39,6 +39,30 @@ class PeerState:
         self.node_id = node_id
         self.prs = PeerRoundState()
 
+    def snapshot(self) -> dict:
+        """JSON-ready view of the peer's claimed round state (reference
+        PeerState.ToJSON via dump_consensus_state): heights/rounds as
+        ints, step by name, bitmaps as their string rendering."""
+        prs = self.prs
+
+        def bits(ba):
+            return str(ba) if ba is not None else ""
+
+        return {
+            "height": prs.height,
+            "round": prs.round,
+            "step": prs.step.name,
+            "proposal": prs.proposal,
+            "proposal_pol_round": prs.proposal_pol_round,
+            "proposal_block_parts": bits(prs.proposal_block_parts),
+            "prevotes": bits(prs.prevotes),
+            "precommits": bits(prs.precommits),
+            "last_commit_round": prs.last_commit_round,
+            "last_commit": bits(prs.last_commit),
+            "catchup_commit_round": prs.catchup_commit_round,
+            "catchup_commit": bits(prs.catchup_commit),
+        }
+
     # -- round-state updates (reference ApplyNewRoundStepMessage) --------
     def apply_new_round_step(self, msg, num_validators: int) -> None:
         prs = self.prs

@@ -49,6 +49,7 @@ from .messages import (
     TimeoutInfo,
     VoteMessage,
 )
+from . import eventlog
 from .round_state import HeightVoteSet, RoundState, Step
 from .ticker import TimeoutTicker
 from .wal import NopWAL
@@ -108,6 +109,10 @@ class ConsensusState:
         self.done_height: asyncio.Event = asyncio.Event()  # pulsed every commit
         self.on_event = None  # callable(name: str, payload) — reactor hook
         self.event_bus = None  # types.events.EventBus — external observers
+        # structured event journal (consensus/eventlog.py): NOP unless the
+        # node wires a real one; every site guards on `.enabled` so the
+        # disabled path costs one branch (bench.py journal-overhead stage)
+        self.journal = eventlog.NOP
         self._task: asyncio.Task | None = None
         self._stopping = False
         self._step_t0: float | None = None  # when the current step began
@@ -135,6 +140,7 @@ class ConsensusState:
             except asyncio.CancelledError:
                 pass
         self.wal.close()
+        self.journal.close()
 
     # ------------------------------------------------------------------
     # external API (reactor / RPC entry points)
@@ -290,7 +296,7 @@ class ConsensusState:
     def handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id
         if isinstance(msg, ProposalMessage):
-            self.set_proposal(msg.proposal)
+            self.set_proposal(msg.proposal, peer_id)
         elif isinstance(msg, BlockPartMessage):
             self.add_proposal_block_part(msg.height, msg.part, peer_id)
         elif isinstance(msg, VoteMessage):
@@ -323,6 +329,9 @@ class ConsensusState:
         ):
             return
         step = Step(ti.step)
+        if self.journal.enabled and not self.replay_mode:
+            self.journal.log("timeout", h=ti.height, r=ti.round,
+                             step=step.name, dur_ms=ti.duration_ms)
         if step == Step.NEW_HEIGHT:
             self.enter_new_round(ti.height, 0)
         elif step == Step.NEW_ROUND:
@@ -393,11 +402,15 @@ class ConsensusState:
             height = state.initial_height
 
         self._observe_step()  # COMMIT (or startup) -> NEW_HEIGHT
+        prev_step = rs.step
         rs.height = height
         rs.round = 0
         rs.step = Step.NEW_HEIGHT
         if _trace.enabled() and not self.replay_mode:
             _trace.instant("consensus.new_height", height=height)
+        if self.journal.enabled and not self.replay_mode:
+            self.journal.log("step", h=height, r=0,
+                             step=Step.NEW_HEIGHT.name, prev=prev_step.name)
         if rs.commit_time_ns == 0:
             rs.start_time_ns = now_ns() + self.config.timeout_commit_ms * 1_000_000
         else:
@@ -430,8 +443,12 @@ class ConsensusState:
         if not self.replay_mode:
             pass  # (reference fires newStep events here)
         self._observe_step()
+        prev = self.rs.step
         self.rs.round = round_
         self.rs.step = step
+        if self.journal.enabled and not self.replay_mode:
+            self.journal.log("step", h=self.rs.height, r=round_,
+                             step=step.name, prev=prev.name)
         self._emit("new_round_step")
 
     def _observe_step(self) -> None:
@@ -509,6 +526,14 @@ class ConsensusState:
         rs.validators = validators
         if _trace.enabled() and not self.replay_mode:
             _trace.instant("consensus.new_round", height=height, round=round_)
+        if self.journal.enabled and not self.replay_mode:
+            prop = validators.get_proposer()
+            self.journal.log(
+                "new_round", h=height, r=round_,
+                proposer=prop.address.hex() if prop else "",
+                val=(validators.get_by_address(prop.address)[0]
+                     if prop else -1),
+            )
         self._update_round_step(round_, Step.NEW_ROUND)
         if round_ != 0:
             # round 0 keeps proposals from NewHeight; later rounds start over
@@ -687,6 +712,9 @@ class ConsensusState:
             self.sign_add_vote(SignedMsgType.PRECOMMIT, b"", PartSetHeader())
             return
 
+        if self.journal.enabled and not self.replay_mode:
+            self.journal.log("polka", h=height, r=round_,
+                             block=block_id.hash[:8].hex())
         self._emit("polka", block_id)
 
         if block_id.is_zero():
@@ -757,6 +785,9 @@ class ConsensusState:
             raise RuntimeError("enter_commit without +2/3 precommits for a block")
         rs.commit_round = commit_round
         rs.commit_time_ns = now_ns()
+        if self.journal.enabled and not self.replay_mode:
+            self.journal.log("commit_maj", h=height, r=commit_round,
+                             block=block_id.hash[:8].hex())
         self._update_round_step(rs.round, Step.COMMIT)
 
         if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
@@ -818,6 +849,10 @@ class ConsensusState:
             raise ConsensusFailureError(
                 f"failed to commit block {height}: {e}"
             ) from e
+        if self.journal.enabled and not self.replay_mode:
+            self.journal.log("commit", h=height, r=rs.commit_round,
+                             block=block_id.hash[:8].hex(),
+                             txs=len(block.data.txs))
         if retain_height > 0:
             try:
                 pruned = self.block_store.prune_blocks(retain_height)
@@ -838,7 +873,7 @@ class ConsensusState:
     # message ingestion
     # ------------------------------------------------------------------
 
-    def set_proposal(self, proposal: Proposal) -> None:
+    def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
         """Reference defaultSetProposal (state.go:1719)."""
         rs = self.rs
         if rs.proposal is not None:
@@ -855,6 +890,14 @@ class ConsensusState:
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+        if self.journal.enabled and not self.replay_mode:
+            self.journal.log(
+                "proposal", h=proposal.height, r=proposal.round,
+                proposer=proposer.address.hex(),
+                block=proposal.block_id.hash[:8].hex(),
+                pol_round=proposal.pol_round,
+                **{"from": peer_id},
+            )
         self._emit("proposal", proposal)
 
     def add_proposal_block_part(self, height: int, part: Part, peer_id: str = "") -> bool:
@@ -923,6 +966,21 @@ class ConsensusState:
             self.logger.info("bad vote", err=str(e))
             return False
 
+    def _journal_vote(self, vote: Vote, peer_id: str) -> None:
+        """One journal line per ADMITTED vote, attributed to the peer
+        that delivered it ("" = our own, via the internal queue).  `at_r`
+        is the round this node was in at arrival — what the timeline
+        analyzer uses to flag late votes."""
+        self.journal.log(
+            "vote", h=vote.height, r=vote.round,
+            type=("prevote" if vote.type == SignedMsgType.PREVOTE
+                  else "precommit"),
+            val=vote.validator_index,
+            block=vote.block_id.hash[:8].hex(),
+            at_r=self.rs.round,
+            **{"from": peer_id},
+        )
+
     def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
         """Reference addVote (state.go:1892)."""
         rs = self.rs
@@ -935,6 +993,8 @@ class ConsensusState:
                 return False
             added = rs.last_commit.add_vote(vote)
             if added:
+                if self.journal.enabled and not self.replay_mode:
+                    self._journal_vote(vote, peer_id)
                 self._emit("vote", vote)
                 if self.config.skip_timeout_commit and rs.last_commit.has_all():
                     self.enter_new_round(rs.height, 0)
@@ -946,6 +1006,8 @@ class ConsensusState:
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
+        if self.journal.enabled and not self.replay_mode:
+            self._journal_vote(vote, peer_id)
         self._emit("vote", vote)
 
         if vote.type == SignedMsgType.PREVOTE:

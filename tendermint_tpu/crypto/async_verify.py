@@ -573,6 +573,20 @@ def reset_service(**kwargs) -> VerifyService:
         return _SERVICE
 
 
+def clear_service() -> None:
+    """Drop the singleton entirely so the NEXT get_service() rebuilds it
+    from the then-current environment.  Test isolation: the service
+    captures TM_TPU_CPU_THRESHOLD / linger / cache sizing at
+    construction, so a singleton built by an earlier test would silently
+    override a later test's env (the order-dependent multinode
+    device-path flake)."""
+    global _SERVICE
+    with _SERVICE_LOCK:
+        if _SERVICE is not None:
+            _SERVICE.close()
+        _SERVICE = None
+
+
 def verify_many(items) -> list[bool]:
     """Module-level sync wrapper over the shared service."""
     return get_service().verify_many(items)

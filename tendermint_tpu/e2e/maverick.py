@@ -61,13 +61,13 @@ class MaverickConsensusState(ConsensusState):
     def _active(self) -> str | None:
         return self.misbehaviors.get(self.rs.height)
 
-    def set_proposal(self, proposal) -> None:
+    def set_proposal(self, proposal, peer_id: str = "") -> None:
         if self._active() == "ignore-proposal":
             self.ignored_proposals += 1
             self.logger.info("maverick: dropping received proposal",
                              height=self.rs.height, round=self.rs.round)
             return
-        super().set_proposal(proposal)
+        super().set_proposal(proposal, peer_id)
 
     def do_prevote(self, height: int, round_: int) -> None:
         if self._active() == "amnesia" and self.rs.proposal_block is not None:

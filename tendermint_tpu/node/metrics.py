@@ -95,7 +95,10 @@ class NodeMetrics:
             "peers", "Connected peers", namespace=ns, subsystem="p2p",
             fn=lambda: len(node.router.peers),
         ))
-        from tendermint_tpu.utils.metrics import LabeledCallbackGauge
+        from tendermint_tpu.utils.metrics import (
+            CallbackCounter,
+            LabeledCallbackGauge,
+        )
 
         self.p2p_recv_bytes = reg.register(LabeledCallbackGauge(
             "message_receive_bytes_total", "Bytes received per channel",
@@ -110,13 +113,70 @@ class NodeMetrics:
                         for cid, v in sorted(node.router.bytes_sent.items())],
         ))
 
+        # per-peer series (reference p2p/metrics.go PeerReceiveBytesTotal /
+        # PeerSendBytesTotal{peer_id, chID} + MessageReceiveBytesTotal
+        # by message_type): the cross-node debugging surface — which
+        # peer's votes arrived, over which channel, and how deep its
+        # send queues sit right now
+        def _per_peer(table):
+            return [({"peer_id": pid, "chID": f"{cid:#x}"}, v)
+                    for pid, chans in sorted(table.items())
+                    for cid, v in sorted(chans.items())]
+
+        self.p2p_peer_recv_bytes = reg.register(LabeledCallbackGauge(
+            "peer_receive_bytes_total", "Bytes received per peer per channel",
+            namespace=ns, subsystem="p2p", kind="counter",
+            fn=lambda: _per_peer(node.router.peer_bytes_received),
+        ))
+        self.p2p_peer_send_bytes = reg.register(LabeledCallbackGauge(
+            "peer_send_bytes_total", "Bytes sent per peer per channel",
+            namespace=ns, subsystem="p2p", kind="counter",
+            fn=lambda: _per_peer(node.router.peer_bytes_sent),
+        ))
+        self.p2p_msg_recv_count = reg.register(LabeledCallbackGauge(
+            "message_receive_count", "Decoded inbound messages by type",
+            namespace=ns, subsystem="p2p", kind="counter",
+            fn=lambda: [({"message_type": t}, v)
+                        for t, v in sorted(node.router.msg_recv_count.items())],
+        ))
+
+        def _msg_send_count():
+            agg: dict[str, int] = {}
+            for ch in node.router.channels.values():
+                for t, v in ch.msg_send_count.items():
+                    agg[t] = agg.get(t, 0) + v
+            return [({"message_type": t}, v) for t, v in sorted(agg.items())]
+
+        self.p2p_msg_send_count = reg.register(LabeledCallbackGauge(
+            "message_send_count", "Outbound messages by type (all channels)",
+            namespace=ns, subsystem="p2p", kind="counter",
+            fn=_msg_send_count,
+        ))
+        self.p2p_send_queue_depth = reg.register(LabeledCallbackGauge(
+            "peer_send_queue_depth",
+            "Messages queued per peer per channel (live peers only)",
+            namespace=ns, subsystem="p2p",
+            fn=lambda: [({"peer_id": pid, "chID": f"{cid:#x}"}, depth)
+                        for pid, cid, depth
+                        in sorted(node.router.send_queue_depths())],
+        ))
+        self.p2p_peers_connected = reg.register(CallbackCounter(
+            "peers_connected_total", "Peer connections established",
+            namespace=ns, subsystem="p2p",
+            fn=lambda: node.router.peers_connected,
+        ))
+        self.p2p_peers_disconnected = reg.register(CallbackCounter(
+            "peers_disconnected_total", "Peer connections dropped",
+            namespace=ns, subsystem="p2p",
+            fn=lambda: node.router.peers_disconnected,
+        ))
+
         # -- crypto: the async verification service ---------------------
         # counters scraped from crypto.async_verify.service_stats() —
         # all zeros until the first verify touches the service, and the
         # scrape itself never instantiates it.  Monotonic *_total series
         # are CallbackCounter so the exposition advertises `counter`.
         from tendermint_tpu.crypto import async_verify as _av
-        from tendermint_tpu.utils.metrics import CallbackCounter
 
         def _svc(key: str):
             return lambda: _av.service_stats()[key]

@@ -289,6 +289,15 @@ class Node:
             **cs_kw,
         )
         self.consensus.event_bus = self.event_bus
+        # structured event journal (TM_TPU_JOURNAL; consensus/eventlog.py):
+        # NOP unless the env asks for one, so the FSM pays one branch per
+        # event site when off
+        from tendermint_tpu.consensus import eventlog as _eventlog
+
+        self.consensus.journal = _eventlog.from_env(
+            node=config.base.moniker or self.node_key.node_id[:8],
+            data_dir=config.db_dir,
+        )
         self.consensus_reactor = ConsensusReactor(
             self.consensus, self.router, self.block_store, logger=self.logger
         )
@@ -354,6 +363,7 @@ class Node:
             block_store=self.block_store,
             state_store=self.state_store,
             consensus=self.consensus,
+            consensus_reactor=self.consensus_reactor,
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             tx_indexer=self.tx_indexer,

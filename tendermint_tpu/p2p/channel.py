@@ -28,13 +28,23 @@ class Channel:
         )
         self.out_queue: asyncio.Queue[Envelope] = asyncio.Queue(maxsize=1024)
         self.err_queue: asyncio.Queue[PeerError] = asyncio.Queue(maxsize=256)
+        # per-message-type send counters + out-queue drop count (reference
+        # p2p/metrics.go MessageSendBytesTotal{message_type}); scraped by
+        # node/metrics.py, aggregated across channels
+        self.msg_send_count: dict[str, int] = {}
+        self.send_drops = 0
 
     @property
     def channel_id(self) -> int:
         return self.descriptor.channel_id
 
+    def _count_send(self, envelope: Envelope) -> None:
+        name = type(envelope.message).__name__
+        self.msg_send_count[name] = self.msg_send_count.get(name, 0) + 1
+
     async def send(self, envelope: Envelope) -> None:
         envelope.channel_id = self.channel_id
+        self._count_send(envelope)
         await self.out_queue.put(envelope)
 
     def try_send(self, envelope: Envelope) -> bool:
@@ -42,8 +52,10 @@ class Channel:
         envelope.channel_id = self.channel_id
         try:
             self.out_queue.put_nowait(envelope)
+            self._count_send(envelope)
             return True
         except asyncio.QueueFull:
+            self.send_drops += 1
             return False
 
     async def receive(self) -> Envelope:

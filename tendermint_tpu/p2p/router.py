@@ -51,6 +51,7 @@ class _Peer:
     def __init__(self, node_id: NodeID, conn):
         self.node_id = node_id
         self.conn = conn
+        self.connected_at = time.monotonic()
         # per-channel bounded send queues (reference MConnection
         # Channel.sendQueue w/ SendQueueCapacity): channel isolation is
         # the point — see module docstring
@@ -91,6 +92,19 @@ class Router:
         # by channel), read by the metrics scraper
         self.bytes_received: dict[int, int] = {}
         self.bytes_sent: dict[int, int] = {}
+        # per-peer per-channel counters (reference p2p/metrics.go
+        # PeerReceiveBytesTotal / PeerSendBytesTotal{peer_id, chID}) —
+        # cumulative, so a peer's series survives its disconnect exactly
+        # like a Prometheus counter's labelset would
+        self.peer_bytes_received: dict[NodeID, dict[int, int]] = {}
+        self.peer_bytes_sent: dict[NodeID, dict[int, int]] = {}
+        # decoded inbound messages by concrete type (reference
+        # MessageReceiveBytesTotal{message_type}, counted here as messages)
+        self.msg_recv_count: dict[str, int] = {}
+        # peer lifecycle counters (reference NumPeers is a gauge; the
+        # connect/disconnect totals make churn visible after the fact)
+        self.peers_connected = 0
+        self.peers_disconnected = 0
 
     # -- channels --------------------------------------------------------
     def open_channel(self, descriptor: ChannelDescriptor) -> Channel:
@@ -117,6 +131,47 @@ class Router:
 
     def peer_ids(self) -> list[NodeID]:
         return list(self.peers.keys())
+
+    # -- per-peer observability ------------------------------------------
+    def send_queue_depths(self) -> list[tuple[NodeID, int, int]]:
+        """(peer_id, channel_id, queued msgs) for every live per-peer
+        per-channel send queue — the backpressure picture at a glance."""
+        out = []
+        for pid, peer in list(self.peers.items()):
+            for cid, q in list(peer.send_queues.items()):
+                out.append((pid, cid, len(q)))
+        return out
+
+    def peer_snapshot(self, node_id: NodeID) -> dict | None:
+        """One peer's traffic/queue state for net_info (reference
+        ConnectionStatus in p2p/conn/connection.go Status()).  Returns
+        None for unknown peers; byte counters come from the cumulative
+        per-peer dicts so they are exact even mid-transfer."""
+        peer = self.peers.get(node_id)
+        if peer is None:
+            return None
+        now = time.monotonic()
+        recv = self.peer_bytes_received.get(node_id, {})
+        sent = self.peer_bytes_sent.get(node_id, {})
+        channels = []
+        for cid in sorted(set(recv) | set(sent) | set(peer.send_queues)):
+            channels.append({
+                "ch_id": f"{cid:#x}",
+                "send_queue_size": len(peer.send_queues.get(cid, ())),
+                "recv_bytes": recv.get(cid, 0),
+                "send_bytes": sent.get(cid, 0),
+            })
+        snap = {
+            "duration_s": round(now - peer.connected_at, 3),
+            "last_recv_age_s": round(now - peer.last_recv, 3),
+            "channels": channels,
+        }
+        conn_sent = getattr(peer.conn, "bytes_sent", None)
+        if conn_sent is not None:
+            # TCP transport: raw frame bytes incl. the channel tag
+            snap["conn_bytes_sent"] = conn_sent
+            snap["conn_bytes_received"] = getattr(peer.conn, "bytes_received", 0)
+        return snap
 
     # -- lifecycle -------------------------------------------------------
     async def start(self) -> None:
@@ -168,6 +223,7 @@ class Router:
         if self.ping_interval > 0:
             peer.tasks.append(loop.create_task(self._peer_keepalive(peer)))
         self.peers[node_id] = peer
+        self.peers_connected += 1
         self.logger.info("peer up", peer=node_id[:8])
         self._publish_peer_update(PeerUpdate(node_id, PeerStatus.UP))
 
@@ -179,6 +235,7 @@ class Router:
         peer = self.peers.pop(node_id, None)
         if peer is None:
             return
+        self.peers_disconnected += 1
         await peer.conn.close()
         for t in peer.tasks:
             t.cancel()
@@ -187,14 +244,28 @@ class Router:
             self._publish_peer_update(PeerUpdate(node_id, PeerStatus.DOWN))
 
     # -- per-peer tasks ----------------------------------------------------
+    def _count_recv(self, node_id: NodeID, channel_id: int, n: int) -> None:
+        self.bytes_received[channel_id] = (
+            self.bytes_received.get(channel_id, 0) + n
+        )
+        per = self.peer_bytes_received.get(node_id)
+        if per is None:
+            per = self.peer_bytes_received[node_id] = {}
+        per[channel_id] = per.get(channel_id, 0) + n
+
+    def _count_sent(self, node_id: NodeID, channel_id: int, n: int) -> None:
+        self.bytes_sent[channel_id] = self.bytes_sent.get(channel_id, 0) + n
+        per = self.peer_bytes_sent.get(node_id)
+        if per is None:
+            per = self.peer_bytes_sent[node_id] = {}
+        per[channel_id] = per.get(channel_id, 0) + n
+
     async def _peer_recv(self, peer: _Peer) -> None:
         try:
             while True:
                 channel_id, data = await peer.conn.receive()
                 peer.last_recv = time.monotonic()
-                self.bytes_received[channel_id] = (
-                    self.bytes_received.get(channel_id, 0) + len(data)
-                )
+                self._count_recv(peer.node_id, channel_id, len(data))
                 if channel_id == CTRL_CHANNEL:
                     if data == _PING:
                         peer.pong_owed = True
@@ -210,6 +281,8 @@ class Router:
                     msg = ch.descriptor.decode(data)
                 except Exception as e:
                     raise ValueError(f"undecodable message: {e}")
+                tname = type(msg).__name__
+                self.msg_recv_count[tname] = self.msg_recv_count.get(tname, 0) + 1
                 await ch.in_queue.put(
                     Envelope(message=msg, from_=peer.node_id, channel_id=channel_id)
                 )
@@ -251,16 +324,12 @@ class Router:
                     if peer.pong_owed:
                         peer.pong_owed = False
                         await peer.conn.send(CTRL_CHANNEL, _PONG)
-                        self.bytes_sent[CTRL_CHANNEL] = (
-                            self.bytes_sent.get(CTRL_CHANNEL, 0) + len(_PONG)
-                        )
+                        self._count_sent(peer.node_id, CTRL_CHANNEL, len(_PONG))
                         continue
                     if peer.ping_due:
                         peer.ping_due = False
                         await peer.conn.send(CTRL_CHANNEL, _PING)
-                        self.bytes_sent[CTRL_CHANNEL] = (
-                            self.bytes_sent.get(CTRL_CHANNEL, 0) + len(_PING)
-                        )
+                        self._count_sent(peer.node_id, CTRL_CHANNEL, len(_PING))
                         continue
                     cid = self._pick_channel(peer)
                     if cid is None:
@@ -268,7 +337,7 @@ class Router:
                     data = peer.send_queues[cid].popleft()
                     await peer.conn.send(cid, data)
                     peer.recent_sent[cid] = peer.recent_sent.get(cid, 0.0) + len(data)
-                    self.bytes_sent[cid] = self.bytes_sent.get(cid, 0) + len(data)
+                    self._count_sent(peer.node_id, cid, len(data))
         except asyncio.CancelledError:
             return
         except ConnectionError:

@@ -63,6 +63,12 @@ class TCPConnection:
         self._on_close = on_close
         self._send_limiter = send_limiter or NopLimiter()
         self._recv_limiter = recv_limiter or NopLimiter()
+        # plaintext frame bytes through this connection (payload + the
+        # 1-byte channel tag; SecretConnection sealing overhead excluded)
+        # — the transport-level view behind the router's per-channel
+        # counters, surfaced in net_info peer snapshots
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
     async def send(self, channel_id: int, data: bytes) -> None:
         if self._closed:
@@ -71,6 +77,7 @@ class TCPConnection:
             async with self._send_lock:
                 await self._send_limiter.limit(len(data) + 1)
                 await self._sconn.send(bytes([channel_id]) + data)
+                self.bytes_sent += len(data) + 1
         except (OSError, asyncio.IncompleteReadError) as e:
             raise ConnectionError(str(e)) from None
 
@@ -84,6 +91,7 @@ class TCPConnection:
         if not msg:
             raise ConnectionError("empty frame")
         await self._recv_limiter.limit(len(msg))
+        self.bytes_received += len(msg)
         return msg[0], msg[1:]
 
     async def close(self) -> None:

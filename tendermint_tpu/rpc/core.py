@@ -34,6 +34,7 @@ class Environment:
         block_store=None,
         state_store=None,
         consensus=None,
+        consensus_reactor=None,
         mempool=None,
         evidence_pool=None,
         tx_indexer=None,
@@ -52,6 +53,7 @@ class Environment:
         self.block_store = block_store
         self.state_store = state_store
         self.consensus = consensus
+        self.consensus_reactor = consensus_reactor
         self.mempool = mempool
         self.evidence_pool = evidence_pool
         self.tx_indexer = tx_indexer
@@ -139,12 +141,23 @@ def genesis(env: Environment) -> dict:
 
 
 def net_info(env: Environment) -> dict:
+    """Peer list with per-peer traffic snapshots (reference net.go NetInfo
+    → ConnectionStatus): per-channel recv/send bytes and live send-queue
+    depths so an operator can see WHICH peer is slow, not just how many
+    peers exist."""
     peers = env.router.peer_ids() if env.router else []
+    entries = []
+    for p in peers:
+        entry = {"node_info": {"id": p}, "is_outbound": True}
+        snap = env.router.peer_snapshot(p)
+        if snap is not None:
+            entry["connection_status"] = snap
+        entries.append(entry)
     return {
         "listening": True,
         "listeners": [],
         "n_peers": enc.i64(len(peers)),
-        "peers": [{"node_info": {"id": p}, "is_outbound": True} for p in peers],
+        "peers": entries,
     }
 
 
@@ -292,6 +305,15 @@ def dump_consensus_state(env: Environment) -> dict:
                 }
             )
     out["height_vote_set"] = votes
+    # per-peer round state (reference consensus.go DumpConsensusState →
+    # PeerStateJSON): what each peer CLAIMS about its height/round/step
+    # and which votes/parts we believe it already has — the operator-side
+    # view the timeline analyzer correlates against
+    peers = []
+    if env.consensus_reactor is not None:
+        for pid, ps in env.consensus_reactor.peers.items():
+            peers.append({"node_address": pid, "peer_state": ps.snapshot()})
+    out["peers"] = peers
     return {"round_state": out}
 
 

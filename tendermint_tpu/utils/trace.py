@@ -176,6 +176,15 @@ def instant(name: str, **attrs) -> None:
     _append(name, next(_ids), None, time.perf_counter_ns(), 0, attrs)
 
 
+def current_span_id() -> int | None:
+    """The id of this thread's innermost open span, or None.  Lets other
+    structured sinks (the consensus event journal) stamp their records
+    with the span that produced them, so a journal line and its trace
+    span correlate offline."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
 # -- export -----------------------------------------------------------------
 
 

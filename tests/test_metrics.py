@@ -155,6 +155,114 @@ def test_exposition_conformance():
     assert host_sum == pytest.approx(0.55)
 
 
+def test_per_peer_series_in_metrics_and_net_info(tmp_path):
+    """ISSUE 3 acceptance (p2p leg): with a live peer connected, the
+    per-peer byte series appear in /metrics with correct peer_id/chID
+    labels, message_receive_count carries concrete message types,
+    net_info exposes the per-peer connection_status snapshot, and
+    dump_consensus_state includes the reactor's peer round state."""
+    from tendermint_tpu.node.node_key import load_or_gen_node_key
+    from tendermint_tpu.p2p import MemoryNetwork
+    from tendermint_tpu.rpc import core as rpc_core
+
+    async def run():
+        key = priv_key_from_seed(b"\x66" * 32)
+        gen = GenesisDoc(
+            chain_id="peer-metrics-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        network = MemoryNetwork()
+
+        v_cfg = make_test_config(str(tmp_path / "v"))
+        v_cfg.base.fast_sync = False
+        v_cfg.instrumentation.prometheus = True
+        v_cfg.instrumentation.prometheus_listen_addr = "tcp://127.0.0.1:0"
+        nk_v = load_or_gen_node_key(v_cfg.node_key_file)
+        validator = Node(v_cfg, genesis=gen,
+                         transport=network.create_transport(nk_v.node_id))
+        validator.priv_validator.priv_key = key
+        validator.consensus.priv_validator = validator.priv_validator
+
+        f_cfg = make_test_config(str(tmp_path / "f"))
+        f_cfg.base.fast_sync = False
+        nk_f = load_or_gen_node_key(f_cfg.node_key_file)
+        follower = Node(f_cfg, genesis=gen,
+                        transport=network.create_transport(nk_f.node_id))
+
+        await validator.start()
+        await follower.start()
+        await follower.router.dial(nk_v.node_id)
+        try:
+            await follower.wait_for_height(2, timeout=60)
+            host, port = validator.metrics.addr
+
+            def scrape():
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=5
+                ) as r:
+                    return r.read().decode()
+
+            text = await asyncio.to_thread(scrape)
+            _types, samples = _parse_exposition(text)
+            by_name = {}
+            for name, labels, value in samples:
+                by_name.setdefault(name, []).append((labels, value))
+
+            # per-peer byte series labeled with the follower's real id +
+            # a hex chID, nonzero in both directions
+            for series in ("tendermint_p2p_peer_receive_bytes_total",
+                           "tendermint_p2p_peer_send_bytes_total"):
+                rows = by_name.get(series, [])
+                assert rows, f"{series} missing from /metrics"
+                assert all(lbl["peer_id"] == nk_f.node_id and
+                           lbl["chID"].startswith("0x")
+                           for lbl, _v in rows), rows
+                assert sum(v for _l, v in rows) > 0
+            # vote-channel (0x22) traffic flowed peer-wise: the validator
+            # GOSSIPS votes to the (non-validator) follower, so it shows
+            # on the send side; the follower's round-step broadcasts show
+            # on the receive side (0x20)
+            send_chs = {lbl["chID"] for lbl, _v in
+                        by_name["tendermint_p2p_peer_send_bytes_total"]}
+            assert "0x22" in send_chs, send_chs
+            recv_chs = {lbl["chID"] for lbl, _v in
+                        by_name["tendermint_p2p_peer_receive_bytes_total"]}
+            assert "0x20" in recv_chs, recv_chs
+            # message-type counters carry concrete types on both sides
+            mr = {lbl["message_type"]: v for lbl, v in
+                  by_name.get("tendermint_p2p_message_receive_count", [])}
+            assert mr.get("NewRoundStepMessage", 0) > 0, mr
+            ms = {lbl["message_type"]: v for lbl, v in
+                  by_name.get("tendermint_p2p_message_send_count", [])}
+            assert ms.get("VoteMessage", 0) > 0, ms
+            assert _types["tendermint_p2p_peer_receive_bytes_total"] == "counter"
+            assert by_name.get("tendermint_p2p_peers_connected_total") == [({}, 1.0)]
+
+            # net_info: per-peer connection snapshot
+            info = rpc_core.net_info(validator.rpc_env)
+            assert len(info["peers"]) == 1
+            peer = info["peers"][0]
+            assert peer["node_info"]["id"] == nk_f.node_id
+            st = peer["connection_status"]
+            assert st["duration_s"] >= 0
+            chans = {c["ch_id"]: c for c in st["channels"]}
+            assert "0x22" in chans
+            assert chans["0x22"]["recv_bytes"] > 0 or chans["0x22"]["send_bytes"] > 0
+
+            # dump_consensus_state: the reactor's per-peer round state
+            dump = rpc_core.dump_consensus_state(validator.rpc_env)
+            peers = dump["round_state"]["peers"]
+            assert len(peers) == 1 and peers[0]["node_address"] == nk_f.node_id
+            ps = peers[0]["peer_state"]
+            assert ps["height"] >= 1 and ps["step"]
+        finally:
+            await follower.stop()
+            await validator.stop()
+
+    asyncio.run(run())
+
+
 def test_node_serves_prometheus(tmp_path):
     async def run():
         key = priv_key_from_seed(b"\x55" * 32)

@@ -39,6 +39,30 @@ def cpu_backend():
     set_default_backend("auto")
 
 
+@pytest.fixture(autouse=True)
+def isolated_device_path_state():
+    """Fix for the order-dependent device-path flake: the async verify
+    service singleton captures TM_TPU_CPU_THRESHOLD at construction, so
+    when ANY earlier test (test_dispatch_model, test_evidence, ...) had
+    instantiated it, this module's jax-backend test — which pins the
+    threshold to 2 via monkeypatch.setenv — kept verifying through a
+    service built with the default 64-sig floor and the device path
+    never ran.  Dropping the singleton on both sides makes each test
+    build its own from its own env, so suite ordering no longer matters.
+    The warmup started-flag is reset too: a stale failed warmup from a
+    monkeypatched earlier test would otherwise latch the host path
+    forever (_DEVICE_READY itself is left alone — a genuinely warm
+    device staying warm is correct and saves a re-warm)."""
+    from tendermint_tpu.crypto import async_verify as av
+    from tendermint_tpu.crypto import batch as cbatch
+
+    av.clear_service()
+    cbatch._WARMUP_STARTED = False
+    yield
+    av.clear_service()
+    cbatch._WARMUP_STARTED = False
+
+
 class _PV:
     """In-memory privval (no double-sign file state; tests only)."""
 

@@ -70,6 +70,59 @@ def test_crc_corruption_raises():
         list(decode_records(bytes(buf)))
 
 
+def test_every_truncation_point_yields_clean_prefix():
+    """Robustness sweep: a WAL chopped at ANY byte offset (crash
+    mid-write at an arbitrary point) must decode to a clean prefix of
+    the original records — never raise, never yield a partial record."""
+    msgs = [
+        EndHeightMessage(0),
+        MsgInfo(VoteMessage(mkvote(1)), "peer-a"),
+        TimeoutInfo(3000, 1, 0, 3),
+        MsgInfo(VoteMessage(mkvote(1, 1)), "peer-b"),
+        EndHeightMessage(1),
+    ]
+    recs = [encode_record(100 + i, m) for i, m in enumerate(msgs)]
+    buf = b"".join(recs)
+    bounds = [0]
+    for r in recs:
+        bounds.append(bounds[-1] + len(r))
+    for cut in range(len(buf) + 1):
+        out = list(decode_records(buf[:cut]))
+        # number of COMPLETE records before the cut
+        want = sum(1 for b in bounds[1:] if b <= cut)
+        assert len(out) == want, f"cut at {cut}: {len(out)} != {want}"
+        for got, orig in zip(out, msgs):
+            assert type(got.msg) is type(orig)
+
+
+def test_corrupt_tail_yields_prior_records_then_raises():
+    """A CRC flip in the LAST record must still hand replay every record
+    before it (decode_records is a generator: consume incrementally, the
+    way catchup replay would after a partially-flushed disk error)."""
+    msgs = [EndHeightMessage(0), MsgInfo(VoteMessage(mkvote(1)), "p"),
+            EndHeightMessage(1)]
+    recs = [encode_record(10 + i, m) for i, m in enumerate(msgs)]
+    buf = bytearray(b"".join(recs))
+    buf[len(recs[0]) + len(recs[1]) + 10] ^= 0xFF  # corrupt record 3's payload
+    it = decode_records(bytes(buf))
+    assert isinstance(next(it).msg, EndHeightMessage)
+    assert isinstance(next(it).msg, MsgInfo)
+    with pytest.raises(DataCorruptionError):
+        next(it)
+
+
+def test_crc_valid_garbage_payload_is_corruption():
+    """Framing intact + CRC valid but the payload is not a WAL message:
+    DataCorruptionError, not a KeyError leaking into replay."""
+    import zlib
+
+    payload = b"\xff\xfe\xfd\xfc not-a-proto"
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    buf = struct.pack(">II", crc, len(payload)) + payload
+    with pytest.raises(DataCorruptionError):
+        list(decode_records(buf))
+
+
 def test_oversized_length_raises():
     buf = bytearray(encode_record(1, EndHeightMessage(5)))
     struct.pack_into(">I", buf, 4, 10 * 1024 * 1024)
