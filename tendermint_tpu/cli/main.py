@@ -777,6 +777,18 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    """Live ANSI dashboard over a node's RPC status + /metrics: consensus
+    progress, peers + send queues, verify queue/occupancy/cache, jit
+    compile events, device memory (cli/top.py).  `--once --json` emits
+    one machine-readable snapshot."""
+    from tendermint_tpu.cli.top import run_top
+
+    return run_top(args.rpc_laddr, args.metrics_laddr,
+                   interval=args.interval, once=args.once,
+                   as_json=args.json, timeout=args.timeout)
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -863,6 +875,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="emit the merged report as JSON")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("top", help="live dashboard for one node "
+                                    "(RPC status + /metrics)")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr",
+                    default="http://127.0.0.1:26657")
+    sp.add_argument("--metrics-laddr", dest="metrics_laddr",
+                    default="http://127.0.0.1:26660",
+                    help="Prometheus listener; '' disables the metrics view")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-request HTTP timeout")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the snapshot as JSON (implies one frame)")
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("wal2json", help="dump a consensus WAL as JSON lines")
     sp.add_argument("wal_file")

@@ -1,0 +1,316 @@
+"""`tendermint-tpu top` — a live terminal dashboard for one node.
+
+Polls the node's RPC (`status`, `net_info`, `consensus_state`) and its
+Prometheus `/metrics` endpoint and renders consensus progress
+(height/round/step), peer count + per-peer send-queue depths, the
+verify pipeline (queue depth, per-rung batch occupancy, cumulative
+padding rows, cache hit ratio), jit compile events, and device memory —
+the `dump_consensus_state`-style live introspection of the DEVICE
+layer, upstream Tendermint never had one of these.
+
+Curses-free: the refresh loop repaints with plain ANSI (`ESC[H ESC[2J`),
+so it works over any dumb terminal/ssh pipe.  `--once` prints a single
+frame; `--once --json` emits the raw snapshot for scripting and tests.
+Every data source is best-effort — an unreachable metrics listener (or
+a node without instrumentation enabled) degrades to the RPC-only view,
+with the failure listed under `errors`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+
+def _http_base(addr: str) -> str:
+    if addr.startswith("tcp://"):
+        addr = "http://" + addr[len("tcp://"):]
+    if not addr.startswith(("http://", "https://")):
+        addr = "http://" + addr
+    return addr.rstrip("/")
+
+
+def _get_json(url: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        doc = json.loads(r.read())
+    return doc.get("result", doc)
+
+
+def _get_text(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def parse_exposition(text: str):
+    """Exposition 0.0.4 text → list[(name, labels, value)]."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        labels: dict[str, str] = {}
+        if "{" in series:
+            name, _, rest = series.partition("{")
+            for pair in rest.rstrip("}").split(","):
+                k, _, v = pair.partition("=")
+                labels[k] = v.strip('"')
+        else:
+            name = series
+        try:
+            samples.append((name, labels, float(value)))
+        except ValueError:
+            continue
+    return samples
+
+
+def _index(samples):
+    by_name: dict[str, list] = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    return by_name
+
+
+def _scalar(by_name, name, default=None):
+    rows = by_name.get(name)
+    if not rows:
+        return default
+    return rows[0][1]
+
+
+def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
+    """One dashboard snapshot; every missing source appends to
+    `errors` instead of failing the frame."""
+    snap: dict = {
+        "ts": time.time(),
+        "node": {},
+        "height": None,
+        "round": None,
+        "step": None,
+        "peers": {"count": None, "send_queue_depths": {}},
+        "verify": {"queue_depth": None, "submitted": None, "flushes": None,
+                   "device_batches": None, "cache_hit_ratio": None,
+                   "backend": None, "device_ready": None,
+                   "occupancy": {}, "padding_rows_total": None,
+                   "transfer_bytes_total": None},
+        "compile": {"total": 0, "seconds_total": 0.0, "recompiles": 0,
+                    "by_rung": {}},
+        "device_memory": [],
+        "errors": [],
+    }
+    verify = snap["verify"]
+
+    try:
+        st = _get_json(f"{rpc_base}/status", timeout)
+        ni = st.get("node_info", {})
+        snap["node"] = {"moniker": ni.get("moniker", ""),
+                        "id": ni.get("id", ""),
+                        "network": ni.get("network", "")}
+        sync = st.get("sync_info", {})
+        snap["height"] = int(sync.get("latest_block_height", 0))
+        snap["node"]["catching_up"] = bool(sync.get("catching_up", False))
+        vs = st.get("verify_service", {})
+        if vs:
+            verify["backend"] = vs.get("backend")
+            verify["device_ready"] = vs.get("device_ready")
+            verify["queue_depth"] = int(vs.get("queue_depth", 0))
+            verify["submitted"] = int(vs.get("submitted", 0))
+            verify["cache_hit_ratio"] = vs.get("cache_hit_ratio")
+    except Exception as e:  # noqa: BLE001 — RPC down: metrics-only frame
+        snap["errors"].append(f"status: {e}")
+
+    try:
+        cs = _get_json(f"{rpc_base}/consensus_state", timeout)
+        rs = cs.get("round_state", {})
+        snap["round"] = rs.get("round")
+        snap["step"] = rs.get("step")
+    except Exception as e:  # noqa: BLE001
+        snap["errors"].append(f"consensus_state: {e}")
+
+    try:
+        ni = _get_json(f"{rpc_base}/net_info", timeout)
+        snap["peers"]["count"] = int(ni.get("n_peers", 0))
+    except Exception as e:  # noqa: BLE001
+        snap["errors"].append(f"net_info: {e}")
+
+    if metrics_base:
+        try:
+            by_name = _index(parse_exposition(
+                _get_text(f"{metrics_base}/metrics", timeout)))
+            _fold_metrics(snap, by_name)
+        except Exception as e:  # noqa: BLE001
+            snap["errors"].append(f"metrics: {e}")
+    return snap
+
+
+def _fold_metrics(snap: dict, by_name: dict) -> None:
+    verify = snap["verify"]
+    if snap["height"] is None:
+        h = _scalar(by_name, "tendermint_consensus_height")
+        snap["height"] = int(h) if h is not None else None
+    if snap["round"] is None:
+        r = _scalar(by_name, "tendermint_consensus_rounds")
+        snap["round"] = int(r) if r is not None else None
+    if snap["peers"]["count"] is None:
+        p = _scalar(by_name, "tendermint_p2p_peers")
+        snap["peers"]["count"] = int(p) if p is not None else None
+
+    depths: dict[str, int] = {}
+    for labels, v in by_name.get("tendermint_p2p_peer_send_queue_depth", []):
+        pid = labels.get("peer_id", "?")
+        depths[pid] = depths.get(pid, 0) + int(v)
+    snap["peers"]["send_queue_depths"] = depths
+
+    if verify["queue_depth"] is None:
+        q = _scalar(by_name, "tendermint_crypto_verify_queue_depth")
+        verify["queue_depth"] = int(q) if q is not None else None
+    if verify["submitted"] is None:
+        s = _scalar(by_name, "tendermint_crypto_verify_submitted_total")
+        verify["submitted"] = int(s) if s is not None else None
+    fl = _scalar(by_name, "tendermint_crypto_verify_flushes_total")
+    verify["flushes"] = int(fl) if fl is not None else None
+    db = _scalar(by_name, "tendermint_crypto_verify_device_batches_total")
+    verify["device_batches"] = int(db) if db is not None else None
+    if verify["cache_hit_ratio"] is None:
+        hits = _scalar(by_name, "tendermint_crypto_verify_cache_hits_total", 0)
+        misses = _scalar(by_name,
+                         "tendermint_crypto_verify_cache_misses_total", 0)
+        total = (hits or 0) + (misses or 0)
+        verify["cache_hit_ratio"] = round(hits / total, 4) if total else 0.0
+
+    pad = _scalar(by_name, "tendermint_crypto_verify_padding_rows_total")
+    verify["padding_rows_total"] = int(pad) if pad is not None else None
+    xfer = _scalar(by_name, "tendermint_crypto_verify_transfer_bytes_total")
+    verify["transfer_bytes_total"] = int(xfer) if xfer is not None else None
+
+    # per-rung mean occupancy from the histogram's sum/count series
+    occ: dict[str, dict] = {}
+    counts = {labels.get("rung", "?"): v for labels, v in by_name.get(
+        "tendermint_crypto_verify_batch_occupancy_ratio_count", [])}
+    sums = {labels.get("rung", "?"): v for labels, v in by_name.get(
+        "tendermint_crypto_verify_batch_occupancy_ratio_sum", [])}
+    for rung, c in sorted(counts.items(), key=lambda kv: _rung_key(kv[0])):
+        occ[rung] = {"flushes": int(c),
+                     "mean_ratio": round(sums.get(rung, 0.0) / c, 4)
+                     if c else None}
+    verify["occupancy"] = occ
+
+    comp = snap["compile"]
+    by_rung = {}
+    total = 0
+    for labels, v in by_name.get("tendermint_crypto_jit_compile_total", []):
+        key = f"{labels.get('rung', '?')}/{labels.get('impl', '?')}"
+        by_rung[key] = int(v)
+        total += int(v)
+    comp["by_rung"] = by_rung
+    comp["total"] = total
+    comp["seconds_total"] = round(sum(
+        v for _l, v in by_name.get(
+            "tendermint_crypto_jit_compile_seconds_total", [])), 3)
+    rc = _scalar(by_name, "tendermint_crypto_jit_recompile_total", 0)
+    comp["recompiles"] = int(rc or 0)
+
+    mem: dict[str, dict] = {}
+    for labels, v in by_name.get("tendermint_crypto_device_memory_bytes", []):
+        dev = labels.get("device", "?")
+        entry = mem.setdefault(dev, {"device": dev,
+                                     "platform": labels.get("platform", "?")})
+        entry[labels.get("kind", "bytes")] = int(v)
+    snap["device_memory"] = [mem[k] for k in sorted(mem)]
+
+
+def _rung_key(rung: str):
+    try:
+        return (0, int(rung))
+    except ValueError:
+        return (1, rung)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024:
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _v(x, fmt="{}"):
+    return fmt.format(x) if x is not None else "-"
+
+
+def render(snap: dict) -> str:
+    node = snap.get("node", {})
+    verify = snap["verify"]
+    comp = snap["compile"]
+    when = time.strftime("%H:%M:%S", time.localtime(snap["ts"]))
+    lines = [
+        f"tendermint-tpu top — {node.get('moniker') or node.get('id', '?')[:12]}"
+        f"  chain={node.get('network', '?')}  {when}",
+        f"consensus  height {_v(snap['height'])}  round {_v(snap['round'])}"
+        f"  step {_v(snap['step'])}"
+        f"  catching_up {_v(node.get('catching_up'))}",
+    ]
+    depths = snap["peers"]["send_queue_depths"]
+    qtxt = "  ".join(f"{pid[:8]}:{d}" for pid, d in sorted(depths.items()))
+    lines.append(f"peers      {_v(snap['peers']['count'])}"
+                 + (f"  send-queues {qtxt}" if qtxt else ""))
+    ready = ("ready" if verify["device_ready"]
+             else "not-ready" if verify["device_ready"] is not None else "-")
+    ratio = verify["cache_hit_ratio"]
+    lines.append(
+        f"verify     queue {_v(verify['queue_depth'])}"
+        f"  submitted {_v(verify['submitted'])}"
+        f"  flushes {_v(verify['flushes'])}"
+        f" (device {_v(verify['device_batches'])})"
+        f"  cache-hit {_v(ratio if ratio is None else round(100 * ratio, 1), '{}%')}"
+        f"  backend {_v(verify['backend'])}/{ready}")
+    occ = verify["occupancy"]
+    if occ:
+        otxt = "  ".join(
+            f"{rung}:{d['flushes']}x@{d['mean_ratio']}" for rung, d in occ.items())
+        lines.append(f"occupancy  {otxt}")
+    lines.append(
+        f"padding    rows {_v(verify['padding_rows_total'])}"
+        f"  transfer {_fmt_bytes(verify['transfer_bytes_total'])}")
+    ctxt = "  ".join(f"{k}:{v}" for k, v in sorted(comp["by_rung"].items()))
+    lines.append(
+        f"compile    {comp['total']} programs  {comp['seconds_total']}s"
+        f"  recompiles {comp['recompiles']}" + (f"  [{ctxt}]" if ctxt else ""))
+    if snap["device_memory"]:
+        for e in snap["device_memory"]:
+            detail = "  ".join(
+                f"{k} {_fmt_bytes(v)}" for k, v in e.items()
+                if k not in ("device", "platform"))
+            lines.append(f"memory     dev{e['device']} {e['platform']}  {detail}")
+    else:
+        lines.append("memory     (no device memory reported)")
+    for err in snap["errors"]:
+        lines.append(f"! {err}")
+    return "\n".join(lines) + "\n"
+
+
+def run_top(rpc_addr: str, metrics_addr: str, *, interval: float = 2.0,
+            once: bool = False, as_json: bool = False,
+            timeout: float = 5.0) -> int:
+    rpc_base = _http_base(rpc_addr)
+    metrics_base = _http_base(metrics_addr) if metrics_addr else ""
+    try:
+        while True:
+            snap = collect(rpc_base, metrics_base, timeout=timeout)
+            if as_json:
+                sys.stdout.write(json.dumps(snap) + "\n")
+            elif once:
+                sys.stdout.write(render(snap))
+            else:
+                sys.stdout.write("\x1b[H\x1b[2J" + render(snap))
+            sys.stdout.flush()
+            if once or as_json:
+                # scripting mode is one frame; a refresh loop of JSON
+                # docs is `watch tendermint-tpu top --once --json`
+                return 0 if snap["height"] is not None else 1
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0

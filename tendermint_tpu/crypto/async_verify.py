@@ -60,6 +60,7 @@ import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
 
+from tendermint_tpu.utils import devmon as _devmon
 from tendermint_tpu.utils import trace as _trace
 from tendermint_tpu.utils.metrics import Histogram
 
@@ -422,6 +423,10 @@ class VerifyService:
             if _trace.enabled():
                 _trace.record("verify.host_prep", t_prep, prep_dt,
                               n=end - start, rung=b)
+            if _devmon.STATS.enabled:
+                _devmon.STATS.record_flush(
+                    "verify", end - start, b,
+                    nbytes=sum(a.nbytes for a in padded))
             while len(inflight) >= 2:
                 self._drain_one(inflight)
             t_enq = time.perf_counter()
@@ -607,14 +612,30 @@ def service_stats() -> dict:
         return {"submitted": 0, "flushes": 0, "host_flushes": 0,
                 "device_batches": 0, "coalesced_max": 0,
                 "pipelined_drains": 0, "cache_hits": 0, "cache_misses": 0,
-                "cache_size": 0}
+                "cache_size": 0, "queue_depth": 0}
     with svc._cv:
         out = dict(svc.stats)
+        out["queue_depth"] = len(svc._queue)
     cache = svc.cache
     with cache._lock:
         out["cache_hits"] = cache.hits
         out["cache_misses"] = cache.misses
         out["cache_size"] = len(cache._d)
+    return out
+
+
+def device_stats() -> dict:
+    """Device-layer snapshot next to service_stats(): utils/devmon's
+    compile/occupancy/padding/memory accounting folded together with the
+    service's live queue depth and verified-signature cache hit ratio —
+    one call answers "how efficiently is the device being used right
+    now".  Like service_stats(), never instantiates the service."""
+    out = _devmon.device_stats()
+    st = service_stats()
+    lookups = st["cache_hits"] + st["cache_misses"]
+    out["queue_depth"] = st["queue_depth"]
+    out["cache_hit_ratio"] = (round(st["cache_hits"] / lookups, 6)
+                              if lookups else 0.0)
     return out
 
 

@@ -211,6 +211,62 @@ class NodeMetrics:
             "Service flushes dispatched to the device path",
             namespace=ns, subsystem="crypto", fn=_svc("device_batches"),
         ))
+        self.verify_queue_depth = reg.register(Gauge(
+            "verify_queue_depth",
+            "Requests waiting in the verification service's submission queue",
+            namespace=ns, subsystem="crypto", fn=_svc("queue_depth"),
+        ))
+
+        # -- device layer (utils/devmon) --------------------------------
+        # compile tracking + batch-efficiency accounting + device memory.
+        # Module attributes are resolved at scrape time so a devmon.reset()
+        # (tests/bench) is picked up by the next scrape.
+        from tendermint_tpu.utils import devmon as _dm
+
+        self.jit_compiles = reg.register(LabeledCallbackGauge(
+            "jit_compile_total",
+            "JIT programs compiled (first call per bucket rung), by rung/impl",
+            namespace=ns, subsystem="crypto", kind="counter",
+            fn=lambda: _dm.TRACKER.compile_count_samples(),
+        ))
+        self.jit_compile_seconds = reg.register(LabeledCallbackGauge(
+            "jit_compile_seconds_total",
+            "Wall seconds spent in first-call trace+compile, by rung/impl",
+            namespace=ns, subsystem="crypto", kind="counter",
+            fn=lambda: _dm.TRACKER.compile_seconds_samples(),
+        ))
+        self.jit_recompiles = reg.register(CallbackCounter(
+            "jit_recompile_total",
+            "Unexpected recompiles (same jit cache key compiled twice)",
+            namespace=ns, subsystem="crypto",
+            fn=lambda: _dm.TRACKER.recompiles,
+        ))
+        reg.register(_dm.VERIFY_BATCH_OCCUPANCY)
+        self.verify_padding_rows = reg.register(CallbackCounter(
+            "verify_padding_rows_total",
+            "Wasted (padding) rows shipped to the device by bucket rounding",
+            namespace=ns, subsystem="crypto",
+            fn=lambda: _dm.STATS.padding_rows,
+        ))
+        self.verify_transfer_bytes = reg.register(CallbackCounter(
+            "verify_transfer_bytes_total",
+            "Estimated host-to-device bytes shipped (padded row widths)",
+            namespace=ns, subsystem="crypto",
+            fn=lambda: _dm.STATS.transfer_bytes,
+        ))
+        self.verify_rung_flushes = reg.register(LabeledCallbackGauge(
+            "verify_rung_flushes_total",
+            "Device flushes by program kind and bucket rung",
+            namespace=ns, subsystem="crypto", kind="counter",
+            fn=lambda: _dm.STATS.rung_flush_samples(),
+        ))
+        self.device_memory_bytes = reg.register(LabeledCallbackGauge(
+            "device_memory_bytes",
+            "Per-device memory from jax memory_stats()/live buffers "
+            "(absent until a backend is initialized)",
+            namespace=ns, subsystem="crypto",
+            fn=_dm.memory_gauge_samples,
+        ))
 
         # -- latency histograms fed at their source ---------------------
         # Process-wide module singletons (the verify service, the FSM,

@@ -10,6 +10,9 @@ The Python equivalents of goroutine/heap profiles:
     GET /debug/pprof/trace     recent span ring (utils.trace) as JSONL;
                                ?fmt=chrome returns the Perfetto-loadable
                                Chrome trace-event JSON
+    GET /debug/pprof/device    device-layer accounting (utils.devmon):
+                               jit compile events, batch occupancy and
+                               padding waste, device memory
 
 Plain text responses, stdlib only.
 """
@@ -113,10 +116,18 @@ class PprofServer:
             fmt = urllib.parse.parse_qs(parsed.query).get("fmt", [""])[0]
             ctype, body = _trace_dump(fmt)
             return 200, ctype, body.encode()
+        elif route.startswith("/debug/pprof/device"):
+            # device-layer accounting (utils/devmon): compile events,
+            # batch occupancy/padding, device memory.  Never initializes
+            # a backend — safe to scrape a node whose device never woke.
+            from tendermint_tpu.utils import devmon
+
+            body = devmon.render_text()
         elif route.startswith("/debug/pprof"):
             body = ("pprof analog endpoints:\n"
                     "/debug/pprof/goroutine\n/debug/pprof/heap\n"
-                    "/debug/pprof/trace[?fmt=chrome]\n")
+                    "/debug/pprof/trace[?fmt=chrome]\n"
+                    "/debug/pprof/device\n")
         else:
             return None
         return 200, "text/plain", body.encode()

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tendermint_tpu.crypto import ed25519 as _ref
+from tendermint_tpu.utils import devmon as _devmon
 
 L = _ref.L
 SCALAR_BITS = 253  # s, k < L < 2^253
@@ -505,7 +506,8 @@ def _compiled(n: int, impl: str | None = None, base_mxu: bool = False):
     # must resolve the impl themselves (verify_batch does); this default
     # resolves once per (n, None) cache entry.  base_mxu is part of the
     # cache key because it is baked into the trace.
-    core = _core(impl or default_impl())
+    impl_r = impl or default_impl()
+    core = _core(impl_r)
 
     # a named wrapper, NOT functools.partial: jit derives the HLO module
     # name from __name__, and the persistent compile cache keys on it —
@@ -514,7 +516,11 @@ def _compiled(n: int, impl: str | None = None, base_mxu: bool = False):
         return core.verify_core(pub_rows, r_rows, s_rows, k_rows, valid,
                                 base_mxu=base_mxu)
 
-    return jax.jit(verify_core)
+    # compile tracking (utils/devmon): the first call per cache entry is
+    # the one that pays trace+compile; re-tracing the same key after a
+    # cache_clear is the unexpected-recompile the tracker warns about
+    return _devmon.track_jit(jax.jit(verify_core), kind="verify",
+                             impl=impl_r, rung=n, base_mxu=base_mxu)
 
 
 def rlc_reduce_lanes() -> int:
@@ -538,7 +544,8 @@ def _compiled_rlc(n: int, impl: str, reduce_lanes: int = 2048):
         return core.verify_core_rlc(pub_rows, r_rows, zk_rows, z_rows,
                                     valid, reduce_lanes=reduce_lanes)
 
-    return jax.jit(verify_core_rlc)
+    return _devmon.track_jit(jax.jit(verify_core_rlc), kind="rlc",
+                             impl=impl, rung=n, reduce_lanes=reduce_lanes)
 
 
 # ---------------------------------------------------------------------------
@@ -770,6 +777,11 @@ def _verify_rows(pub_rows, r_rows, s_rows, k_rows, valid, impl: str) -> np.ndarr
     pub_rows, r_rows, s_rows, k_rows, valid_p = _pad_rows(
         n, b, pub_rows, r_rows, s_rows, k_rows, valid
     )
+    if _devmon.STATS.enabled:
+        _devmon.STATS.record_flush(
+            "verify", n, b,
+            nbytes=(pub_rows.nbytes + r_rows.nbytes + s_rows.nbytes
+                    + k_rows.nbytes + valid_p.nbytes))
     ok = _compiled(b, impl, base_mxu)(pub_rows, r_rows, s_rows, k_rows, valid_p)
     return np.asarray(ok)[:n]
 
@@ -810,6 +822,10 @@ def _verify_batch_pipelined(pubs, msgs, sigs, impl: str, chunk: int) -> np.ndarr
     for start, end, b in chunks_of(len(pubs), chunk):
         rows = prepare_batch(pubs[start:end], msgs[start:end], sigs[start:end])
         padded = _pad_rows(end - start, b, *rows)
+        if _devmon.STATS.enabled:
+            _devmon.STATS.record_flush(
+                "verify", end - start, b,
+                nbytes=sum(a.nbytes for a in padded))
         pending.append((_compiled(b, impl, base_mxu)(*padded), end - start))
     return np.concatenate([np.asarray(ok)[:m] for ok, m in pending])
 
@@ -903,6 +919,10 @@ def verify_batch_rlc(pubs, msgs, sigs, impl: str | None = None) -> np.ndarray:
     pub_p, r_p, zk_p, z_p, valid_p = _pad_rows(
         n, b, pub_rows, r_rows, zk_rows, z_rows, valid
     )
+    if _devmon.STATS.enabled:
+        _devmon.STATS.record_flush(
+            "rlc", n, b,
+            nbytes=sum(a.nbytes for a in (pub_p, r_p, zk_p, z_p, valid_p)))
     acc, prevalid = _compiled_rlc(b, impl, rlc_reduce_lanes())(
         pub_p, r_p, zk_p, z_p, valid_p
     )

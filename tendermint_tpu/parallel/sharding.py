@@ -20,6 +20,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tendermint_tpu.ops import ed25519_jax as _dev
+from tendermint_tpu.utils import devmon as _devmon
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -47,7 +48,12 @@ def sharded_verify_fn(mesh: Mesh):
     batch2 = NamedSharding(mesh, P("batch", None))
     # (pub_rows, r_rows, s_rows, k_rows, valid) — packed [N,32] u8 + bool[N]
     in_sh = (batch2, batch2, batch2, batch2, batch)
-    return jax.jit(_dev._verify_core, in_shardings=in_sh, out_shardings=batch)
+    # one jit compiles one program per input shape: rung=None tracks the
+    # first call per leading-axis size (utils/devmon)
+    return _devmon.track_jit(
+        jax.jit(_dev._verify_core, in_shardings=in_sh, out_shardings=batch),
+        kind="sharded_verify", impl=_dev.default_impl(),
+        devices=int(mesh.devices.size))
 
 
 @functools.lru_cache(maxsize=8)
@@ -74,14 +80,17 @@ def sharded_rlc_fn(mesh: Mesh, impl: str, reduce_lanes: int = 2048):
 
     core = verify_core_rlc
     b2 = P("batch", None)
-    return jax.jit(
-        shard_map(
-            core,
-            mesh=mesh,
-            in_specs=(b2, b2, b2, b2, P("batch")),
-            out_specs=((b2, b2, b2, b2), P("batch")),
-        )
-    )
+    return _devmon.track_jit(
+        jax.jit(
+            shard_map(
+                core,
+                mesh=mesh,
+                in_specs=(b2, b2, b2, b2, P("batch")),
+                out_specs=((b2, b2, b2, b2), P("batch")),
+            )
+        ),
+        kind="sharded_rlc", impl=impl, devices=int(mesh.devices.size),
+        reduce_lanes=reduce_lanes)
 
 
 def verify_batch_rlc_sharded(pubs, msgs, sigs, mesh: Mesh | None = None,
@@ -108,6 +117,10 @@ def verify_batch_rlc_sharded(pubs, msgs, sigs, mesh: Mesh | None = None,
     pub_p, r_p, zk_p, z_p, valid_p = _dev._pad_rows(
         n, b, pub_rows, r_rows, zk_rows, z_rows, valid
     )
+    if _devmon.STATS.enabled:
+        _devmon.STATS.record_flush(
+            "rlc_sharded", n, b,
+            nbytes=sum(a.nbytes for a in (pub_p, r_p, zk_p, z_p, valid_p)))
     acc, prevalid = sharded_rlc_fn(mesh, impl, _dev.rlc_reduce_lanes())(
         pub_p, r_p, zk_p, z_p, valid_p
     )
@@ -135,6 +148,9 @@ def _verify_rows_sharded(inputs, n: int, mesh: Mesh) -> np.ndarray:
         inputs = tuple(
             np.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) for x in inputs
         )
+    if _devmon.STATS.enabled:
+        _devmon.STATS.record_flush(
+            "verify_sharded", n, b, nbytes=sum(a.nbytes for a in inputs))
     ok = sharded_verify_fn(mesh)(*inputs)
     return np.asarray(ok)[:n]
 

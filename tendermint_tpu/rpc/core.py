@@ -94,6 +94,31 @@ def health(env: Environment) -> dict:
     return {}
 
 
+def _verify_service_status() -> dict:
+    """Compact verify-service block for `status`: one RPC answers "is
+    the TPU path actually live on this node".  Reads only existing
+    snapshots — never instantiates the service or touches a backend."""
+    from tendermint_tpu.crypto import async_verify as _av
+    from tendermint_tpu.crypto import batch as _cbatch
+
+    st = _av.service_stats()
+    lookups = st["cache_hits"] + st["cache_misses"]
+    svc = _av._SERVICE
+    backend = "unstarted"
+    if svc is not None:
+        backend = "jax" if svc._jax_bv is not None else "host"
+    return {
+        "enabled": _av.service_enabled(),
+        "backend": backend,
+        "device_ready": _cbatch.device_ready(),
+        "queue_depth": enc.i64(st["queue_depth"]),
+        "submitted": enc.i64(st["submitted"]),
+        "device_batches": enc.i64(st["device_batches"]),
+        "cache_hit_ratio": round(st["cache_hits"] / lookups, 4)
+        if lookups else 0.0,
+    }
+
+
 def status(env: Environment) -> dict:
     latest = _latest_height(env)
     meta = env.block_store.load_block_meta(latest) if latest else None
@@ -131,6 +156,7 @@ def status(env: Environment) -> dict:
                         {"type": "tendermint/PubKeyEd25519", "value": ""}),
             "voting_power": enc.i64(power),
         },
+        "verify_service": _verify_service_status(),
     }
 
 

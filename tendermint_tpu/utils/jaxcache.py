@@ -8,7 +8,10 @@ and plant poisoned entries.  The default now lives inside the repo tree
 `TM_BENCH_CACHE` remains the explicit override.
 """
 
+import logging
 import os
+
+_log = logging.getLogger("tendermint_tpu.utils.jaxcache")
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -33,6 +36,20 @@ def enable(jax_module) -> None:
 
     Without this, every program in this container recompiles through
     the ~100 s/bucket remote-compile relay (see .claude/skills/verify).
+    The resolved dir and whether it pre-existed are logged at startup:
+    a silently-missing cache is exactly how the 100 s/bucket relay
+    sneaks back in, and the log line is the operator's one-glance check
+    (pre_existed=False on a deployment that should be warm is the bug).
     """
-    jax_module.config.update("jax_compilation_cache_dir", cache_dir())
+    d = cache_dir()
+    pre_existed = os.path.isdir(d)
+    entries = 0
+    if pre_existed:
+        try:
+            entries = sum(1 for nm in os.listdir(d) if not nm.startswith("."))
+        except OSError:
+            pre_existed = False
+    jax_module.config.update("jax_compilation_cache_dir", d)
     jax_module.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _log.info("jax persistent compile cache: dir=%s pre_existed=%s entries=%d",
+              d, pre_existed, entries)

@@ -1,10 +1,26 @@
 """Structured key-value logging (reference libs/log: leveled, per-module
-`With("module", ...)` fields)."""
+`With("module", ...)` fields).
+
+TM_TPU_LOG_FMT=json switches every line to one JSON object
+`{"ts", "level", "msg", **fields}` (wall-clock seconds, merged
+with_/call fields) so node logs join with the event journal
+(consensus/eventlog.py) and trace exports by timestamp; the default
+text format is unchanged.  The flag is read per line, but the handler
+prefix is chosen when logging is first configured — flip the env before
+the first new_logger() call for clean JSON output.
+"""
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
+import time
+
+
+def _json_mode() -> bool:
+    return os.environ.get("TM_TPU_LOG_FMT", "").lower() == "json"
 
 
 class Logger:
@@ -17,25 +33,29 @@ class Logger:
         merged.update(fields)
         return Logger(self._base, merged)
 
-    def _fmt(self, msg: str, kv: dict) -> str:
+    def _fmt(self, msg: str, kv: dict, level: str = "info") -> str:
         merged = dict(self._fields)
         merged.update(kv)
+        if _json_mode():
+            doc = {"ts": round(time.time(), 6), "level": level, "msg": msg}
+            doc.update(merged)
+            return json.dumps(doc, default=str)
         if not merged:
             return msg
         tail = " ".join(f"{k}={v}" for k, v in merged.items())
         return f"{msg} {tail}"
 
     def debug(self, msg: str, **kv) -> None:
-        self._base.debug(self._fmt(msg, kv))
+        self._base.debug(self._fmt(msg, kv, "debug"))
 
     def info(self, msg: str, **kv) -> None:
-        self._base.info(self._fmt(msg, kv))
+        self._base.info(self._fmt(msg, kv, "info"))
 
     def warn(self, msg: str, **kv) -> None:
-        self._base.warning(self._fmt(msg, kv))
+        self._base.warning(self._fmt(msg, kv, "warn"))
 
     def error(self, msg: str, **kv) -> None:
-        self._base.error(self._fmt(msg, kv))
+        self._base.error(self._fmt(msg, kv, "error"))
 
 
 _configured = False
@@ -46,9 +66,13 @@ def new_logger(name: str = "tendermint_tpu", level: str = "info") -> Logger:
     base = logging.getLogger(name)
     if not _configured:
         handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname).1s %(name)s | %(message)s")
-        )
+        if _json_mode():
+            # the message IS the JSON document; no text prefix
+            handler.setFormatter(logging.Formatter("%(message)s"))
+        else:
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname).1s %(name)s | %(message)s")
+            )
         root = logging.getLogger("tendermint_tpu")
         if not root.handlers:
             root.addHandler(handler)

@@ -1,0 +1,341 @@
+"""Device-layer observability (ISSUE 4): occupancy/padding accounting
+exact against the `_bucket` ladder, compile-tracker first-call and
+double-compile detection, the `device_stats()` snapshot, JSON log
+format, jaxcache startup logging, and the `top --once --json` golden
+over a live single node (plus exposition TYPE checks for every new
+series and the /debug/pprof/device dump).
+"""
+
+import asyncio
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.keys import priv_key_from_seed
+from tendermint_tpu.node import Node
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+from tendermint_tpu.utils import devmon
+from tendermint_tpu.utils.metrics import Histogram
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+class _Capture(logging.Handler):
+    """Handler attached DIRECTLY to a named logger: the package root
+    sets propagate=False once node logging is configured, so pytest's
+    root-logger caplog never sees these records."""
+
+    def __init__(self):
+        super().__init__()
+        self.lines: list[str] = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+
+@pytest.fixture
+def capture_logger():
+    handlers = []
+
+    def attach(name: str, level=logging.INFO) -> _Capture:
+        lg = logging.getLogger(name)
+        h = _Capture()
+        lg.addHandler(h)
+        lg.setLevel(level)
+        handlers.append((lg, h))
+        return h
+
+    yield attach
+    for lg, h in handlers:
+        lg.removeHandler(h)
+
+
+# ---------------------------------------------------------------------------
+# occupancy / padding math
+# ---------------------------------------------------------------------------
+
+def test_occupancy_padding_math_matches_bucket():
+    """Exact expected waste at n=1, 64, 129, 320 against the real
+    `_bucket` ladder (the 1.49x worst case at 129→192 included)."""
+    from tendermint_tpu.ops.ed25519_jax import _bucket
+
+    hist = Histogram("test_occupancy_ratio", "", label_names=("rung",),
+                     buckets=devmon.OCCUPANCY_BUCKETS)
+    st = devmon.DeviceStats(enabled=True, hist=hist)
+    want_buckets = {1: 8, 64: 64, 129: 192, 320: 320}
+    for n, want_b in want_buckets.items():
+        b = _bucket(n)
+        assert b == want_b, (n, b)
+        # per-row program ships 4x 32B rows + 1 valid byte per padded row
+        st.record_flush("verify", n, b, nbytes=129 * b)
+
+    snap = st.snapshot()
+    assert snap["flushes_total"] == 4
+    assert snap["rows_requested_total"] == 1 + 64 + 129 + 320
+    assert snap["rows_padded_total"] == 8 + 64 + 192 + 320
+    assert snap["padding_rows_total"] == (8 - 1) + (192 - 129)
+    assert snap["transfer_bytes_total"] == 129 * (8 + 64 + 192 + 320)
+
+    per_rung = {(r["kind"], r["rung"]): r for r in snap["rungs"]}
+    assert per_rung[("verify", 192)]["padding_rows"] == 63
+    assert per_rung[("verify", 192)]["mean_occupancy"] == round(129 / 192, 4)
+    assert per_rung[("verify", 64)]["padding_rows"] == 0
+    assert per_rung[("verify", 64)]["mean_occupancy"] == 1.0
+
+    # the histogram saw the exact ratios, one observation per rung
+    for n, b in want_buckets.items():
+        counts, total, cnt = hist._series[(str(b),)]
+        assert cnt == 1
+        assert total == n / b  # 1/8, 1.0, 129/192, 1.0 — all f64-exact
+
+
+def test_disabled_stats_record_nothing():
+    st = devmon.DeviceStats(enabled=False)
+    # flush sites guard with `if STATS.enabled:` — one branch, no call
+    if st.enabled:
+        st.record_flush("verify", 10, 16)
+    assert st.snapshot()["flushes_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# compile tracker
+# ---------------------------------------------------------------------------
+
+def test_compile_tracker_first_call_and_double_compile(capture_logger):
+    cap = capture_logger("tendermint_tpu.devmon", logging.WARNING)
+    tr = devmon.CompileTracker()
+    calls = []
+
+    def fake_jit(*args):
+        calls.append(args)
+        return "verdicts"
+
+    p1 = devmon.track_jit(fake_jit, kind="verify", impl="int64", rung=192,
+                          tracker=tr, base_mxu=False)
+    assert p1("a") == "verdicts"
+    assert p1("b") == "verdicts"  # steady state: no second event
+    snap = tr.snapshot()
+    assert snap["total"] == 1 and snap["recompiles"] == 0
+    assert snap["by_rung"] == {"192/int64": 1}
+    ev = snap["events"][0]
+    assert ev["rung"] == 192 and ev["impl"] == "int64"
+    assert ev["cache_hit"] is True  # a stub "compile" is instant
+    assert ev["recompile"] is False
+    assert len(calls) == 2
+    assert not cap.lines
+
+    # the same cache key traced again (functools cache cleared): the
+    # unexpected-recompile counter and a warn log
+    p2 = devmon.track_jit(fake_jit, kind="verify", impl="int64", rung=192,
+                          tracker=tr, base_mxu=False)
+    p2("c")
+    snap = tr.snapshot()
+    assert snap["total"] == 2 and snap["recompiles"] == 1
+    assert snap["events"][-1]["recompile"] is True
+    assert any("recompile" in ln for ln in cap.lines)
+
+    # a DIFFERENT key (other rung) is a normal compile, not a recompile
+    p3 = devmon.track_jit(fake_jit, kind="verify", impl="int64", rung=320,
+                          tracker=tr, base_mxu=False)
+    p3("d")
+    assert tr.snapshot()["recompiles"] == 1
+
+
+def test_compile_tracker_dynamic_rung():
+    """rung=None (the sharded jits): one program per input shape."""
+
+    class Rows:
+        def __init__(self, n):
+            self.shape = (n, 32)
+
+    tr = devmon.CompileTracker()
+    proxy = devmon.track_jit(lambda a: a.shape[0], kind="sharded_verify",
+                             impl="int64", tracker=tr, devices=8)
+    assert proxy(Rows(128)) == 128
+    proxy(Rows(128))
+    proxy(Rows(256))
+    snap = tr.snapshot()
+    assert snap["total"] == 2
+    assert set(snap["by_rung"]) == {"128/int64", "256/int64"}
+
+
+# ---------------------------------------------------------------------------
+# snapshots
+# ---------------------------------------------------------------------------
+
+def test_device_stats_snapshot_shape():
+    from tendermint_tpu.crypto import async_verify as _av
+
+    st = _av.service_stats()
+    assert "queue_depth" in st  # live queue depth rides service_stats now
+    snap = _av.device_stats()
+    for key in ("enabled", "flushes_total", "padding_rows_total",
+                "transfer_bytes_total", "rungs", "compile", "device_memory",
+                "queue_depth", "cache_hit_ratio"):
+        assert key in snap, key
+    assert isinstance(snap["device_memory"], list)
+    assert {"total", "seconds_total", "recompiles",
+            "by_rung", "events"} <= set(snap["compile"])
+    # the text dump renders without a backend ever being touched
+    text = devmon.render_text()
+    assert "jit compiles" in text and "device memory" in text
+
+
+# ---------------------------------------------------------------------------
+# satellites: JSON log format, jaxcache startup log
+# ---------------------------------------------------------------------------
+
+def test_json_log_format(monkeypatch, capture_logger):
+    from tendermint_tpu.utils import log as tmlog
+
+    cap = capture_logger("tm-json-test", logging.DEBUG)
+    base = logging.getLogger("tm-json-test")
+    base.propagate = False
+    lg = tmlog.Logger(base).with_(module="consensus")
+
+    monkeypatch.setenv("TM_TPU_LOG_FMT", "json")
+    lg.info("hello", height=3, peer="ab12")
+    doc = json.loads(cap.lines[-1])
+    assert doc["msg"] == "hello" and doc["level"] == "info"
+    assert doc["module"] == "consensus"
+    assert doc["height"] == 3 and doc["peer"] == "ab12"
+    assert isinstance(doc["ts"], float)
+    lg.warn("slow", dur_ms=12.5)
+    assert json.loads(cap.lines[-1])["level"] == "warn"
+
+    # default text format unchanged
+    monkeypatch.delenv("TM_TPU_LOG_FMT")
+    lg.info("hello", height=3)
+    assert cap.lines[-1] == "hello module=consensus height=3"
+
+
+def test_jaxcache_enable_logs_dir_and_preexistence(
+        monkeypatch, tmp_path, capture_logger):
+    from tendermint_tpu.utils import jaxcache
+
+    cap = capture_logger("tendermint_tpu.utils.jaxcache")
+    updates = []
+
+    class FakeConfig:
+        def update(self, k, v):
+            updates.append((k, v))
+
+    class FakeJax:
+        config = FakeConfig()
+
+    cache = tmp_path / "jcache"
+    monkeypatch.setenv("TM_BENCH_CACHE", str(cache))
+    jaxcache.enable(FakeJax())
+    assert ("jax_compilation_cache_dir", str(cache)) in updates
+    assert "pre_existed=False" in cap.lines[-1]
+
+    cache.mkdir()
+    (cache / "prog_abc").write_bytes(b"x")
+    jaxcache.enable(FakeJax())
+    assert "pre_existed=True" in cap.lines[-1]
+    assert "entries=1" in cap.lines[-1]
+
+
+# ---------------------------------------------------------------------------
+# live single node: top --once --json golden, status verify_service,
+# metrics TYPE conformance for every new series, pprof device dump
+# ---------------------------------------------------------------------------
+
+NEW_SERIES_TYPES = [
+    ("tendermint_crypto_jit_compile_total", "counter"),
+    ("tendermint_crypto_jit_compile_seconds_total", "counter"),
+    ("tendermint_crypto_jit_recompile_total", "counter"),
+    ("tendermint_crypto_verify_batch_occupancy_ratio", "histogram"),
+    ("tendermint_crypto_verify_padding_rows_total", "counter"),
+    ("tendermint_crypto_verify_transfer_bytes_total", "counter"),
+    ("tendermint_crypto_verify_rung_flushes_total", "counter"),
+    ("tendermint_crypto_verify_queue_depth", "gauge"),
+    ("tendermint_crypto_device_memory_bytes", "gauge"),
+]
+
+
+def test_top_once_json_over_live_node(tmp_path, capsys):
+    from tendermint_tpu.cli.main import main as cli_main
+    from tendermint_tpu.rpc import core as rpc_core
+
+    async def run():
+        key = priv_key_from_seed(b"\x77" * 32)
+        gen = GenesisDoc(
+            chain_id="devmon-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.base.fast_sync = False
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "tcp://127.0.0.1:0"
+        cfg.rpc.pprof_laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = key
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        try:
+            await node.wait_for_height(2, timeout=60)
+            rh, rp = node.rpc_addr
+            mh, mp = node.metrics.addr
+            ph, pp = node.pprof_addr
+
+            rc = await asyncio.to_thread(
+                cli_main,
+                ["top", "--once", "--json",
+                 "--rpc-laddr", f"http://{rh}:{rp}",
+                 "--metrics-laddr", f"http://{mh}:{mp}"])
+            assert rc == 0
+
+            # RPC status carries the compact verify_service block
+            st = rpc_core.status(node.rpc_env)
+            vs = st["verify_service"]
+            assert vs["enabled"] is True
+            assert vs["backend"] in ("jax", "host", "unstarted")
+            assert isinstance(vs["device_ready"], bool)
+            assert int(vs["queue_depth"]) >= 0
+            assert 0.0 <= vs["cache_hit_ratio"] <= 1.0
+
+            def fetch(url):
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    return r.read().decode()
+
+            # every new series advertises the right exposition TYPE
+            text = await asyncio.to_thread(
+                fetch, f"http://{mh}:{mp}/metrics")
+            for series, kind in NEW_SERIES_TYPES:
+                assert f"# TYPE {series} {kind}" in text, series
+
+            # pprof device dump renders the accounting
+            dump = await asyncio.to_thread(
+                fetch, f"http://{ph}:{pp}/debug/pprof/device")
+            assert "jit compiles" in dump
+            assert "device flushes" in dump
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+    out = capsys.readouterr().out
+    snap = json.loads(out.strip().splitlines()[-1])
+    assert snap["height"] >= 2
+    assert snap["peers"]["count"] == 0
+    verify = snap["verify"]
+    assert verify["queue_depth"] == 0
+    assert isinstance(verify["occupancy"], dict)
+    assert verify["padding_rows_total"] >= 0
+    assert verify["transfer_bytes_total"] >= 0
+    assert verify["backend"] in ("jax", "host", "unstarted")
+    comp = snap["compile"]
+    assert comp["total"] >= 0 and comp["recompiles"] >= 0
+    assert isinstance(snap["device_memory"], list)
+    assert snap["errors"] == []
