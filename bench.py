@@ -775,6 +775,31 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["device_observability_error"] = str(e)[-300:]
 
+        # -- tmlint over the full tree: analyzer wall time (budget: the
+        # tier-1 gate runs it on every suite, so it must stay trivially
+        # cheap — <5 s for the whole package) + finding count.  A
+        # non-zero count here is a regression the tier-1 test will also
+        # catch; surfacing it in the BENCH artifact makes the drift
+        # visible even when only the bench runs.
+        _stage_set("lint")
+        try:
+            from tendermint_tpu.lint import lint_package
+
+            t0 = time.perf_counter()
+            lint_findings = lint_package()
+            lint_s = time.perf_counter() - t0
+            lint_budget_s = 5.0
+            _partial.update({
+                "lint_seconds": round(lint_s, 3),
+                "lint_budget_s": lint_budget_s,
+                "lint_within_budget": bool(lint_s <= lint_budget_s),
+                "lint_findings": len(lint_findings),
+            })
+            if lint_findings:
+                _partial["lint_first_finding"] = lint_findings[0].format()
+        except Exception as e:  # noqa: BLE001
+            _partial["lint_error"] = str(e)[-300:]
+
         _stage_set("pair-median")
         assert headline_pairs, "headline path recorded no (prod, baseline) pairs"
         base = statistics.median(b for _p, b in headline_pairs)

@@ -17,7 +17,12 @@ from __future__ import annotations
 
 import threading
 
-import grpc
+try:
+    # gated, not required at import (tmlint eager-optional-import):
+    # connect()/start() raise at point of use when grpcio is absent
+    import grpc
+except Exception:  # pragma: no cover — ModuleNotFoundError and kin
+    grpc = None
 
 from tendermint_tpu.utils.log import Logger, nop_logger
 
@@ -106,6 +111,9 @@ class GRPCAppClient:
         self._channel: grpc.Channel | None = None
 
     def connect(self, retries: int = 40, delay: float = 0.25) -> None:
+        from tendermint_tpu.utils.grpc_util import require_grpc
+
+        require_grpc()
         self._channel = grpc.insecure_channel(self.laddr)
         try:
             grpc.channel_ready_future(self._channel).result(

@@ -789,6 +789,17 @@ def cmd_top(args) -> int:
                    as_json=args.json, timeout=args.timeout)
 
 
+def cmd_lint(args) -> int:
+    """Repo-aware static analysis (tendermint_tpu/lint): six rules, each
+    grounded in a shipped bug or a hot-path invariant.  Exit 0 = clean,
+    1 = findings, 2 = usage error; `--json` is the scripting entry point
+    (docs/linting.md)."""
+    from tendermint_tpu.lint import run_cli
+
+    return run_cli(paths=args.paths or None, as_json=args.json,
+                   rules=args.rules, list_rules=args.list_rules)
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -892,6 +903,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="emit the snapshot as JSON (implies one frame)")
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("lint", help="repo-aware static analysis (tmlint)")
+    sp.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: the "
+                         "installed tendermint_tpu package)")
+    sp.add_argument("--json", action="store_true",
+                    help="emit findings as one JSON object")
+    sp.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    sp.add_argument("--list-rules", dest="list_rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("wal2json", help="dump a consensus WAL as JSON lines")
     sp.add_argument("wal_file")

@@ -21,7 +21,10 @@ import os
 try:
     import tomllib
 except ModuleNotFoundError:  # Python < 3.11: same API from the backport
-    import tomli as tomllib
+    try:
+        import tomli as tomllib
+    except ModuleNotFoundError:  # neither: raise at load_config, not here
+        tomllib = None
 from dataclasses import dataclass, field
 
 from tendermint_tpu.consensus.config import ConsensusConfig
@@ -251,6 +254,10 @@ def load_config(home: str) -> Config:
     path = cfg.config_file
     if not os.path.exists(path):
         return cfg
+    if tomllib is None:
+        raise ImportError(
+            "reading config.toml requires tomllib (Python >= 3.11) or the "
+            "`tomli` backport; neither is installed")
     with open(path, "rb") as fh:
         doc = tomllib.load(fh)
     for name, cls in _SECTIONS:

@@ -100,7 +100,10 @@ class EventJournal:
         rec = {
             "e": event,
             "n": self.node,
-            "w": time.time_ns(),
+            # wall clock is the point: journals from N nodes merge on
+            # "w" for the cross-node timeline (cli/timeline.py); "m" is
+            # the monotonic companion for in-process deltas
+            "w": time.time_ns(),  # tmlint: disable=wallclock-in-consensus
             "m": time.perf_counter_ns(),
         }
         if _trace.enabled():

@@ -18,6 +18,7 @@ each drained slice through the TPU BatchVerifier.
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 
 from tendermint_tpu.p2p import ChannelDescriptor, Envelope, PeerStatus
@@ -104,6 +105,14 @@ class ConsensusReactor:
         self.peers: dict[str, PeerState] = {}
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
         self._tasks: list[asyncio.Task] = []
+        # seeded jitter source for the maj23 gossip cadence (tmlint
+        # wallclock-in-consensus: consensus paths use seeded entropy so
+        # runs are reproducible).  TM_TPU_GOSSIP_SEED pins it for tests;
+        # the default decorrelates reactors across processes AND within
+        # one process (multi-node test nets share a pid).
+        seed = os.environ.get("TM_TPU_GOSSIP_SEED")
+        self._jitter_rng = random.Random(
+            int(seed) if seed else hash((os.getpid(), id(self))))
 
         self.state_ch = router.open_channel(_descriptor(STATE_CHANNEL, 6))
         self.data_ch = router.open_channel(_descriptor(DATA_CHANNEL, 10))
@@ -625,7 +634,8 @@ class ConsensusReactor:
     async def _query_maj23(self, ps: PeerState) -> None:
         while True:
             try:
-                await asyncio.sleep(self.maj23_sleep + random.random() * 0.1)
+                await asyncio.sleep(
+                    self.maj23_sleep + self._jitter_rng.random() * 0.1)
                 rs = self.cs.rs
                 prs = ps.prs
                 # Periodic round-step refresh.  NewRoundStep is otherwise

@@ -971,6 +971,9 @@ class ConsensusState:
         that delivered it ("" = our own, via the internal queue).  `at_r`
         is the round this node was in at arrival — what the timeline
         analyzer uses to flag late votes."""
+        # both call sites hold the `journal.enabled and not replay_mode`
+        # guard; this helper only exists to share the formatting
+        # tmlint: disable=ungated-observability
         self.journal.log(
             "vote", h=vote.height, r=vote.round,
             type=("prevote" if vote.type == SignedMsgType.PREVOTE

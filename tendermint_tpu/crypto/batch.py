@@ -440,9 +440,21 @@ class JAXBatchVerifier(_BaseBatch):
         return bool(all(oks)), oks
 
 
-_DEFAULT_BACKEND = os.environ.get("TM_TPU_CRYPTO_BACKEND", "auto")
-if _DEFAULT_BACKEND not in ("auto", "jax", "cpu"):
-    _DEFAULT_BACKEND = "auto"
+# None = not yet resolved: TM_TPU_CRYPTO_BACKEND is read lazily at the
+# first new_batch_verifier() call (not at import — tmlint
+# import-time-env; the PR 3 multinode flake came from exactly this kind
+# of construction-time env capture).  set_default_backend() pins a
+# value; reload_env() un-pins back to the environment.
+_DEFAULT_BACKEND: str | None = None
+
+
+def _default_backend() -> str:
+    global _DEFAULT_BACKEND
+    if _DEFAULT_BACKEND is None:
+        backend = os.environ.get("TM_TPU_CRYPTO_BACKEND", "auto")
+        _DEFAULT_BACKEND = backend if backend in ("auto", "jax", "cpu") \
+            else "auto"
+    return _DEFAULT_BACKEND
 
 
 def set_default_backend(name: str) -> None:
@@ -452,8 +464,15 @@ def set_default_backend(name: str) -> None:
     _DEFAULT_BACKEND = name
 
 
+def reload_env() -> None:
+    """Drop the cached/pinned default so the next new_batch_verifier()
+    re-reads TM_TPU_CRYPTO_BACKEND."""
+    global _DEFAULT_BACKEND
+    _DEFAULT_BACKEND = None
+
+
 def new_batch_verifier(backend: str | None = None) -> BatchVerifier:
-    backend = backend or _DEFAULT_BACKEND
+    backend = backend or _default_backend()
     if backend not in ("auto", "jax", "cpu"):
         raise ValueError(f"unknown batch-verifier backend {backend!r}")
     if backend == "cpu":

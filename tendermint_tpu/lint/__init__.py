@@ -1,0 +1,37 @@
+"""tmlint — repo-aware static analysis for tendermint-tpu.
+
+The Python analogue of the reference's `make lint` CI gate: every rule
+is grounded in a bug this repo actually shipped (eager optional imports
+taking down every verify surface in the minimal container; a singleton
+freezing TM_TPU_CPU_THRESHOLD at construction) or in a hot-path
+invariant the bench enforces dynamically (one-branch-when-disabled
+observability, no host syncs inside jit-compiled programs).
+
+Entry points:
+  * ``tendermint-tpu lint [paths] [--json]`` (cli/main.py subcommand)
+  * :func:`lint_package` — analyze the installed package tree
+  * :func:`lint_paths` — analyze arbitrary files/directories
+  * tests/test_lint.py — tier-1 gate asserting zero findings
+
+See docs/linting.md for the rule catalogue and suppression syntax
+(``# tmlint: disable=RULE`` inline, ``# tmlint: disable-file=RULE``
+file-wide).
+"""
+
+from tendermint_tpu.lint.analyzer import (
+    Finding,
+    RULES,
+    lint_package,
+    lint_paths,
+    package_root,
+)
+from tendermint_tpu.lint.cli import run as run_cli
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "lint_package",
+    "lint_paths",
+    "package_root",
+    "run_cli",
+]

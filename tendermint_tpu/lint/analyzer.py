@@ -1,0 +1,639 @@
+"""AST analyzer behind `tendermint-tpu lint`.
+
+Six rules, each motivated by a shipped bug or a hot-path invariant:
+
+  import-time-env          Module-level `os.environ` reads freeze config
+                           before tests/operators can set it (the PR 3
+                           multinode flake: a singleton captured
+                           TM_TPU_CPU_THRESHOLD at construction).
+  eager-optional-import    Top-level imports of optional deps crash every
+                           importer on the minimal container (the PR 1
+                           `cryptography` incident took down pure-ed25519
+                           verification); `jax` outside the device
+                           modules drags a multi-second import into
+                           processes that never touch a device.
+  ungated-observability    Sinks whose cost contract is "caller pays one
+                           branch when disabled" (devmon STATS, the
+                           consensus journal) called without the
+                           `.enabled` guard.
+  host-sync-in-jit         `.item()` / `np.asarray` / `jax.device_get` /
+                           `.block_until_ready` reachable inside a
+                           jit-compiled function body: a host sync baked
+                           into the traced program.
+  wallclock-in-consensus   `time.time()`/`time.time_ns()`/module-level
+                           `random.*` in consensus/ — steps must use
+                           monotonic clocks and seeded entropy so replay
+                           and tests are deterministic.
+  metric-name-conformance  Counter series must end `_total`, gauges must
+                           not, duplicate metric names, and unbounded
+                           ("high-cardinality") label names.
+
+Suppressions: ``# tmlint: disable=RULE[,RULE...]`` (or ``disable=all``)
+on the flagged line or on a comment line directly above it;
+``# tmlint: disable-file=RULE[,...]`` anywhere in the file suppresses
+the rule file-wide.  Suppressed findings are dropped, not reported.
+
+The analyzer is two-phase: phase 1 parses every file and collects
+cross-file facts (names of functions handed to ``jax.jit``; metric
+name registrations for duplicate detection), phase 2 walks each tree
+with an execution-context state machine (import-time vs runtime,
+enabled-gated, try/except-import-guarded, inside-jit).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+RULES: dict[str, str] = {
+    "import-time-env":
+        "module-level os.environ read: config frozen at import, before "
+        "tests/operators can set it — resolve lazily (reload_env pattern)",
+    "eager-optional-import":
+        "top-level import of an optional dependency (cryptography, "
+        "tomllib/tomli, hypothesis, grpc; jax outside ops/ and parallel/) "
+        "— defer to point of use or gate with try/except",
+    "ungated-observability":
+        "observability sink whose disabled-path contract is one caller "
+        "branch (STATS.record_flush, journal.log) called without an "
+        "`.enabled` guard",
+    "host-sync-in-jit":
+        "host synchronization (.item/.tolist/np.asarray/jax.device_get/"
+        ".block_until_ready) inside a jit-compiled function body",
+    "wallclock-in-consensus":
+        "wall clock (time.time/time_ns) or unseeded module-level random.* "
+        "in consensus/ — use monotonic clocks / seeded random.Random",
+    "metric-name-conformance":
+        "counter not ending _total, gauge/histogram ending _total, "
+        "duplicate metric name, or high-cardinality label name",
+}
+
+#: top-level packages that must never be imported eagerly (the minimal
+#: container does not ship them; PR 1 gated them in-tree after the
+#: cryptography import took down every verify surface)
+OPTIONAL_TOP_PACKAGES = {"cryptography", "tomllib", "tomli", "hypothesis",
+                         "grpc"}
+
+#: directory names whose modules are allowed to import jax at top level
+#: (the device modules — everything else defers to point of use)
+JAX_ALLOWED_DIRS = {"ops", "parallel"}
+
+#: files that DEFINE the observability sinks: internal calls inside them
+#: are the implementation, not a call site
+OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py"}
+
+#: label names that explode series cardinality on a real network
+HIGH_CARDINALITY_LABELS = {"height", "hash", "tx_hash", "block_hash",
+                           "addr", "address", "time", "timestamp",
+                           "error", "msg", "reason"}
+
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram", "LabeledCallbackGauge",
+                   "CallbackCounter"}
+_METRIC_KWARGS = {"namespace", "subsystem", "label_names", "fn", "buckets",
+                  "help_", "kind"}
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+_SUPPRESS_RE = re.compile(r"#\s*tmlint:\s*disable=([A-Za-z\-, ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*tmlint:\s*disable-file=([A-Za-z\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# per-file context: source, tree, suppressions, path scoping
+# ---------------------------------------------------------------------------
+
+class FileContext:
+    def __init__(self, path: Path, display: str, source: str):
+        self.path = path
+        self.display = display
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+        parts = Path(display).parts
+        self.in_consensus = "consensus" in parts
+        self.jax_allowed = bool(JAX_ALLOWED_DIRS.intersection(parts))
+        self.obs_definition = path.name in OBSERVABILITY_DEF_FILES
+        self._line_suppressions: dict[int, set[str]] = {}
+        self._file_suppressions: set[str] = set()
+        self._scan_suppressions(source)
+
+    def _scan_suppressions(self, source: str) -> None:
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self._file_suppressions.update(_parse_rule_list(m.group(1)))
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = _parse_rule_list(m.group(1))
+            cell = self._line_suppressions.setdefault(i, set())
+            cell.update(rules)
+            if line.lstrip().startswith("#"):
+                # comment-only directive covers the following line too
+                # (long call statements whose own line has no room)
+                nxt = self._line_suppressions.setdefault(i + 1, set())
+                nxt.update(rules)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if rule in self._file_suppressions or "all" in self._file_suppressions:
+            return True
+        rules = self._line_suppressions.get(line, ())
+        return rule in rules or "all" in rules
+
+
+def _parse_rule_list(raw: str) -> set[str]:
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+# ---------------------------------------------------------------------------
+# phase 1: cross-file collection
+# ---------------------------------------------------------------------------
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """`jit` / `jax.jit` / `anything.jit` reference."""
+    return ((isinstance(node, ast.Name) and node.id == "jit")
+            or (isinstance(node, ast.Attribute) and node.attr == "jit"))
+
+
+def _jit_arg_name(arg: ast.AST) -> str | None:
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Attribute):
+        return arg.attr
+    return None
+
+
+def collect_jit_targets(tree: ast.AST) -> set[str]:
+    """Names of functions handed to jax.jit — via direct call
+    `jit(f, ...)`, decorator `@jit`, `@jit(...)`, or
+    `@partial(jit, ...)`."""
+    targets: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_ref(node.func) and node.args:
+            name = _jit_arg_name(node.args[0])
+            if name:
+                targets.add(name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec):
+                    targets.add(node.name)
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_ref(dec.func):
+                        targets.add(node.name)
+                    elif (isinstance(dec.func, (ast.Name, ast.Attribute))
+                          and getattr(dec.func, "id",
+                                      getattr(dec.func, "attr", "")) == "partial"
+                          and dec.args and _is_jit_ref(dec.args[0])):
+                        targets.add(node.name)
+    return targets
+
+
+def _metric_call_info(node: ast.Call) -> dict | None:
+    """Recognize a metrics-class constructor call with a literal name.
+    Returns {cls, name, kind, subsystem, labels, line, col} or None."""
+    func = node.func
+    cls = func.id if isinstance(func, ast.Name) else (
+        func.attr if isinstance(func, ast.Attribute) else None)
+    if cls not in _METRIC_CLASSES:
+        return None
+    if not node.args or not isinstance(node.args[0], ast.Constant) \
+            or not isinstance(node.args[0].value, str):
+        return None
+    kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+    # disambiguate from e.g. collections.Counter: require metric-shaped
+    # keywords or a literal help string in the second position
+    metric_shaped = (bool(_METRIC_KWARGS.intersection(kwargs))
+                     or (len(node.args) >= 2
+                         and isinstance(node.args[1], ast.Constant)
+                         and isinstance(node.args[1].value, str)))
+    if not metric_shaped:
+        return None
+    kind = {"Counter": "counter", "CallbackCounter": "counter",
+            "Gauge": "gauge", "Histogram": "histogram",
+            "LabeledCallbackGauge": "gauge"}[cls]
+    kv = kwargs.get("kind")
+    if isinstance(kv, ast.Constant) and kv.value == "counter":
+        kind = "counter"
+    subsystem = ""
+    sv = kwargs.get("subsystem")
+    if isinstance(sv, ast.Constant) and isinstance(sv.value, str):
+        subsystem = sv.value
+    labels: list[str] = []
+    lv = kwargs.get("label_names")
+    if isinstance(lv, (ast.Tuple, ast.List)):
+        labels = [e.value for e in lv.elts
+                  if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return {"cls": cls, "name": node.args[0].value, "kind": kind,
+            "subsystem": subsystem, "labels": labels,
+            "line": node.lineno, "col": node.col_offset}
+
+
+def collect_metric_defs(ctx: FileContext) -> list[dict]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            info = _metric_call_info(node)
+            if info:
+                info["path"] = ctx.display
+                out.append(info)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# phase 2: the walker
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _St:
+    runtime: bool = False     # inside a function/lambda body
+    gated: bool = False       # inside an `if ...enabled...:` guard
+    optguard: bool = False    # inside try/except-ImportError or TYPE_CHECKING
+    in_jit: bool = False      # inside a function handed to jax.jit
+
+
+def _test_mentions_enabled(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr == "enabled":
+            return True
+        if isinstance(n, ast.Name) and n.id == "enabled":
+            return True
+        if isinstance(n, ast.Call):
+            f = n.func
+            if (isinstance(f, ast.Attribute) and f.attr == "enabled") or \
+                    (isinstance(f, ast.Name) and f.id == "enabled"):
+                return True
+    return False
+
+
+def _is_type_checking(test: ast.AST) -> bool:
+    return ((isinstance(test, ast.Name) and test.id == "TYPE_CHECKING")
+            or (isinstance(test, ast.Attribute)
+                and test.attr == "TYPE_CHECKING"))
+
+
+def _handler_guards_import(handler: ast.ExceptHandler) -> bool:
+    names: list[str] = []
+    t = handler.type
+    elts = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+    for e in elts:
+        if isinstance(e, ast.Name):
+            names.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.append(e.attr)
+    if t is None:
+        return True  # bare except
+    return bool({"ImportError", "ModuleNotFoundError", "Exception",
+                 "BaseException"}.intersection(names))
+
+
+def _ends_in_exit(stmts: list[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Continue, ast.Break, ast.Raise))
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os")
+
+
+class _Walker:
+    def __init__(self, ctx: FileContext, rules: set[str],
+                 jit_targets: set[str],
+                 metric_first: dict[tuple, tuple[str, int]],
+                 findings: list[Finding]):
+        self.ctx = ctx
+        self.rules = rules
+        self.jit_targets = jit_targets
+        self.metric_first = metric_first
+        self.findings = findings
+
+    # -- reporting ------------------------------------------------------
+
+    def _report(self, node: ast.AST, rule: str, message: str) -> None:
+        if rule not in self.rules:
+            return
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) + 1
+        if self.ctx.suppressed(line, rule):
+            return
+        self.findings.append(Finding(self.ctx.display, line, col, rule,
+                                     message))
+
+    # -- traversal ------------------------------------------------------
+
+    def run(self) -> None:
+        self._walk_body(self.ctx.tree.body, _St())
+
+    def _walk_body(self, stmts: list[ast.stmt], st: _St) -> None:
+        for s in stmts:
+            self._walk(s, st)
+            # early-exit guard: `if not SINK.enabled: return` gates the
+            # remainder of this body
+            if (isinstance(s, ast.If) and _test_mentions_enabled(s.test)
+                    and _ends_in_exit(s.body) and not s.orelse):
+                st = dataclasses.replace(st, gated=True)
+
+    def _walk(self, node: ast.AST, st: _St) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                self._walk(dec, st)
+            args = node.args
+            # default values evaluate at definition time — in the
+            # enclosing (possibly import-time) context
+            for dflt in list(args.defaults) + [d for d in args.kw_defaults if d]:
+                self._walk(dflt, st)
+            in_jit = st.in_jit or node.name in self.jit_targets
+            self._walk_body(node.body, dataclasses.replace(
+                st, runtime=True, gated=False, in_jit=in_jit))
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(node.body, dataclasses.replace(st, runtime=True))
+            return
+        if isinstance(node, ast.ClassDef):
+            for dec in node.decorator_list:
+                self._walk(dec, st)
+            self._walk_body(node.body, st)  # class body runs at import
+            return
+        if isinstance(node, ast.If):
+            if _is_type_checking(node.test):
+                self._walk_body(node.body, dataclasses.replace(
+                    st, optguard=True))
+            else:
+                self._walk(node.test, st)
+                body_st = st
+                if _test_mentions_enabled(node.test):
+                    body_st = dataclasses.replace(st, gated=True)
+                self._walk_body(node.body, body_st)
+            self._walk_body(node.orelse, st)
+            return
+        if isinstance(node, ast.Try):
+            guard = st.optguard or any(_handler_guards_import(h)
+                                       for h in node.handlers)
+            self._walk_body(node.body, dataclasses.replace(
+                st, optguard=guard))
+            for h in node.handlers:
+                self._walk_body(h.body, st)
+            self._walk_body(node.orelse, st)
+            self._walk_body(node.finalbody, st)
+            return
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            self._check_import(node, st)
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node, st)
+        elif isinstance(node, ast.Subscript):
+            self._check_env_subscript(node, st)
+        elif isinstance(node, ast.Compare):
+            self._check_env_compare(node, st)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, st)
+
+    # -- rule: eager-optional-import ------------------------------------
+
+    def _check_import(self, node: ast.Import | ast.ImportFrom, st: _St) -> None:
+        if st.runtime:
+            return
+        if isinstance(node, ast.ImportFrom):
+            if node.level:          # relative import — in-package
+                return
+            roots = [(node.module or "").split(".")[0]]
+        else:
+            roots = [a.name.split(".")[0] for a in node.names]
+        for root in roots:
+            if root == "jax":
+                # try/except does not excuse jax: the import itself is
+                # the multi-second cost — only device modules may pay it
+                # at import time
+                if not self.ctx.jax_allowed:
+                    self._report(
+                        node, "eager-optional-import",
+                        "top-level `import jax` outside the device modules "
+                        "(ops/, parallel/) — defer to point of use")
+            elif root in OPTIONAL_TOP_PACKAGES and not st.optguard:
+                self._report(
+                    node, "eager-optional-import",
+                    f"top-level import of optional dependency {root!r} — "
+                    "gate with try/except (raise at point of use) or move "
+                    "into the function that needs it")
+
+    # -- rule: import-time-env ------------------------------------------
+
+    def _env_read_msg(self, what: str) -> str:
+        return (f"{what} at import time freezes the value before "
+                "tests/operators can set it — resolve lazily at first "
+                "use and expose reload_env()")
+
+    def _check_env_subscript(self, node: ast.Subscript, st: _St) -> None:
+        if st.runtime or not isinstance(node.ctx, ast.Load):
+            return
+        if _is_os_environ(node.value):
+            self._report(node, "import-time-env",
+                         self._env_read_msg("os.environ[...] read"))
+
+    def _check_env_compare(self, node: ast.Compare, st: _St) -> None:
+        if st.runtime:
+            return
+        for comp in node.comparators:
+            if _is_os_environ(comp):
+                self._report(node, "import-time-env",
+                             self._env_read_msg("`in os.environ` check"))
+
+    def _check_env_call(self, node: ast.Call, st: _St) -> None:
+        if st.runtime:
+            return
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in ("get", "setdefault") and _is_os_environ(func.value):
+                self._report(node, "import-time-env",
+                             self._env_read_msg(f"os.environ.{func.attr}()"))
+            elif func.attr == "getenv" and isinstance(func.value, ast.Name) \
+                    and func.value.id == "os":
+                self._report(node, "import-time-env",
+                             self._env_read_msg("os.getenv()"))
+
+    # -- rules on calls --------------------------------------------------
+
+    def _check_call(self, node: ast.Call, st: _St) -> None:
+        self._check_env_call(node, st)
+        func = node.func
+
+        # ungated-observability
+        if not self.ctx.obs_definition and isinstance(func, ast.Attribute):
+            if func.attr == "record_flush" and not st.gated:
+                self._report(
+                    node, "ungated-observability",
+                    "STATS.record_flush() without an `if ...enabled:` "
+                    "guard — the disabled path must cost one branch")
+            elif func.attr == "log" and not st.gated:
+                recv = func.value
+                recv_name = recv.attr if isinstance(recv, ast.Attribute) \
+                    else (recv.id if isinstance(recv, ast.Name) else "")
+                if recv_name.endswith("journal"):
+                    self._report(
+                        node, "ungated-observability",
+                        "journal.log() without an `if ...enabled:` guard "
+                        "— the disabled path must cost one branch")
+
+        # host-sync-in-jit
+        if st.in_jit and isinstance(func, ast.Attribute):
+            if func.attr in _HOST_SYNC_METHODS:
+                self._report(
+                    node, "host-sync-in-jit",
+                    f".{func.attr}() inside a jit-compiled function — "
+                    "host sync baked into the traced program")
+            elif func.attr == "asarray" and isinstance(func.value, ast.Name) \
+                    and func.value.id in ("np", "numpy"):
+                self._report(
+                    node, "host-sync-in-jit",
+                    "np.asarray() inside a jit-compiled function — "
+                    "device->host transfer in the traced program")
+            elif func.attr == "device_get" and isinstance(func.value, ast.Name) \
+                    and func.value.id == "jax":
+                self._report(
+                    node, "host-sync-in-jit",
+                    "jax.device_get() inside a jit-compiled function")
+
+        # wallclock-in-consensus
+        if self.ctx.in_consensus and isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            mod, attr = func.value.id, func.attr
+            if mod == "time" and attr in ("time", "time_ns"):
+                self._report(
+                    node, "wallclock-in-consensus",
+                    f"time.{attr}() in consensus code — use a monotonic "
+                    "clock (time.monotonic/perf_counter) so replay and "
+                    "tests are deterministic")
+            elif mod == "random":
+                if attr != "Random":
+                    self._report(
+                        node, "wallclock-in-consensus",
+                        f"random.{attr}() in consensus code — use a "
+                        "seeded random.Random instance")
+                elif not node.args and not node.keywords:
+                    self._report(
+                        node, "wallclock-in-consensus",
+                        "unseeded random.Random() in consensus code — "
+                        "pass an explicit seed")
+
+        # metric-name-conformance
+        info = _metric_call_info(node)
+        if info:
+            self._check_metric(node, info)
+
+    def _check_metric(self, node: ast.Call, info: dict) -> None:
+        rule = "metric-name-conformance"
+        name, kind = info["name"], info["kind"]
+        if kind == "counter" and not name.endswith("_total"):
+            self._report(node, rule,
+                         f"counter {name!r} must end in `_total` "
+                         "(Prometheus naming convention)")
+        elif kind == "gauge" and name.endswith("_total"):
+            self._report(node, rule,
+                         f"gauge {name!r} ends in `_total` — either it is "
+                         "monotonic (register a counter kind) or misnamed")
+        elif kind == "histogram" and name.endswith(
+                ("_total", "_bucket", "_sum", "_count")):
+            self._report(node, rule,
+                         f"histogram {name!r} collides with the generated "
+                         "_bucket/_sum/_count series suffixes")
+        bad_labels = HIGH_CARDINALITY_LABELS.intersection(info["labels"])
+        if bad_labels:
+            self._report(node, rule,
+                         f"label(s) {sorted(bad_labels)} on {name!r} are "
+                         "unbounded on a real network — series cardinality "
+                         "red flag")
+        key = (info["subsystem"], name)
+        first = self.metric_first.get(key)
+        if first and first != (self.ctx.display, info["line"]):
+            self._report(node, rule,
+                         f"metric {name!r} (subsystem {info['subsystem']!r}) "
+                         f"already registered at {first[0]}:{first[1]}")
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def package_root() -> Path:
+    """Directory of the installed tendermint_tpu package."""
+    return Path(__file__).resolve().parent.parent
+
+
+def _expand(paths: list[Path]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(f for f in sorted(p.rglob("*.py"))
+                         if "__pycache__" not in f.parts)
+        else:
+            files.append(p)
+    return files
+
+
+def _display(path: Path, base: Path | None) -> str:
+    try:
+        return str(path.resolve().relative_to(
+            (base or Path.cwd()).resolve()))
+    except ValueError:
+        return str(path)
+
+
+def lint_paths(paths: list[str | Path], rules: set[str] | None = None,
+               base: Path | None = None) -> list[Finding]:
+    """Analyze files/directories; returns findings sorted by location.
+
+    `base` anchors the displayed (and path-scoped-rule) relative paths;
+    it defaults to the parent of the package root so in-package files
+    render as `tendermint_tpu/...`.
+    """
+    active = set(RULES) if rules is None else set(rules)
+    unknown = active - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+    if base is None:
+        base = package_root().parent
+    files = _expand([Path(p) for p in paths])
+    ctxs: list[FileContext] = []
+    for f in files:
+        source = f.read_text(encoding="utf-8")
+        ctxs.append(FileContext(f, _display(f, base), source))
+
+    jit_targets: set[str] = set()
+    metric_first: dict[tuple, tuple[str, int]] = {}
+    for ctx in ctxs:
+        jit_targets |= collect_jit_targets(ctx.tree)
+        for info in collect_metric_defs(ctx):
+            key = (info["subsystem"], info["name"])
+            metric_first.setdefault(key, (info["path"], info["line"]))
+
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        _Walker(ctx, active, jit_targets, metric_first, findings).run()
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_package(rules: set[str] | None = None) -> list[Finding]:
+    """Analyze the whole installed tendermint_tpu tree."""
+    return lint_paths([package_root()], rules=rules)

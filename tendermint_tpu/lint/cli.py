@@ -1,0 +1,80 @@
+"""`tendermint-tpu lint` — CLI driver over lint.analyzer.
+
+Exit-code contract (scripting entry point, like `top --once --json`):
+  0  clean (no unsuppressed findings)
+  1  findings reported
+  2  usage error (unknown rule, unreadable path, syntax error)
+
+`--json` emits one machine-readable object:
+  {"findings": [{path, line, col, rule, message}...],
+   "files_scanned": N, "rules": [...], "elapsed_s": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+from tendermint_tpu.lint.analyzer import (
+    RULES,
+    lint_paths,
+    package_root,
+)
+
+
+def _count_files(paths: list[Path]) -> int:
+    n = 0
+    for p in paths:
+        if p.is_dir():
+            n += sum(1 for f in p.rglob("*.py") if "__pycache__" not in f.parts)
+        else:
+            n += 1
+    return n
+
+
+def run(paths: list[str] | None = None, as_json: bool = False,
+        rules: str = "", list_rules: bool = False,
+        out=None) -> int:
+    out = out or sys.stdout
+    if list_rules:
+        for rid, doc in RULES.items():
+            out.write(f"{rid}: {doc}\n")
+        return 0
+
+    active = None
+    if rules:
+        active = {r.strip() for r in rules.split(",") if r.strip()}
+
+    targets = [Path(p) for p in paths] if paths else [package_root()]
+    for t in targets:
+        if not t.exists():
+            sys.stderr.write(f"tmlint: no such path: {t}\n")
+            return 2
+
+    t0 = time.perf_counter()
+    try:
+        findings = lint_paths(targets, rules=active)
+    except ValueError as e:          # unknown rule
+        sys.stderr.write(f"tmlint: {e}\n")
+        return 2
+    except SyntaxError as e:
+        sys.stderr.write(f"tmlint: cannot parse {e.filename}:{e.lineno}: "
+                         f"{e.msg}\n")
+        return 2
+    elapsed = time.perf_counter() - t0
+
+    if as_json:
+        out.write(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "files_scanned": _count_files(targets),
+            "rules": sorted(active if active is not None else set(RULES)),
+            "elapsed_s": round(elapsed, 3),
+        }) + "\n")
+    else:
+        for f in findings:
+            out.write(f.format() + "\n")
+        out.write(f"tmlint: {len(findings)} finding(s) in "
+                  f"{_count_files(targets)} file(s) ({elapsed:.2f}s)\n")
+    return 1 if findings else 0

@@ -74,6 +74,10 @@ class NodeMetrics:
             "block_size_bytes", "Size of the latest block", namespace=ns,
             subsystem="consensus",
         ))
+        # upstream parity: the reference exposes exactly
+        # `tendermint_consensus_total_txs` (consensus/metrics.go), so the
+        # non-conventional name is kept for dashboard compatibility
+        # tmlint: disable=metric-name-conformance
         self.total_txs = reg.register(Counter(
             "total_txs", "Total committed txs since start", namespace=ns,
             subsystem="consensus",
@@ -134,7 +138,7 @@ class NodeMetrics:
             fn=lambda: _per_peer(node.router.peer_bytes_sent),
         ))
         self.p2p_msg_recv_count = reg.register(LabeledCallbackGauge(
-            "message_receive_count", "Decoded inbound messages by type",
+            "message_receive_count_total", "Decoded inbound messages by type",
             namespace=ns, subsystem="p2p", kind="counter",
             fn=lambda: [({"message_type": t}, v)
                         for t, v in sorted(node.router.msg_recv_count.items())],
@@ -148,7 +152,7 @@ class NodeMetrics:
             return [({"message_type": t}, v) for t, v in sorted(agg.items())]
 
         self.p2p_msg_send_count = reg.register(LabeledCallbackGauge(
-            "message_send_count", "Outbound messages by type (all channels)",
+            "message_send_count_total", "Outbound messages by type (all channels)",
             namespace=ns, subsystem="p2p", kind="counter",
             fn=_msg_send_count,
         ))

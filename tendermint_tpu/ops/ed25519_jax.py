@@ -763,7 +763,7 @@ def _resolve_optin(impl: str) -> bool:
     base_mxu = False
     if _base_mxu_requested():
         base_mxu = _optin_safe("base_mxu", impl)
-    if impl == "f32" and getattr(_field("f32"), "_USE_MXU", False):
+    if impl == "f32" and _field("f32")._use_mxu():
         _optin_safe("fe_mxu", impl)  # flips the module flag on mismatch
     return base_mxu
 

@@ -141,7 +141,27 @@ def _mul_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return cols
 
 
-_USE_MXU = os.environ.get("TM_TPU_FE_MXU", "0") == "1"
+# None = not yet resolved: TM_TPU_FE_MXU is read lazily at the first
+# fe_mul (not at import — tmlint import-time-env), so tests/operators
+# can still flip it after this module loads.  ed25519_jax's golden
+# self-check pins it False on a backend that miscomputes; tests pin it
+# with monkeypatch.setattr.
+_USE_MXU: bool | None = None
+
+
+def _use_mxu() -> bool:
+    global _USE_MXU
+    if _USE_MXU is None:
+        _USE_MXU = os.environ.get("TM_TPU_FE_MXU", "0") == "1"
+    return _USE_MXU
+
+
+def reload_env() -> None:
+    """Drop the cached flag so the next fe_mul re-reads TM_TPU_FE_MXU.
+    Compiled programs bake the flag in: callers that flip it must also
+    clear the jit caches (see ed25519_jax._optin_safe)."""
+    global _USE_MXU
+    _USE_MXU = None
 
 
 def _inc_matrix() -> np.ndarray:
@@ -187,7 +207,7 @@ def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, shape + (NLIMBS,))
     b = jnp.broadcast_to(b, shape + (NLIMBS,))
-    if _USE_MXU:
+    if _use_mxu():
         return _fe_mul_mxu(a, b)
     return _fold_cols(_mul_cols(a, b))
 

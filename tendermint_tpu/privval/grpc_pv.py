@@ -11,7 +11,12 @@ Method payloads (shared shapes with privval/socket_pv.py):
 
 from __future__ import annotations
 
-import grpc
+try:
+    # gated, not required at import (tmlint eager-optional-import):
+    # connect()/start() raise at point of use when grpcio is absent
+    import grpc
+except Exception:  # pragma: no cover — ModuleNotFoundError and kin
+    grpc = None
 
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
@@ -102,6 +107,9 @@ class GRPCSignerClient:
         self._cached_pub = None
 
     def connect(self, timeout: float = 30.0) -> None:
+        from tendermint_tpu.utils.grpc_util import require_grpc
+
+        require_grpc()
         self._channel = grpc.insecure_channel(self.laddr)
         try:
             grpc.channel_ready_future(self._channel).result(timeout=timeout)

@@ -14,7 +14,13 @@ from __future__ import annotations
 
 import base64
 
-import grpc
+try:
+    # gated, not required at import (tmlint eager-optional-import): the
+    # node only reaches this module when grpc_laddr is configured, and
+    # start()/connect() raise at point of use via utils.grpc_util
+    import grpc
+except Exception:  # pragma: no cover — ModuleNotFoundError and kin
+    grpc = None
 
 from tendermint_tpu.utils.log import Logger, nop_logger
 from tendermint_tpu.wire.proto import ProtoWriter, fields_to_dict
@@ -103,6 +109,9 @@ class GRPCBroadcastClient:
         self._channel: grpc.aio.Channel | None = None
 
     async def connect(self) -> None:
+        from tendermint_tpu.utils.grpc_util import require_grpc
+
+        require_grpc()
         self._channel = grpc.aio.insecure_channel(self.addr)
 
     async def close(self) -> None:

@@ -3,7 +3,22 @@ services (ABCI app transport, privval signer, RPC broadcast API)."""
 
 from __future__ import annotations
 
-import grpc
+try:
+    # Gated, not required at import (grpcio is optional; the minimal
+    # container may not ship it — same contract as crypto/secp256k1's
+    # cryptography gate): callers get an ImportError at the point of
+    # use, not a crashed importer.
+    import grpc
+except Exception:  # pragma: no cover — ModuleNotFoundError and kin
+    grpc = None
+
+
+def require_grpc() -> None:
+    """Raise at point of use when grpcio is absent (shared by every
+    gRPC surface: ABCI transport, privval signer, broadcast API)."""
+    if grpc is None:
+        raise ImportError(
+            "grpcio is required for gRPC transports but is not installed")
 
 
 async def start_generic_server(service: str, handlers: dict, laddr: str
@@ -11,6 +26,7 @@ async def start_generic_server(service: str, handlers: dict, laddr: str
     """Start a grpc.aio server exposing `handlers` (method name →
     async fn(bytes, context) -> bytes) on `laddr` (tcp://host:port or
     host:port; port 0 = ephemeral).  Returns (server, bound_addr)."""
+    require_grpc()
     target = laddr.split("://", 1)[-1]
     rpc_handlers = {
         name: grpc.unary_unary_rpc_method_handler(

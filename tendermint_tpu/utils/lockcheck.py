@@ -1,0 +1,244 @@
+"""Runtime lock-order checker: the dynamic complement to tmlint.
+
+The package holds ~22 `threading.Lock`/`RLock` sites (the verify
+service's queue + cache + service-singleton locks, devmon's stats and
+tracker locks, the stores' RLocks, ...).  A lock-order inversion between
+any two of them is a latent deadlock that no static rule catches — the
+PR 1 `_MEASURE_LOCK`/`_FLAG_LOCK` contention was found by hand.  This
+module finds them mechanically, the way Go's `-race`/mutex profiling
+rides along in the reference's CI: while installed, every lock created
+through `threading.Lock()`/`threading.RLock()` is wrapped so each
+acquisition records a per-thread edge `held -> acquired` into a global
+lock-site graph (sites are identified by the `file:line` that CREATED
+the lock, so the graph is stable across instances).  A new edge that
+closes a cycle (A→B observed after B→A — any cycle length, via DFS) is
+recorded as a violation; `check()` raises `LockOrderError` with both
+witness paths.
+
+Opt-in, two ways:
+  * TM_TPU_LOCKCHECK=1 + :func:`maybe_install_from_env` (tests/conftest
+    calls it, so the whole suite can run checked);
+  * :func:`install` directly — the async-verify and multinode test
+    modules do this from an autouse fixture and assert `check()` clean
+    at teardown.
+
+Scope and honesty about limits:
+  * only locks CREATED while installed are wrapped (module-level locks
+    from modules imported earlier are invisible) — the verify-service
+    test fixtures already recreate their singletons per test, which is
+    what puts the interesting locks in scope;
+  * `threading.Condition` over a wrapped lock works (attribute
+    forwarding covers `_release_save`/`_acquire_restore`/`_is_owned`),
+    but the release-reacquire inside `Condition.wait` bypasses the
+    bookkeeping: the waiter is parked, acquires nothing meanwhile, so
+    the held-set stays consistent;
+  * edges are cumulative across threads and time — an inversion does
+    not require a simultaneous deadlock to be detected (that is the
+    point: the A→B/B→A schedule that never collided in CI still
+    reports).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+ENV_FLAG = "TM_TPU_LOCKCHECK"
+
+
+class LockOrderError(AssertionError):
+    """Raised by check() when the acquisition graph contains a cycle."""
+
+
+class _Violation:
+    __slots__ = ("edge", "cycle")
+
+    def __init__(self, edge: tuple[str, str], cycle: list[str]):
+        self.edge = edge
+        self.cycle = cycle
+
+    def describe(self) -> str:
+        a, b = self.edge
+        return (f"lock-order inversion: acquiring {b} while holding {a} "
+                f"closes the cycle {' -> '.join(self.cycle)}")
+
+
+class LockChecker:
+    """Global acquisition-order graph over lock creation sites."""
+
+    def __init__(self):
+        # the checker's own mutex is a real (never-wrapped) lock and a
+        # leaf: it is never held while acquiring anything else
+        self._mtx = threading.Lock()
+        self._succ: dict[str, set[str]] = {}   # site -> directly-after sites
+        self._violations: list[_Violation] = []
+        self._tls = threading.local()
+        self._active = False
+        self._depth = 0                        # install() refcount
+        self._orig: tuple | None = None
+
+    # -- bookkeeping (called from _CheckedLock) -------------------------
+
+    def _held(self) -> list[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, site: str) -> None:
+        held = self._held()
+        if held and site not in held:
+            with self._mtx:
+                for h in held:
+                    self._add_edge(h, site)
+        held.append(site)
+
+    def note_release(self, site: str) -> None:
+        held = self._held()
+        # remove the innermost occurrence; tolerate unbalanced pairs
+        # from activation toggling mid-hold
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+    def _add_edge(self, a: str, b: str) -> None:
+        succ = self._succ.setdefault(a, set())
+        if b in succ:
+            return
+        cycle = self._find_path(b, a)          # does b already reach a?
+        succ.add(b)
+        if cycle is not None:
+            self._violations.append(_Violation((a, b), [a, b] + cycle[1:]))
+
+    def _find_path(self, src: str, dst: str) -> list[str] | None:
+        """DFS path src -> dst over recorded edges, or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._succ.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def install(self) -> None:
+        """Patch threading.Lock/RLock so new locks are order-checked.
+        Refcounted and idempotent; the first install resets state."""
+        with self._mtx:
+            self._depth += 1
+            if self._depth > 1:
+                return
+            self._succ = {}
+            self._violations = []
+            self._orig = (threading.Lock, threading.RLock)
+        orig_lock, orig_rlock = self._orig
+
+        def make_lock():
+            f = sys._getframe(1)
+            site = f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+            return _CheckedLock(orig_lock(), self, site)
+
+        def make_rlock():
+            f = sys._getframe(1)
+            site = f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+            return _CheckedLock(orig_rlock(), self, site)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        self._active = True
+
+    def uninstall(self) -> None:
+        with self._mtx:
+            if self._depth == 0:
+                return
+            self._depth -= 1
+            if self._depth:
+                return
+        self._active = False
+        if self._orig is not None:
+            threading.Lock, threading.RLock = self._orig
+            self._orig = None
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._succ = {}
+            self._violations = []
+
+    def violations(self) -> list[_Violation]:
+        with self._mtx:
+            return list(self._violations)
+
+    def check(self) -> None:
+        vs = self.violations()
+        if vs:
+            raise LockOrderError(
+                "; ".join(v.describe() for v in vs))
+
+
+class _CheckedLock:
+    """Order-recording wrapper over a real Lock/RLock.  Unknown
+    attributes (RLock's `_is_owned`/`_release_save`/`_acquire_restore`,
+    used by threading.Condition) forward to the wrapped lock."""
+
+    __slots__ = ("_inner", "_chk", "_site")
+
+    def __init__(self, inner, checker: LockChecker, site: str):
+        self._inner = inner
+        self._chk = checker
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and self._chk._active:
+            self._chk.note_acquire(self._site)
+        return ok
+
+    def release(self) -> None:
+        if self._chk._active:
+            self._chk.note_release(self._site)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<CheckedLock {self._site} over {self._inner!r}>"
+
+
+#: process-wide checker (one graph: cross-subsystem inversions are the
+#: interesting ones)
+CHECKER = LockChecker()
+
+install = CHECKER.install
+uninstall = CHECKER.uninstall
+reset = CHECKER.reset
+violations = CHECKER.violations
+check = CHECKER.check
+
+
+def maybe_install_from_env() -> bool:
+    """Install when TM_TPU_LOCKCHECK is set truthy; returns whether the
+    checker is installed.  Call early (conftest) — only locks created
+    afterwards are checked."""
+    if os.environ.get(ENV_FLAG, "0") not in ("", "0"):
+        install()
+        return True
+    return False

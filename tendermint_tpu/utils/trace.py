@@ -16,10 +16,16 @@ branch — `span()` returns a shared no-op singleton and `record()` /
 stay clean (the same rule node/metrics.py states for metrics).
 
 Env knobs:
-  TM_TPU_TRACE        1 enables tracing (default 0).  Read once at
-                      import; tests/benches flip it with set_enabled().
+  TM_TPU_TRACE        1 enables tracing (default 0).  Resolved lazily at
+                      the FIRST span site (not at import — tmlint
+                      import-time-env), so setting it after import still
+                      takes effect; tests/benches pin it with
+                      set_enabled(), long-lived CLIs re-read with
+                      reload_env().
   TM_TPU_TRACE_RING   ring-buffer capacity in spans (default 4096).
-                      Oldest spans are dropped first.
+                      Oldest spans are dropped first.  Applied when the
+                      enable flag first resolves true, or explicitly via
+                      set_ring_size()/reload_env().
 
 All timestamps come from time.perf_counter_ns() — perf_counter() floats
 handed to record() share the same clock origin, so externally measured
@@ -50,15 +56,31 @@ def _env_ring_size() -> int:
         return DEFAULT_RING_SIZE
 
 
-_enabled = os.environ.get(ENV_FLAG, "0") not in ("", "0")
+# None = not yet resolved from the environment: the first span site (or
+# enabled() call) reads TM_TPU_TRACE then, so env vars set after import
+# still take effect.  set_enabled()/reload_env() pin a real bool.
+_enabled: bool | None = None
 _lock = threading.Lock()
-_ring: deque = deque(maxlen=_env_ring_size())
+_ring: deque = deque(maxlen=DEFAULT_RING_SIZE)
 _ids = itertools.count(1)
 _tls = threading.local()
 
 
-def enabled() -> bool:
+def _resolve_enabled() -> bool:
+    global _enabled
+    _enabled = os.environ.get(ENV_FLAG, "0") not in ("", "0")
+    if _enabled:
+        # size the ring from the env only when tracing actually turns
+        # on; an explicit earlier set_ring_size() is preserved when
+        # TM_TPU_TRACE_RING is unset (deque keeps the default otherwise)
+        if os.environ.get(ENV_RING):
+            set_ring_size(_env_ring_size())
     return _enabled
+
+
+def enabled() -> bool:
+    en = _enabled
+    return en if en is not None else _resolve_enabled()
 
 
 def set_enabled(on: bool) -> None:
@@ -70,6 +92,11 @@ def refresh_from_env() -> None:
     """Re-read TM_TPU_TRACE / TM_TPU_TRACE_RING (tests, long-lived CLIs)."""
     set_enabled(os.environ.get(ENV_FLAG, "0") not in ("", "0"))
     set_ring_size(_env_ring_size())
+
+
+#: the lazy-env contract name shared by trace / crypto.batch /
+#: ops.fe25519_f32 (docs/linting.md, import-time-env)
+reload_env = refresh_from_env
 
 
 def set_ring_size(n: int) -> None:
@@ -153,7 +180,8 @@ _NOP_SPAN = _NopSpan()
 def span(name: str, **attrs) -> "_SpanCtx | _NopSpan":
     """Context manager measuring the enclosed block.  Disabled tracing
     returns a shared no-op singleton: one branch, zero allocation."""
-    if not _enabled:
+    en = _enabled
+    if not (en if en is not None else _resolve_enabled()):
         return _NOP_SPAN
     return _SpanCtx(name, attrs)
 
@@ -163,7 +191,8 @@ def record(name: str, t0: float, dur: float, **attrs) -> None:
     seconds on the time.perf_counter() clock.  For work whose start and
     end live on different threads (device enqueue → verdict drain) or
     whose duration was measured on another monotonic clock."""
-    if not _enabled:
+    en = _enabled
+    if not (en if en is not None else _resolve_enabled()):
         return
     _append(name, next(_ids), None, int(t0 * 1e9), max(0, int(dur * 1e9)),
             attrs)
@@ -171,7 +200,8 @@ def record(name: str, t0: float, dur: float, **attrs) -> None:
 
 def instant(name: str, **attrs) -> None:
     """Zero-duration marker (height/round transitions and the like)."""
-    if not _enabled:
+    en = _enabled
+    if not (en if en is not None else _resolve_enabled()):
         return
     _append(name, next(_ids), None, time.perf_counter_ns(), 0, attrs)
 

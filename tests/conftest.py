@@ -26,3 +26,11 @@ jax.config.update("jax_platforms", _platform)
 from tendermint_tpu.utils import jaxcache  # noqa: E402
 
 jaxcache.enable(jax)
+
+# opt-in runtime lock-order checking for the whole suite: set
+# TM_TPU_LOCKCHECK=1 and every threading.Lock/RLock created from here
+# on is order-checked (utils/lockcheck; the async-verify and multinode
+# modules install it per-test regardless).
+from tendermint_tpu.utils import lockcheck  # noqa: E402
+
+lockcheck.maybe_install_from_env()

@@ -1,0 +1,24 @@
+"""Fixture: wallclock-in-consensus — this file lives under a
+consensus/ directory, so wall clocks and unseeded entropy are flagged."""
+
+import random
+import time
+
+
+def step_timing():
+    t0 = time.time()  # LINT: wallclock-in-consensus
+    t1 = time.time_ns()  # LINT: wallclock-in-consensus
+    jitter = random.random()  # LINT: wallclock-in-consensus
+    rng = random.Random()  # LINT: wallclock-in-consensus
+    return t0, t1, jitter, rng
+
+
+def deterministic_timing():
+    t0 = time.monotonic()
+    t1 = time.perf_counter_ns()
+    rng = random.Random(42)  # seeded: reproducible
+    return t0, t1, rng.random()
+
+
+def journal_stamp():
+    return time.time_ns()  # tmlint: disable=wallclock-in-consensus

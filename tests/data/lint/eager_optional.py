@@ -1,0 +1,28 @@
+"""Fixture: eager-optional-import — positives, suppressed, and the
+sanctioned gated/deferred patterns."""
+
+from typing import TYPE_CHECKING
+
+import cryptography  # LINT: eager-optional-import
+
+from grpc import aio  # LINT: eager-optional-import
+
+import hypothesis.strategies  # LINT: eager-optional-import
+
+import jax  # LINT: eager-optional-import
+
+import tomllib  # tmlint: disable=eager-optional-import
+
+try:
+    import grpc
+except ImportError:  # gated: raises at point of use instead
+    grpc = None
+
+if TYPE_CHECKING:
+    import cryptography.hazmat  # annotations only — never executed
+
+
+def point_of_use():
+    import tomli  # deferred: pays the cost only when actually needed
+
+    return tomli

@@ -1,0 +1,49 @@
+"""Fixture: host-sync-in-jit — host syncs inside functions handed to
+jax.jit (by call, decorator, and partial), against clean device code."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated(x):
+    return x.item()  # LINT: host-sync-in-jit
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def partial_decorated(n, x):
+    host = np.asarray(x)  # LINT: host-sync-in-jit
+    return host[:n]
+
+
+def wrapped_core(x):
+    x.block_until_ready()  # LINT: host-sync-in-jit
+    y = jax.device_get(x)  # LINT: host-sync-in-jit
+    rows = x.tolist()  # LINT: host-sync-in-jit
+    return y, rows
+
+
+_compiled = jax.jit(wrapped_core)
+
+
+def clean_core(x):
+    # jnp.asarray is a device op, .sum() is traced: no findings
+    return jnp.asarray(x).sum()
+
+
+_compiled_clean = jax.jit(clean_core)
+
+
+def suppressed_core(x):
+    return x.item()  # tmlint: disable=host-sync-in-jit
+
+
+_compiled_suppressed = jax.jit(suppressed_core)
+
+
+def host_helper(x):
+    # NOT jit-compiled: host syncs are fine here
+    return np.asarray(x).item()

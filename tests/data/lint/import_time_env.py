@@ -1,0 +1,34 @@
+"""Fixture: import-time-env — positives, a suppressed site, and clean
+runtime-scoped reads.  `# LINT: <rule>` marks lines tests expect
+reported."""
+
+import os
+
+MODE = os.environ.get("TM_FIXTURE_MODE", "auto")  # LINT: import-time-env
+
+RAW = os.environ["TM_FIXTURE_RAW"]  # LINT: import-time-env
+
+HAS = "TM_FIXTURE_FLAG" in os.environ  # LINT: import-time-env
+
+VIA_GETENV = os.getenv("TM_FIXTURE_G")  # LINT: import-time-env
+
+
+class Config:
+    # class bodies execute at import
+    default = os.environ.get("TM_FIXTURE_CLS")  # LINT: import-time-env
+
+
+def defaulted(value=os.environ.get("TM_FIXTURE_DEF")):  # LINT: import-time-env
+    return value
+
+
+SUPPRESSED = os.environ.get("TM_FIXTURE_OK")  # tmlint: disable=import-time-env
+
+# writes are not reads: seeding the environment at import is a
+# different (allowed) pattern
+os.environ["TM_FIXTURE_SET"] = "1"
+
+
+def runtime_read():
+    # point-of-use resolution: the fix the rule demands
+    return os.environ.get("TM_FIXTURE_MODE", "auto")

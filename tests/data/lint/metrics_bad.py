@@ -1,0 +1,50 @@
+"""Fixture: metric-name-conformance — miskinded names, duplicate
+registrations, high-cardinality labels."""
+
+from tendermint_tpu.utils.metrics import (
+    CallbackCounter,
+    Counter,
+    Gauge,
+    Histogram,
+    LabeledCallbackGauge,
+)
+
+BAD_COUNTER = Counter(  # LINT: metric-name-conformance
+    "requests", "Counter without the _total suffix",
+    namespace="tm", subsystem="fixture")
+
+BAD_CB_COUNTER = CallbackCounter(  # LINT: metric-name-conformance
+    "flushes_count", "CallbackCounter without _total",
+    namespace="tm", subsystem="fixture", fn=lambda: 0)
+
+BAD_KIND_GAUGE = LabeledCallbackGauge(  # LINT: metric-name-conformance
+    "events", "kind=counter without _total",
+    namespace="tm", subsystem="fixture", kind="counter", fn=lambda: [])
+
+BAD_GAUGE = Gauge(  # LINT: metric-name-conformance
+    "queue_depth_total", "Gauge masquerading as a counter",
+    namespace="tm", subsystem="fixture")
+
+BAD_HIST = Histogram(  # LINT: metric-name-conformance
+    "latency_bucket", "Histogram colliding with generated suffixes",
+    namespace="tm", subsystem="fixture")
+
+BAD_LABELS = Counter(  # LINT: metric-name-conformance
+    "blocks_total", "Unbounded label cardinality",
+    namespace="tm", subsystem="fixture", label_names=("height", "rung"))
+
+FIRST = Counter(
+    "dup_total", "First registration wins",
+    namespace="tm", subsystem="fixture")
+
+SECOND = Counter(  # LINT: metric-name-conformance
+    "dup_total", "Duplicate registration",
+    namespace="tm", subsystem="fixture")
+
+SUPPRESSED = Counter(  # tmlint: disable=metric-name-conformance
+    "legacy_txs", "Upstream-parity name kept for dashboards",
+    namespace="tm", subsystem="fixture")
+
+CLEAN = Counter(
+    "verifies_total", "Well-formed counter",
+    namespace="tm", subsystem="fixture", label_names=("rung",))

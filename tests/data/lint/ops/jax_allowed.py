@@ -1,0 +1,10 @@
+"""Fixture: eager-optional-import allowlist — modules under an ops/
+(or parallel/) directory are device modules and may import jax
+eagerly.  Expect ZERO findings."""
+
+import jax
+import jax.numpy as jnp
+
+
+def double(x):
+    return jnp.add(x, x)

@@ -1,0 +1,34 @@
+"""Fixture: ungated-observability — sink calls without the one-branch
+`.enabled` guard, plus the sanctioned guard shapes."""
+
+from tendermint_tpu.utils import devmon
+
+
+class Site:
+    def __init__(self, journal):
+        self.journal = journal
+        self.replay_mode = False
+
+    def flush_ungated(self, n, rung):
+        devmon.STATS.record_flush("verify", n, rung)  # LINT: ungated-observability
+
+    def journal_ungated(self, h):
+        self.journal.log("step", h=h)  # LINT: ungated-observability
+
+    def flush_gated(self, n, rung):
+        if devmon.STATS.enabled:
+            devmon.STATS.record_flush("verify", n, rung)
+
+    def journal_gated(self, h):
+        if self.journal.enabled and not self.replay_mode:
+            self.journal.log("step", h=h)
+
+    def flush_early_exit(self, n, rung):
+        if not devmon.STATS.enabled:
+            return
+        devmon.STATS.record_flush("verify", n, rung)
+
+    def flush_suppressed(self, n, rung):
+        # caller holds the guard (helper shared between gated sites)
+        # tmlint: disable=ungated-observability
+        devmon.STATS.record_flush("verify", n, rung)

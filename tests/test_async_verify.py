@@ -30,6 +30,24 @@ def _triples(n, bad=(), tag=b"async"):
     return items, want
 
 
+@pytest.fixture(autouse=True)
+def lock_order_checked():
+    """Every test in this module runs under the runtime lock-order
+    checker (utils/lockcheck): the service's queue/cache/service-lock
+    interleavings are exactly where an inversion would hide, and the
+    PR 1 `_MEASURE_LOCK`/`_FLAG_LOCK` contention was found by hand.
+    The singleton is recreated per test (reset_service/clear_service),
+    which is what brings its locks into the checker's scope."""
+    from tendermint_tpu.utils import lockcheck
+
+    lockcheck.install()
+    try:
+        yield
+        lockcheck.check()
+    finally:
+        lockcheck.uninstall()
+
+
 @pytest.fixture
 def svc():
     s = av.reset_service(linger_ms=1.0)

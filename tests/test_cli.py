@@ -69,7 +69,14 @@ def test_show_commands_and_reset(tmp_path, capsys):
 def test_testnet_generation(tmp_path):
     out = str(tmp_path / "net")
     assert run_cli("testnet", "--v", "3", "--o", out, "--chain-id", "net-x") == 0
-    import tomllib
+    # the minimal container runs py3.10 without stdlib tomllib; take the
+    # same backport fallback config.py uses, or skip cleanly if neither
+    # exists (generation itself is already asserted above)
+    try:
+        import tomllib
+    except ModuleNotFoundError:
+        tomllib = pytest.importorskip(
+            "tomli", reason="neither tomllib (py3.11+) nor tomli installed")
 
     genesis_docs = []
     for i in range(3):

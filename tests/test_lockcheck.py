@@ -1,0 +1,193 @@
+"""utils/lockcheck: the runtime lock-order checker.
+
+The headline scenario is the one the checker exists for: thread 1 takes
+A then B, thread 2 takes B then A — a latent deadlock that only bites
+under an unlucky schedule.  The checker must report it from the orders
+alone, without the schedules ever colliding.
+"""
+
+import threading
+
+import pytest
+
+from tendermint_tpu.utils import lockcheck
+
+
+@pytest.fixture()
+def checker():
+    lockcheck.install()
+    try:
+        yield lockcheck.CHECKER
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+def _run(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_inversion_across_two_threads_is_detected(checker):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def thread_one():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def thread_two():
+        with lock_b:
+            with lock_a:
+                pass
+
+    _run(thread_one)   # records A -> B
+    assert checker.violations() == []
+    _run(thread_two)   # records B -> A: cycle
+    vs = checker.violations()
+    assert len(vs) == 1
+    with pytest.raises(lockcheck.LockOrderError, match="inversion"):
+        checker.check()
+
+
+def test_consistent_order_is_clean(checker):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    for _ in range(3):
+        def ordered():
+            with lock_a:
+                with lock_b:
+                    pass
+        _run(ordered)
+    checker.check()
+
+
+def test_three_lock_cycle_is_detected(checker):
+    # one creation site per lock: sites are keyed by file:line
+    la = threading.Lock()
+    lb = threading.Lock()
+    lc = threading.Lock()
+
+    def ab():
+        with la, lb:
+            pass
+
+    def bc():
+        with lb, lc:
+            pass
+
+    def ca():
+        with lc, la:
+            pass
+
+    _run(ab)
+    _run(bc)
+    assert checker.violations() == []
+    _run(ca)   # closes A -> B -> C -> A
+    vs = checker.violations()
+    assert len(vs) == 1
+    assert len(vs[0].cycle) >= 3
+
+
+def test_rlock_reentrancy_no_false_positive(checker):
+    rl = threading.RLock()
+    with rl:
+        with rl:     # same site re-entered: no self-edge
+            pass
+    checker.check()
+
+
+def test_condition_over_checked_lock_works(checker):
+    # async_verify's worker loop uses threading.Condition(); the wrapper
+    # must forward the RLock protocol Condition relies on
+    cv = threading.Condition()
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hits.append("woke")
+
+    assert hasattr(cv._lock, "_is_owned")  # RLock protocol forwarded
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cv:
+        cv.notify_all()
+    t.join(timeout=10)
+    assert hits == ["woke"]
+    checker.check()
+
+
+def test_sites_are_stable_across_instances(checker):
+    # two locks born on the SAME line are one site: instance churn must
+    # not wash the graph out
+    def make():
+        return threading.Lock()
+
+    l1, l2 = make(), make()
+    with l1:
+        pass
+    with l2:
+        pass
+    assert len(checker._succ) <= 1  # no edges, at most the empty entry
+
+
+def test_uninstall_restores_factories():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    lockcheck.install()
+    assert threading.Lock is not orig_lock
+    lockcheck.uninstall()
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+
+
+def test_install_is_refcounted():
+    orig_lock = threading.Lock
+    lockcheck.install()
+    lockcheck.install()
+    lockcheck.uninstall()
+    assert threading.Lock is not orig_lock   # still installed
+    lockcheck.uninstall()
+    assert threading.Lock is orig_lock
+
+
+def test_maybe_install_from_env(monkeypatch):
+    monkeypatch.setenv("TM_TPU_LOCKCHECK", "0")
+    assert lockcheck.maybe_install_from_env() is False
+    monkeypatch.setenv("TM_TPU_LOCKCHECK", "1")
+    assert lockcheck.maybe_install_from_env() is True
+    lockcheck.uninstall()
+
+
+def test_async_verify_service_runs_clean_under_checker(checker):
+    # drive the real coalescing service (cpu path) with the checker
+    # installed: submit from several threads so the queue/cache/service
+    # locks interleave, then assert the acquisition graph is acyclic
+    from tendermint_tpu.crypto import async_verify
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+
+    async_verify.clear_service()
+    try:
+        k = priv_key_from_seed(b"\x11" * 32)
+        pub = k.pub_key().bytes_()
+        msgs = [b"lockcheck-%d" % i for i in range(24)]
+        sigs = [k.sign(m) for m in msgs]
+
+        def submit(lo, hi):
+            oks = async_verify.verify_many(
+                list(zip([pub] * (hi - lo), msgs[lo:hi], sigs[lo:hi])))
+            assert all(oks)
+
+        threads = [threading.Thread(target=submit, args=(i * 8, (i + 1) * 8))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        checker.check()
+    finally:
+        async_verify.clear_service()

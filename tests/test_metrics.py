@@ -158,7 +158,7 @@ def test_exposition_conformance():
 def test_per_peer_series_in_metrics_and_net_info(tmp_path):
     """ISSUE 3 acceptance (p2p leg): with a live peer connected, the
     per-peer byte series appear in /metrics with correct peer_id/chID
-    labels, message_receive_count carries concrete message types,
+    labels, message_receive_count_total carries concrete message types,
     net_info exposes the per-peer connection_status snapshot, and
     dump_consensus_state includes the reactor's peer round state."""
     from tendermint_tpu.node.node_key import load_or_gen_node_key
@@ -231,10 +231,10 @@ def test_per_peer_series_in_metrics_and_net_info(tmp_path):
             assert "0x20" in recv_chs, recv_chs
             # message-type counters carry concrete types on both sides
             mr = {lbl["message_type"]: v for lbl, v in
-                  by_name.get("tendermint_p2p_message_receive_count", [])}
+                  by_name.get("tendermint_p2p_message_receive_count_total", [])}
             assert mr.get("NewRoundStepMessage", 0) > 0, mr
             ms = {lbl["message_type"]: v for lbl, v in
-                  by_name.get("tendermint_p2p_message_send_count", [])}
+                  by_name.get("tendermint_p2p_message_send_count_total", [])}
             assert ms.get("VoteMessage", 0) > 0, ms
             assert _types["tendermint_p2p_peer_receive_bytes_total"] == "counter"
             assert by_name.get("tendermint_p2p_peers_connected_total") == [({}, 1.0)]

@@ -63,6 +63,21 @@ def isolated_device_path_state():
     cbatch._WARMUP_STARTED = False
 
 
+@pytest.fixture(autouse=True)
+def lock_order_checked():
+    """Multinode runs exercise the verify service, devmon and the
+    stores from several threads at once — run them under the runtime
+    lock-order checker (utils/lockcheck) and fail on any inversion."""
+    from tendermint_tpu.utils import lockcheck
+
+    lockcheck.install()
+    try:
+        yield
+        lockcheck.check()
+    finally:
+        lockcheck.uninstall()
+
+
 class _PV:
     """In-memory privval (no double-sign file state; tests only)."""
 

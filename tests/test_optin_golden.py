@@ -18,6 +18,14 @@ import pytest
 from tendermint_tpu.crypto.keys import priv_key_from_seed
 from tendermint_tpu.ops import ed25519_jax as dev
 
+# Every test here traces fresh XLA programs (the clean_optin fixture
+# clears the compiled-program caches on purpose), and this image routes
+# compiles through a ~100 s/program remote relay: the module regularly
+# blows the tier-1 870 s budget.  Mark it slow, consistent with the
+# tier-1 `-m 'not slow'` filter; run explicitly with `-m slow` on a box
+# with a local XLA (or a warm persistent cache).
+pytestmark = pytest.mark.slow
+
 
 def _small_batch(n=8, bad=(2,)):
     pubs, msgs, sigs, want = [], [], [], []
