@@ -265,6 +265,73 @@ def main() -> None:
         # path that carries the headline
         headline_pairs: list = []
 
+        # -- simnet under adversity (round 6, ISSUE 6): a fixed-seed
+        # 20-node in-process net with a partition+heal, a slow-link
+        # phase, a fail-point crash-restart (WAL replay) and one
+        # equivocating maverick — the "bounded degradation" BENCH
+        # metrics: accepted-tx/s under faults, heights/min, the longest
+        # consecutive rounds>0 streak, and recovery time after heal.
+        # Runs BEFORE the device stages: in BENCH_r05 the watchdog fired
+        # mid-RLC and every later stage never landed, so a tail position
+        # would silently drop these keys.  Budgeted: the scenario's own
+        # max_runtime is capped so the device stages keep >=300s, and
+        # the stage skips outright when too little is left.
+        _stage_set("simnet")
+        try:
+            # measured 80s on one CPU core; 150s cap absorbs noise while
+            # the device stages keep >=280s of the watchdog budget
+            budget = min(150.0, _deadline_left() - 280.0)
+            if budget < 90:
+                raise RuntimeError("skipped: %.0fs left" % _deadline_left())
+            import tempfile
+
+            from tendermint_tpu.simnet.harness import run_scenario
+            from tendermint_tpu.simnet.scenario import FaultOp, Scenario
+
+            sim_sc = Scenario(
+                name="bench-20", seed=601, validators=20,
+                validator_slots=200, target_height=4,
+                max_runtime_s=budget,
+                load_rate=20, gossip_sleep_ms=50, timeout_scale=6.0,
+                mesh_degree=6, max_rounds=12, stall_factor=0.0,
+                mavericks={"9": {"3": "double-prevote"}},
+                faults=[
+                    FaultOp(op="partition", at_height=1, nodes=[17, 18, 19]),
+                    FaultOp(op="heal", at_height=2),
+                    FaultOp(op="slow", at_height=2, nodes=[2, 3],
+                            latency_ms=40, jitter_ms=20),
+                    FaultOp(op="clear", at_height=3),
+                    FaultOp(op="crash", at_height=2, nodes=[5],
+                            restart_after_s=1.0,
+                            fail_label="commit-after-save"),
+                ],
+            )
+            with tempfile.TemporaryDirectory() as td:
+                rep = run_scenario(sim_sc, td)
+            _partial.update({
+                "simnet_ok": rep["ok"],
+                "simnet_violations": [v["invariant"]
+                                      for v in rep["violations"]],
+                "simnet_nodes": sim_sc.validators,
+                "simnet_validator_slots": sim_sc.total_slots(),
+                "simnet_duration_s": rep["duration_s"],
+                "simnet_min_honest_height": rep["heights"]["min_honest"],
+                "simnet_heights_per_min": rep["heights"]["per_min"],
+                "simnet_accepted_tx_per_s": rep["load"]["accepted_tx_per_s"],
+                "simnet_offered_tx": rep["load"]["offered_tx"],
+                "simnet_accepted_tx": rep["load"]["accepted_tx"],
+                "simnet_max_round": rep["rounds"]["max_round"],
+                "simnet_max_consecutive_rounds_gt0":
+                    rep["rounds"]["max_consecutive_gt0"],
+                "simnet_max_recovery_s": rep["recovery"]["max_recovery_s"],
+                "simnet_restarts": rep["restarts"],
+                "simnet_wal_replays": rep["wal_replays"],
+                "simnet_frames_dropped": rep["network"]["frames_dropped"],
+                "simnet_evidence_committed": rep["evidence"]["committed"],
+            })
+        except Exception as e:  # noqa: BLE001
+            _partial["simnet_error"] = str(e)[-300:]
+
         if platform == "cpu":
             _stage_set("timed-production-cpu")
             from tendermint_tpu.crypto.batch import new_batch_verifier

@@ -777,6 +777,55 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_simnet(args) -> int:
+    """Fault-injecting in-process scenario run (tendermint_tpu/simnet):
+    stand up the scenario's node count over the FaultyNetwork, apply the
+    fault schedule (partitions, slow links, churn with WAL replay,
+    mavericks), and emit the analyzer-computed verdict as JSON.  Exit 0
+    when every invariant held, 1 with the violated invariant named in
+    `violations` otherwise (docs/simnet.md)."""
+    import tempfile
+
+    from tendermint_tpu.simnet.harness import run_scenario
+    from tendermint_tpu.simnet.scenario import (
+        generate_scenario,
+        load_scenario,
+    )
+    from tendermint_tpu.utils.log import new_logger, nop_logger
+
+    if bool(args.scenario) == (args.gen_seed is not None):
+        print("simnet: exactly one of --scenario or --gen-seed required",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.scenario:
+            scenario = load_scenario(args.scenario)
+        else:
+            scenario = generate_scenario(args.gen_seed, args.gen_index)
+    except (OSError, ValueError, ImportError) as e:
+        print(f"simnet: cannot load scenario: {e}", file=sys.stderr)
+        return 2
+
+    logger = new_logger("tendermint_tpu.simnet") if args.verbose else nop_logger()
+    root = args.root or tempfile.mkdtemp(prefix=f"simnet-{scenario.name}-")
+    report = run_scenario(scenario, root, logger=logger)
+    if not args.full:
+        # the full timeline is bulky; keep the default report focused on
+        # the verdict (--full restores it, and the journals stay under
+        # --root for `tendermint-tpu timeline` post-mortems)
+        report.pop("timeline", None)
+    text = json.dumps(report, indent=2, default=str)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    print(text)
+    if not args.root and not args.keep:
+        shutil.rmtree(root, ignore_errors=True)
+    else:
+        print(f"# node homes (journals, WALs): {root}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def cmd_top(args) -> int:
     """Live ANSI dashboard over a node's RPC status + /metrics: consensus
     progress, peers + send queues, verify queue/occupancy/cache, jit
@@ -886,6 +935,31 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="emit the merged report as JSON")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "simnet",
+        help="run a fault-injection scenario on an in-process net and "
+             "emit the analyzer verdict (exit 0 = all invariants held)")
+    sp.add_argument("--scenario", default="",
+                    help="scenario file (.toml or .json; docs/simnet.md)")
+    sp.add_argument("--gen-seed", dest="gen_seed", type=int, default=None,
+                    help="generator mode: derive the scenario from this "
+                         "seed instead of a file")
+    sp.add_argument("--gen-index", dest="gen_index", type=int, default=0,
+                    help="generator mode: scenario index within the seed's "
+                         "sweep (default 0)")
+    sp.add_argument("--root", default="",
+                    help="directory for node homes (default: a temp dir, "
+                         "removed unless --keep)")
+    sp.add_argument("--out", default="",
+                    help="also write the JSON report to this file")
+    sp.add_argument("--full", action="store_true",
+                    help="include the merged timeline in the report")
+    sp.add_argument("--keep", action="store_true",
+                    help="keep the temp node homes for post-mortems")
+    sp.add_argument("--verbose", action="store_true",
+                    help="log node/harness events to stderr")
+    sp.set_defaults(fn=cmd_simnet)
 
     sp = sub.add_parser("top", help="live dashboard for one node "
                                     "(RPC status + /metrics)")

@@ -102,6 +102,8 @@ class ConsensusReactor:
         self.logger = logger or nop_logger()
         self.gossip_sleep = gossip_sleep_ms / 1000.0
         self.maj23_sleep = maj23_sleep_ms / 1000.0
+        self._nvals_cache: dict[int, int] = {}
+        self._commit_cache: dict[int, "Commit"] = {}
         self.peers: dict[str, PeerState] = {}
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
         self._tasks: list[asyncio.Task] = []
@@ -237,15 +239,41 @@ class ConsensusReactor:
         one height ahead — the byzantine-wedge shape, where the advanced
         pair can't produce block H+1 precisely because the lagging pair
         is stuck at H — can never advertise the commit's maj23 or serve
-        catchup commits, and the wedge is permanent."""
+        catchup commits, and the wedge is permanent.
+
+        Below-tip commits are canonical (immutable) and every lagging
+        peer's gossip loop reloads them each tick, so cache those; the
+        tip's seen-commit can still be superseded and is never cached."""
+        if height < self.block_store.height():
+            commit = self._commit_cache.get(height)
+            if commit is None:
+                commit = self.block_store.load_block_commit(height)
+                if commit is not None:
+                    self._commit_cache[height] = commit
+                    if len(self._commit_cache) > 16:
+                        for h in sorted(self._commit_cache)[:8]:
+                            del self._commit_cache[h]
+            return commit
         return self.block_store.load_commit(height)
 
     def _nvals(self, height: int) -> int:
         rs = self.cs.rs
         if rs.validators is not None and height == rs.height:
             return rs.validators.size()
+        # off-current heights hit this on EVERY catchup gossip message;
+        # a stored height's set is immutable, so cache the size (the
+        # uncached decode was ~15% of a 20-node simnet's CPU)
+        n = self._nvals_cache.get(height)
+        if n is not None:
+            return n
         vals = self.cs.block_exec.store.load_validators(height)
-        return vals.size() if vals is not None else 0
+        n = vals.size() if vals is not None else 0
+        if n:
+            self._nvals_cache[height] = n
+            if len(self._nvals_cache) > 64:
+                for h in sorted(self._nvals_cache)[:32]:
+                    del self._nvals_cache[h]
+        return n
 
     async def _recv_state(self) -> None:
         while True:
@@ -450,8 +478,15 @@ class ConsensusReactor:
         # advance height/round (nulling rs.proposal) while the send is
         # parked — re-reading rs.proposal after the await crashed this
         # task with a None deref (seed-42 sweep logs).
+        # Round must match too (reference reactor.go:536 sleeps unless
+        # height AND round align): a proposal is per (height, round), so
+        # a round-mismatched peer rejects it, its next NewRoundStep
+        # clears prs.proposal, and the pair loops send→reject→resend —
+        # at 20 nodes mid round-churn that flood (4.5k proposals/s, each
+        # sig-verified on receive) starved the net into a stall.
         proposal = rs.proposal
-        if rs.height == prs.height and proposal is not None and not prs.proposal:
+        if rs.height == prs.height and rs.round == prs.round \
+                and proposal is not None and not prs.proposal:
             pol = None
             if proposal.pol_round >= 0 and rs.votes is not None:
                 prevotes = rs.votes.prevotes(proposal.pol_round)

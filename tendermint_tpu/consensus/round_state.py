@@ -68,6 +68,12 @@ class HeightVoteSet:
         rvs = self._round_vote_sets.get(round_)
         return rvs[t] if rvs else None
 
+    def has_exact(self, vote) -> bool:
+        """True if this exact vote is already admitted in its round's
+        set (pre-crypto gossip-duplicate probe; VoteSet.has_exact)."""
+        vs = self._get(vote.round, vote.type)
+        return vs is not None and vs.has_exact(vote)
+
     def add_vote(self, vote, peer_id: str = "") -> bool:
         """Admit a vote; unexpected rounds from peers are allowed for at
         most 2 catchup rounds per peer (DoS bound)."""

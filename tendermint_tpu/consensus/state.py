@@ -205,7 +205,7 @@ class ConsensusState:
                         # own votes/proposals must hit disk before dispatch
                         # (crash ⇒ no double-sign; reference state.go:741-751)
                         self.wal.write_sync(item)
-                        fail_point()  # reference state.go:747 (own msg fsynced)
+                        fail_point("own-msg-fsynced")  # reference state.go:747 (own msg fsynced)
                         # errors here (e.g. a locally built oversized
                         # proposal) fall through to the outer log-and-
                         # continue handler — same containment as the peer
@@ -277,6 +277,16 @@ class ConsensusState:
                 continue
             val = vals.get_by_index(v.validator_index)
             if val is None or val.address != v.validator_address:
+                continue
+            # gossip floods re-deliver admitted votes (every peer relays
+            # until it sees our HasVote): skip their crypto here —
+            # add_vote's duplicate check drops them without verifying.
+            # Without this, a 20-node simnet burned ~47x the necessary
+            # signature verifications and starved the event loop.
+            if v.height == rs.height:
+                if rs.votes.has_exact(v):
+                    continue
+            elif rs.last_commit is not None and rs.last_commit.has_exact(v):
                 continue
             jobs.append((v, val.pub_key))
         if len(jobs) < 2:
@@ -828,21 +838,21 @@ class ConsensusState:
         # from here on, failure is a safety violation: +2/3 precommitted
         # this block, so an error storing/applying it must halt the node
         try:
-            fail_point()  # reference state.go:1524 (before save)
+            fail_point("commit-before-save")  # reference state.go:1524 (before save)
             if self.block_store.height() < block.header.height:
                 seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
                 self.block_store.save_block(block, block_parts, seen_commit)
-            fail_point()  # reference state.go:1538 (saved, before WAL barrier)
+            fail_point("commit-after-save")  # reference state.go:1538 (saved, before WAL barrier)
 
             # crash barrier: replay resumes AFTER this record (reference
             # state.go:1540-1557)
             self.wal.write_sync(EndHeightMessage(height))
-            fail_point()  # reference state.go:1559 (barrier written, before apply)
+            fail_point("commit-after-barrier")  # reference state.go:1559 (barrier written, before apply)
 
             state_copy, retain_height = self.block_exec.apply_block(
                 self.state.copy(), block_id, block
             )
-            fail_point()  # reference state.go:1577 (applied, before state save/advance)
+            fail_point("commit-after-apply")  # reference state.go:1577 (applied, before state save/advance)
         except ConsensusFailureError:
             raise
         except Exception as e:

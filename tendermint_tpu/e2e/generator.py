@@ -119,3 +119,14 @@ def generate(seed: int, n: int = 8) -> list[dict]:
     """Reproducible manifest list for a nightly sweep."""
     rng = random.Random(seed)
     return [generate_manifest(rng, i) for i in range(n)]
+
+
+def generate_simnet(seed: int, n: int = 4):
+    """Simnet mode: reproducible in-process fault scenarios instead of
+    subprocess manifests — same seeded-exploration contract, but the
+    dimensions are the fault menu (partitions, slow links, drops,
+    crash-restart with WAL replay, mavericks) over 8-24 node nets with
+    up to thousands of validator slots (simnet/scenario.py)."""
+    from tendermint_tpu.simnet.scenario import generate as _generate
+
+    return _generate(seed, n)

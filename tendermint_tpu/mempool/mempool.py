@@ -308,3 +308,10 @@ class Mempool:
     # -- gossip iteration --------------------------------------------------
     def entries(self) -> list[MempoolTx]:
         return list(self._txs.values())
+
+    def entries_with_keys(self) -> list[tuple[bytes, MempoolTx]]:
+        """Pool walk with the sha256 keys the pool already maintains.
+        Gossip loops rescan the pool every tick per peer; recomputing
+        the hash per entry per pass made a stalled pool O(pool^2·peers)
+        in sha256 alone — the stall then deepened itself."""
+        return list(self._txs.items())

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import asyncio
 
-from tendermint_tpu.crypto.tmhash import sum_sha256
 from tendermint_tpu.p2p import ChannelDescriptor, Envelope, PeerStatus
 from tendermint_tpu.utils.log import Logger, nop_logger
 from tendermint_tpu.wire.proto import guard_decode, ProtoWriter, fields_to_dict
@@ -36,11 +35,20 @@ def decode_txs(data: bytes) -> list[bytes]:
 class MempoolReactor:
     def __init__(self, mempool: Mempool, router, logger: Logger | None = None,
                  gossip_sleep_ms: int = 100, broadcast: bool = True,
-                 peer_height=None):
+                 peer_height=None, batch_txs: int = 1):
         self.mempool = mempool
         self.router = router
         self.logger = logger or nop_logger()
         self.gossip_sleep = gossip_sleep_ms / 1000.0
+        # txs per gossip message.  1 = reference parity (one tx per
+        # message, reactor.go:244-245).  The wire format is a tx LIST
+        # either way, so receivers are agnostic.  Raise for in-process
+        # nets (simnet): every connection is one FIFO shared by all
+        # channels, and per-tx frames queue hundreds deep ahead of
+        # proposal parts — the backlog delays proposals past
+        # timeout_propose and the net churns nil rounds while the pool
+        # (and the backlog) grows.
+        self.batch_txs = max(1, batch_txs)
         # reference config.Mempool.Broadcast: false = accept txs but never
         # gossip them (reactor.go:129 "Tx broadcasting is disabled")
         self.broadcast = broadcast
@@ -104,8 +112,8 @@ class MempoolReactor:
         try:
             while True:
                 advanced = False
-                for memtx in self.mempool.entries():
-                    key = sum_sha256(memtx.tx)
+                pending: list[bytes] = []
+                for key, memtx in self.mempool.entries_with_keys():
                     if key in sent:
                         continue
                     if self.peer_height is not None:
@@ -134,12 +142,18 @@ class MempoolReactor:
                     advanced = True
                     if node_id in memtx.senders:
                         continue  # peer gave us this tx
-                    await self.ch.send(Envelope(message=[memtx.tx], to=node_id))
+                    pending.append(memtx.tx)
+                    if len(pending) >= self.batch_txs:
+                        await self.ch.send(
+                            Envelope(message=pending, to=node_id))
+                        pending = []
+                if pending:
+                    await self.ch.send(Envelope(message=pending, to=node_id))
                 if not advanced:
                     await asyncio.sleep(self.gossip_sleep)
                     # bound the dedup set: drop hashes no longer in the pool
                     if len(sent) > 4 * max(1, self.mempool.size()):
-                        live = {sum_sha256(m.tx) for m in self.mempool.entries()}
+                        live = {k for k, _ in self.mempool.entries_with_keys()}
                         sent &= live
         except asyncio.CancelledError:
             return

@@ -215,9 +215,14 @@ class Node:
         self.p2p_addr: tuple[str, int] | None = None
         self._dialer_task: asyncio.Task | None = None
         # persistent-peer dial state (reference switch.go reconnectToPeer),
-        # mutated at runtime by add_persistent_peer
+        # mutated at runtime by add_persistent_peer.  Backoff policy:
+        # capped exponential with seeded jitter and flap detection
+        # (p2p/backoff.py) — a peer that accepts then dies keeps climbing
+        # the ladder instead of being redialed at the floor forever.
+        from tendermint_tpu.p2p.backoff import DialBackoff
+
         self._persistent_targets: dict[str, str] = {}
-        self._persistent_backoff: dict[str, float] = {}
+        self._dial_backoff = DialBackoff()
         self._persistent_next_try: dict[str, float] = {}
 
         # -- PEX / address book (reference p2p/pex; node/node.go:820-856)
@@ -517,7 +522,6 @@ class Node:
         pid = self.transport.add_peer_address(addr)
         if pid not in self._persistent_targets:
             self._persistent_targets[pid] = addr
-            self._persistent_backoff[pid] = 0.5
             self._persistent_next_try[pid] = 0.0
         return pid
 
@@ -529,24 +533,44 @@ class Node:
             self.pex_reactor.private_ids.add(pid.strip().lower())
 
     async def _dial_persistent_peers(self) -> None:
-        """Keep persistent peers connected, with per-peer exponential
-        backoff (reference p2p/switch.go reconnectToPeer)."""
-        backoff = self._persistent_backoff
+        """Keep persistent peers connected, with capped exponential
+        backoff + seeded jitter per peer (reference p2p/switch.go
+        reconnectToPeer; policy in p2p/backoff.py).  The ladder resets
+        only after a connection survives min_uptime, so a flapping peer
+        converges to cap-spaced dials instead of busy-looping."""
+        backoff = self._dial_backoff
         next_try = self._persistent_next_try
+        connected: set[str] = set()
 
         async def try_dial(pid: str) -> None:
+            now = asyncio.get_running_loop().time()
             try:
                 await self.router.dial(pid)
-                backoff[pid] = 0.5
+                backoff.note_connected(pid, now)
+                connected.add(pid)
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 self.logger.debug("dial failed", peer=pid[:8], err=str(e))
-                backoff[pid] = min(backoff[pid] * 2, 30.0)
-                next_try[pid] = asyncio.get_running_loop().time() + backoff[pid]
+                next_try[pid] = now + backoff.next_delay(pid)
 
         while True:
             now = asyncio.get_running_loop().time()
-            due = [pid for pid in self._persistent_targets
-                   if pid not in self.router.peers and now >= next_try[pid]]
+            due = []
+            for pid in list(self._persistent_targets):
+                if pid in self.router.peers:
+                    if pid not in connected:
+                        # connected via inbound accept: still counts as up
+                        backoff.note_connected(pid, now)
+                        connected.add(pid)
+                    continue
+                if pid in connected:
+                    # peer just went down: the ladder only resets if the
+                    # connection lasted; either way the next dial waits
+                    connected.discard(pid)
+                    backoff.note_disconnected(pid, now)
+                    next_try[pid] = now + backoff.next_delay(pid)
+                    continue
+                if now >= next_try[pid]:
+                    due.append(pid)
             if due:
                 # concurrently: one unreachable peer must not stall the rest
                 await asyncio.gather(*(try_dial(pid) for pid in due))

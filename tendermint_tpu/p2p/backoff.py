@@ -1,0 +1,78 @@
+"""Redial backoff policy: capped exponential with seeded jitter and
+flap detection.
+
+The persistent-peer dialer (node.py) and the simnet mesh keeper both
+need the same policy: retry a dead peer with exponentially growing,
+jittered, CAPPED delays — and do NOT treat a momentary success as
+recovery.  The pre-existing dialer reset its backoff to the floor the
+instant a dial succeeded, so a flapping peer (accepts the connection,
+dies within a second, forever) was redialed at the floor rate
+indefinitely: a busy-loop with extra steps.  `DialBackoff` only resets
+after the connection SURVIVES `min_uptime_s`.
+
+Jitter is drawn from a seeded `random.Random` (TM_TPU_DIAL_SEED pins it
+for tests; the default decorrelates processes AND instances within one
+process, same scheme as the reactor's maj23 jitter) so a fleet of nodes
+restarting against one dead peer doesn't thundering-herd it in
+lock-step — and so a simnet run replays identically for a given seed.
+
+Pure logic over caller-supplied clocks: no sleeping, no wall-clock
+reads, trivially unit-testable.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+
+class DialBackoff:
+    """Per-peer redial delay policy.
+
+    Usage from a dial loop:
+        delay = bo.next_delay(pid)        # after a failed dial attempt
+        bo.note_connected(pid, now)       # dial succeeded
+        bo.note_disconnected(pid, now)    # peer died; resets the ladder
+                                          # only if uptime >= min_uptime_s
+    """
+
+    def __init__(self, base_s: float = 0.5, cap_s: float = 30.0,
+                 min_uptime_s: float = 10.0, rng: random.Random | None = None):
+        if rng is None:
+            seed = os.environ.get("TM_TPU_DIAL_SEED")
+            rng = random.Random(
+                int(seed) if seed else hash((os.getpid(), id(self))))
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.min_uptime_s = min_uptime_s
+        self._rng = rng
+        self._attempts: dict[str, int] = {}
+        self._connected_at: dict[str, float] = {}
+
+    def next_delay(self, peer_id: str) -> float:
+        """Delay before the next dial attempt; advances the ladder."""
+        n = self._attempts.get(peer_id, 0)
+        self._attempts[peer_id] = n + 1
+        raw = min(self.cap_s, self.base_s * (2.0 ** n))
+        # jitter in [0.5x, 1.0x]: spreads simultaneous redialers without
+        # ever shrinking the delay below half the deterministic ladder
+        return raw * (0.5 + 0.5 * self._rng.random())
+
+    def note_connected(self, peer_id: str, now: float) -> None:
+        self._connected_at[peer_id] = now
+
+    def note_disconnected(self, peer_id: str, now: float) -> None:
+        """Reset the ladder only after a PROVEN-stable connection: a
+        peer that dies within min_uptime_s keeps climbing, so a flapping
+        peer converges to cap_s-spaced dials instead of busy-looping at
+        the floor."""
+        connected_at = self._connected_at.pop(peer_id, None)
+        if connected_at is not None and now - connected_at >= self.min_uptime_s:
+            self._attempts.pop(peer_id, None)
+
+    def attempts(self, peer_id: str) -> int:
+        return self._attempts.get(peer_id, 0)
+
+    def forget(self, peer_id: str) -> None:
+        self._attempts.pop(peer_id, None)
+        self._connected_at.pop(peer_id, None)

@@ -22,6 +22,10 @@ class MemoryConnection:
         self._send_q: asyncio.Queue = send_q
         self._recv_q: asyncio.Queue = recv_q
         self._closed = asyncio.Event()
+        # the other endpoint of the pair (linked by dial); close() signals
+        # its _closed event directly so a close is NEVER lost to a full
+        # queue — the EOF marker below is only the graceful-drain path
+        self._peer: "MemoryConnection | None" = None
 
     async def send(self, channel_id: int, data: bytes) -> None:
         if self._closed.is_set():
@@ -51,7 +55,14 @@ class MemoryConnection:
             try:
                 self._send_q.put_nowait(None)  # EOF marker for the peer
             except asyncio.QueueFull:
+                # marker lost — the remote's _closed event (below) still
+                # delivers the close.  Dropping it silently used to leave
+                # a slow peer (full queue = exactly the slow-peer case)
+                # blocked in receive() forever.
                 pass
+            peer = self._peer
+            if peer is not None:
+                peer._closed.set()
 
     @property
     def closed(self) -> bool:
@@ -61,28 +72,48 @@ class MemoryConnection:
 class MemoryTransport:
     """Per-node endpoint in a MemoryNetwork."""
 
+    # subclass hooks (simnet FaultyTransport swaps the connection type)
+    connection_class = MemoryConnection
+    queue_maxsize = 1024
+
     def __init__(self, network: "MemoryNetwork", node_id: NodeID):
         self.network = network
         self.node_id = node_id
         self._accept_q: asyncio.Queue[MemoryConnection] = asyncio.Queue()
         self._closed = False
+        # every connection this endpoint ever handed out (either side of
+        # a dial), so a whole-node teardown can sever them all
+        self.conns: list[MemoryConnection] = []
 
     async def accept(self) -> MemoryConnection:
         conn = await self._accept_q.get()
         if conn is None:
             raise ConnectionError("transport closed")
+        self.conns.append(conn)
         return conn
 
     async def dial(self, remote_id: NodeID) -> MemoryConnection:
         remote = self.network.nodes.get(remote_id)
         if remote is None or remote._closed:
             raise ConnectionError(f"no node {remote_id} in memory network")
-        q_ab: asyncio.Queue = asyncio.Queue(maxsize=1024)
-        q_ba: asyncio.Queue = asyncio.Queue(maxsize=1024)
-        local_conn = MemoryConnection(self.node_id, remote_id, q_ab, q_ba)
-        remote_conn = MemoryConnection(remote_id, self.node_id, q_ba, q_ab)
+        cls = self.connection_class
+        q_ab: asyncio.Queue = asyncio.Queue(maxsize=self.queue_maxsize)
+        q_ba: asyncio.Queue = asyncio.Queue(maxsize=self.queue_maxsize)
+        local_conn = cls(self.node_id, remote_id, q_ab, q_ba)
+        remote_conn = cls(remote_id, self.node_id, q_ba, q_ab)
+        # link the pair: close() on either side must reach the other even
+        # when its queue is full (the EOF marker alone can be dropped)
+        local_conn._peer = remote_conn
+        remote_conn._peer = local_conn
+        self._setup_conn(local_conn)
+        remote._setup_conn(remote_conn)
+        self.conns.append(local_conn)
         await remote._accept_q.put(remote_conn)
         return local_conn
+
+    def _setup_conn(self, conn: MemoryConnection) -> None:
+        """Subclass hook: initialize a freshly-created connection side
+        (the fault layer attaches its network handle here)."""
 
     async def close(self) -> None:
         self._closed = True

@@ -1,0 +1,33 @@
+"""Simnet: fault-injecting in-process scenario harness.
+
+Stands up 20-50 in-process nodes (hundreds-to-thousands of validator
+slots) over a fault-injection layer wrapped around the memory transport
+(`faults.FaultyNetwork`), drives tx load, applies a declarative fault
+schedule (partitions, slow links, drops, crash-restart with WAL replay,
+byzantine mavericks), and computes a machine-checkable verdict from the
+merged consensus event journals (the PR 3 timeline analyzer) plus
+invariant checks — exit 0/1 with a JSON report, nothing eyeballed.
+
+Entry points:
+  scenario.load_scenario / scenario.generate_scenario  — declarative or
+      seeded-random scenario definitions
+  harness.run_scenario                                 — run one scenario
+  verdict.evaluate                                     — invariants over
+      the timeline report + run info
+
+CLI: `tendermint-tpu simnet --scenario <file>` (cli/main.py).
+Docs: docs/simnet.md.
+"""
+
+from .faults import FaultyNetwork, LinkSpec
+from .scenario import Scenario, generate_scenario, load_scenario
+from .verdict import evaluate
+
+__all__ = [
+    "FaultyNetwork",
+    "LinkSpec",
+    "Scenario",
+    "evaluate",
+    "generate_scenario",
+    "load_scenario",
+]

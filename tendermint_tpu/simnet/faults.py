@@ -1,0 +1,272 @@
+"""Fault-injection layer over the in-memory transport.
+
+`FaultyNetwork` is a drop-in `MemoryNetwork` whose connections route
+every frame through a per-directed-link `LinkSpec`: impose latency +
+seeded jitter, probabilistic drops, a bandwidth cap, or a full blackhole
+(partitions).  Link state is mutable at runtime — the scenario runner
+flips partitions on and off, degrades links mid-run, and severs a
+crashed node's connections — and every decision draws from ONE seeded
+RNG so a scenario replays identically for a given seed.
+
+Semantics (modeled on what a real kernel/network does):
+  * latency/jitter delay frames but never reorder them within one
+    connection (delivery time is monotone per connection, like TCP).
+  * drops and blackholes are silent — the sender learns nothing, the
+    receiver sees nothing (reference e2e "disconnect" perturbation).
+  * a partition also blocks NEW dials across the cut, and frames already
+    in flight across the cut are dropped at delivery time.
+  * bandwidth caps serialize frames through a token-bucket-ish release
+    point: a frame's delivery waits for the link to drain ahead of it.
+  * node churn: `drop_node` severs every connection of a node and
+    removes its transport — peers observe ConnectionError exactly as
+    they would a died process; `create_transport` with the same NodeID
+    rejoins the survivors.
+
+The base `MemoryNetwork` path (no spec set, no default spec) stays
+allocation-free: `send` falls through to the plain queue put.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, replace
+
+from tendermint_tpu.p2p.memory import MemoryConnection, MemoryNetwork, MemoryTransport
+from tendermint_tpu.p2p.types import NodeID
+
+
+@dataclass
+class LinkSpec:
+    """Fault parameters for one directed link (src -> dst)."""
+
+    latency_ms: float = 0.0     # fixed one-way delay
+    jitter_ms: float = 0.0      # + uniform [0, jitter_ms) per frame
+    drop: float = 0.0           # per-frame drop probability [0, 1]
+    bandwidth: int = 0          # bytes/second the link drains (0 = unlimited)
+    blocked: bool = False       # blackhole (partition)
+
+    def is_noop(self) -> bool:
+        return (not self.blocked and self.drop <= 0.0
+                and self.latency_ms <= 0.0 and self.jitter_ms <= 0.0
+                and self.bandwidth <= 0)
+
+
+class FaultyConnection(MemoryConnection):
+    """MemoryConnection whose sends consult the network's link table."""
+
+    network: "FaultyNetwork | None" = None
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self._pending: asyncio.Queue | None = None
+        self._pump_task: asyncio.Task | None = None
+        self._last_deliver = 0.0   # FIFO floor (loop time)
+        self._link_free_at = 0.0   # bandwidth serialization point
+
+    def _spec(self) -> LinkSpec | None:
+        net = self.network
+        if net is None:
+            return None
+        return net.link(self.local_id, self.remote_id)
+
+    async def send(self, channel_id: int, data: bytes) -> None:
+        spec = self._spec()
+        if spec is None:
+            await super().send(channel_id, data)
+            return
+        if self._closed.is_set():
+            raise ConnectionError("connection closed")
+        net = self.network
+        if spec.blocked:
+            net.count_drop(self.local_id, self.remote_id, len(data), "blocked")
+            return
+        if spec.drop > 0.0 and net.rng.random() < spec.drop:
+            net.count_drop(self.local_id, self.remote_id, len(data), "drop")
+            return
+        delay = spec.latency_ms / 1e3
+        if spec.jitter_ms > 0.0:
+            delay += net.rng.random() * spec.jitter_ms / 1e3
+        loop = asyncio.get_running_loop()
+        now = loop.time()
+        if spec.bandwidth > 0:
+            start = max(now, self._link_free_at)
+            drain = len(data) / spec.bandwidth
+            self._link_free_at = start + drain
+            delay += (start - now) + drain
+        if delay <= 0.0:
+            await self._send_q.put((channel_id, data))
+            net.count_delivery(self.local_id, self.remote_id, len(data))
+            return
+        # frames delayed by different jitter draws must not reorder
+        # within one connection: clamp to the previous delivery time
+        deliver_at = max(now + delay, self._last_deliver)
+        self._last_deliver = deliver_at
+        if self._pending is None:
+            self._pending = asyncio.Queue()
+            self._pump_task = loop.create_task(self._pump())
+        self._pending.put_nowait((deliver_at, channel_id, data))
+
+    async def _pump(self) -> None:
+        """Deliver delayed frames in order at their release times."""
+        try:
+            while True:
+                deliver_at, channel_id, data = await self._pending.get()
+                now = asyncio.get_running_loop().time()
+                if deliver_at > now:
+                    await asyncio.sleep(deliver_at - now)
+                if self._closed.is_set():
+                    return
+                spec = self._spec()
+                if spec is not None and spec.blocked:
+                    # partition cut while the frame was in flight
+                    self.network.count_drop(
+                        self.local_id, self.remote_id, len(data), "blocked")
+                    continue
+                await self._send_q.put((channel_id, data))
+                if self.network is not None:
+                    self.network.count_delivery(
+                        self.local_id, self.remote_id, len(data))
+        except asyncio.CancelledError:
+            return
+
+    async def close(self) -> None:
+        if self._pump_task is not None and not self._pump_task.done():
+            self._pump_task.cancel()
+        await super().close()
+
+
+class FaultyTransport(MemoryTransport):
+    connection_class = FaultyConnection
+
+    async def dial(self, remote_id: NodeID):
+        net = self.network
+        if isinstance(net, FaultyNetwork):
+            spec = net.link(self.node_id, remote_id)
+            if spec is not None and spec.blocked:
+                # a partitioned pair cannot establish NEW connections
+                # either (redial during a partition must fail, so the
+                # dialer's backoff keeps running until the heal)
+                raise ConnectionError(
+                    f"link {self.node_id[:8]}->{remote_id[:8]} is partitioned")
+        return await super().dial(remote_id)
+
+    def _setup_conn(self, conn: MemoryConnection) -> None:
+        conn.network = self.network
+
+
+class FaultyNetwork(MemoryNetwork):
+    """MemoryNetwork + mutable per-link fault table + churn helpers."""
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self.rng = random.Random(seed)
+        self.default_spec: LinkSpec | None = None
+        self._links: dict[tuple[NodeID, NodeID], LinkSpec] = {}
+        self._partition: list[set[NodeID]] | None = None
+        # observability: the runner folds these into the verdict report
+        self.frames_dropped = 0
+        self.bytes_dropped = 0
+        self.frames_shaped = 0  # frames that traversed a live fault spec
+        self.drops_by_reason: dict[str, int] = {}
+
+    def create_transport(self, node_id: NodeID) -> FaultyTransport:
+        if node_id in self.nodes:
+            raise ValueError(f"node {node_id} already in network")
+        t = FaultyTransport(self, node_id)
+        self.nodes[node_id] = t
+        return t
+
+    # -- link table ------------------------------------------------------
+    def link(self, src: NodeID, dst: NodeID) -> LinkSpec | None:
+        """Effective spec for a directed link; None = no faults at all."""
+        spec = self._links.get((src, dst), self.default_spec)
+        if self._partition is not None and not self._same_side(src, dst):
+            base = spec or LinkSpec()
+            if not base.blocked:
+                return replace(base, blocked=True)
+        return spec
+
+    def _same_side(self, a: NodeID, b: NodeID) -> bool:
+        # nodes outside every group sit with group 0 (the "majority
+        # side" by convention — scenario.partition lists the minority
+        # explicitly and everyone else stays connected)
+        def side(x: NodeID) -> int:
+            for i, group in enumerate(self._partition):
+                if x in group:
+                    return i
+            return 0
+
+        return side(a) == side(b)
+
+    def set_link(self, src: NodeID, dst: NodeID, spec: LinkSpec | None,
+                 symmetric: bool = True) -> None:
+        keys = [(src, dst)] + ([(dst, src)] if symmetric else [])
+        for k in keys:
+            if spec is None:
+                self._links.pop(k, None)
+            else:
+                self._links[k] = spec
+
+    def set_default(self, spec: LinkSpec | None) -> None:
+        """Baseline spec for every link without an explicit entry."""
+        self.default_spec = spec
+
+    def clear_links(self) -> None:
+        self._links.clear()
+
+    def unblock_links(self) -> None:
+        """Remove only the blocked per-link entries (one-way partitions,
+        isolates) — degradation specs (latency/drop/bandwidth) survive."""
+        for k in [k for k, v in self._links.items() if v.blocked]:
+            del self._links[k]
+
+    def undegrade_links(self) -> None:
+        """Remove only the non-blocked entries (slow-phase degradation);
+        blocks (partitions/isolates) survive until their heal."""
+        for k in [k for k, v in self._links.items() if not v.blocked]:
+            del self._links[k]
+
+    # -- partitions ------------------------------------------------------
+    def partition(self, groups: list[set[NodeID]]) -> None:
+        """Blackhole every link crossing group boundaries.  Nodes not in
+        any group count as members of the first group."""
+        self._partition = [set(g) for g in groups]
+
+    def heal(self) -> None:
+        self._partition = None
+
+    @property
+    def partitioned(self) -> bool:
+        return self._partition is not None
+
+    # -- churn -----------------------------------------------------------
+    async def drop_node(self, node_id: NodeID) -> None:
+        """Sever a node from the net the way a process death would:
+        every one of its connections closes (both sides learn), and its
+        transport leaves the registry so redials fail until rejoin."""
+        t = self.nodes.get(node_id)
+        if t is None:
+            return
+        for conn in list(t.conns):
+            await conn.close()
+        t.conns.clear()
+        await t.close()
+
+    # -- accounting ------------------------------------------------------
+    def count_drop(self, src: NodeID, dst: NodeID, nbytes: int,
+                   reason: str) -> None:
+        self.frames_dropped += 1
+        self.bytes_dropped += nbytes
+        self.drops_by_reason[reason] = self.drops_by_reason.get(reason, 0) + 1
+
+    def count_delivery(self, src: NodeID, dst: NodeID, nbytes: int) -> None:
+        self.frames_shaped += 1
+
+    def stats(self) -> dict:
+        return {
+            "frames_dropped": self.frames_dropped,
+            "bytes_dropped": self.bytes_dropped,
+            "frames_shaped": self.frames_shaped,
+            "drops_by_reason": dict(self.drops_by_reason),
+        }

@@ -1,0 +1,303 @@
+"""Declarative simnet scenarios + the seeded randomized generator.
+
+A scenario fixes everything about a run: topology (live nodes, total
+validator slots, voting weights), load, the fault schedule (keyed by
+wall offset or committed height), byzantine mavericks, and the verdict
+knobs.  Files are TOML or JSON — the same tomllib/tomli story as the
+node config loader — and the generator mode (`generate_scenario`)
+explores the space with a seeded RNG exactly like e2e/generator.py
+explores manifests: the same seed always yields the same scenario.
+
+Fault ops (docs/simnet.md has the full menu):
+  partition   nodes=[minority indices]; everyone else stays connected.
+              one_way=true blocks only minority->majority (asymmetric).
+  heal        lift the partition
+  slow        degrade links of `nodes` (or the whole net when empty):
+              latency_ms/jitter_ms/drop/bandwidth
+  clear       reset all link degradation
+  isolate     blackhole one node's links both ways
+  rejoin      lift an isolate
+  crash       kill node hard (task cancellation), or — with fail_label /
+              fail_index — arm a utils/fail.py fail point so the node
+              dies mid-commit-sequence; restart_after_s relaunches it
+              with WAL replay (negative = stay down)
+  restart     restart a previously crashed node explicitly
+
+Triggers: `at_height` fires when any honest live node commits that
+height; `at_s` is a wall offset from run start.  Ops apply in schedule
+order; a height trigger that never fires times the run out (the verdict
+then reports the progress violation that caused it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from dataclasses import asdict, dataclass, field
+
+# the commit-sequence fail-point labels instrumented in
+# consensus/state.py finalize_commit (reference state.go:1524-1577)
+COMMIT_FAIL_LABELS = (
+    "commit-before-save",
+    "commit-after-save",
+    "commit-after-barrier",
+    "commit-after-apply",
+)
+
+FAULT_OPS = ("partition", "heal", "slow", "clear", "isolate", "rejoin",
+             "crash", "restart")
+
+MISBEHAVIORS = (
+    "double-prevote",
+    "double-precommit",
+    "amnesia",
+    "nil-prevote",
+    "nil-precommit",
+    "ignore-proposal",
+)
+
+
+@dataclass
+class FaultOp:
+    op: str
+    at_s: float | None = None
+    at_height: int | None = None
+    nodes: list = field(default_factory=list)
+    latency_ms: float = 0.0
+    jitter_ms: float = 0.0
+    drop: float = 0.0
+    bandwidth: int = 0            # bytes/s (0 = unlimited)
+    one_way: bool = False         # partition: block minority->rest only
+    fail_label: str = ""          # crash: target a labeled fail point
+    fail_index: int = 0           # crash: index among matching calls
+    restart_after_s: float = 1.0  # crash: relaunch delay (< 0 = stay down)
+
+    def validate(self, n_nodes: int) -> None:
+        if self.op not in FAULT_OPS:
+            raise ValueError(f"unknown fault op {self.op!r}")
+        if self.at_s is None and self.at_height is None:
+            raise ValueError(f"fault op {self.op!r} needs at_s or at_height")
+        for i in self.nodes:
+            if not (0 <= int(i) < n_nodes):
+                raise ValueError(f"fault op {self.op!r}: node {i} out of range")
+        if self.op == "partition" and not self.nodes:
+            raise ValueError("partition needs a minority node list")
+        if self.op in ("crash", "restart", "isolate", "rejoin") and \
+                len(self.nodes) != 1:
+            raise ValueError(f"{self.op} targets exactly one node")
+        if self.fail_label and self.fail_label not in COMMIT_FAIL_LABELS \
+                and self.fail_label != "own-msg-fsynced":
+            raise ValueError(f"unknown fail label {self.fail_label!r}")
+
+
+@dataclass
+class Scenario:
+    name: str = "simnet"
+    seed: int = 0
+    validators: int = 8           # live in-process nodes
+    validator_slots: int = 0      # TOTAL genesis validators (0 = validators);
+                                  # slots beyond the live nodes are passive
+                                  # low-power validators that scale the set
+    live_power: int = 100         # voting power per live node
+    slot_power: int = 1           # voting power per passive slot
+    weights: list = field(default_factory=list)  # explicit live powers
+    target_height: int = 8
+    max_runtime_s: float = 120.0
+    load_rate: float = 0.0        # offered txs/second (0 = no load)
+    load_total: int = 0           # stop after N submissions (0 = unbounded)
+    # node index (as int or str) -> {height: misbehavior name}
+    mavericks: dict = field(default_factory=dict)
+    faults: list = field(default_factory=list)   # list[FaultOp]
+    # verdict knobs (verdict.py)
+    stall_factor: float = 0.0     # x timeout_commit; 0 = default w/ floor
+    max_rounds: int = 8
+    expect_min_height: int = 0    # 0 = target_height
+    gossip_sleep_ms: int = 10
+    timeout_scale: float = 1.0    # scales the test-config consensus timeouts
+    mesh_degree: int = 0          # peers per node: 0 = full mesh; else a
+                                  # ring + seeded chords (big nets flood
+                                  # O(n^2) links all-to-all — real nets
+                                  # don't run full mesh either)
+
+    # -- derived ---------------------------------------------------------
+    def total_slots(self) -> int:
+        return max(self.validator_slots, self.validators)
+
+    def live_weights(self) -> list[int]:
+        if self.weights:
+            if len(self.weights) != self.validators:
+                raise ValueError("weights length != validators")
+            return [int(w) for w in self.weights]
+        return [self.live_power] * self.validators
+
+    def maverick_map(self) -> dict[int, dict[int, str]]:
+        out: dict[int, dict[int, str]] = {}
+        for node, per_height in self.mavericks.items():
+            out[int(node)] = {int(h): str(m) for h, m in per_height.items()}
+        return out
+
+    def byzantine_nodes(self) -> set[int]:
+        return set(self.maverick_map())
+
+    def equivocators_expected(self) -> bool:
+        return any(
+            m in ("double-prevote", "double-precommit")
+            for per_height in self.maverick_map().values()
+            for m in per_height.values()
+        )
+
+    def validate(self) -> None:
+        if self.validators < 1:
+            raise ValueError("validators must be >= 1")
+        if self.validators > 64:
+            raise ValueError("more than 64 live in-process nodes is asking "
+                             "for an event-loop meltdown; use validator_slots "
+                             "for set size")
+        if self.total_slots() > 10_000:
+            raise ValueError("validator_slots > 10000")
+        if self.mesh_degree < 0 or self.mesh_degree == 1:
+            raise ValueError("mesh_degree must be 0 (full mesh) or >= 2")
+        live = sum(self.live_weights())
+        passive = (self.total_slots() - self.validators) * self.slot_power
+        if live * 3 <= (live + passive) * 2:
+            raise ValueError(
+                f"live nodes hold {live}/{live + passive} power — passive "
+                "slots would block every commit (need live > 2/3)")
+        for node, per_height in self.maverick_map().items():
+            if not (0 <= node < self.validators):
+                raise ValueError(f"maverick node {node} out of range")
+            for h, m in per_height.items():
+                if m not in MISBEHAVIORS:
+                    raise ValueError(f"unknown misbehavior {m!r} at {h}")
+        for op in self.faults:
+            op.validate(self.validators)
+
+    def to_dict(self) -> dict:
+        doc = asdict(self)
+        doc["faults"] = [
+            {k: v for k, v in asdict(op).items()
+             if v not in (None, [], "", 0, 0.0, False) or k == "op"}
+            for op in self.faults
+        ]
+        return doc
+
+
+def scenario_from_dict(doc: dict) -> Scenario:
+    """Build + validate a Scenario from decoded TOML/JSON."""
+    doc = dict(doc)
+    faults = [FaultOp(**f) for f in doc.pop("faults", [])]
+    known = {f.name for f in Scenario.__dataclass_fields__.values()}
+    unknown = set(doc) - known
+    if unknown:
+        raise ValueError(f"unknown scenario keys: {sorted(unknown)}")
+    sc = Scenario(**doc, faults=faults)
+    sc.validate()
+    return sc
+
+
+def load_scenario(path: str) -> Scenario:
+    """Load a scenario file — .toml via the config loader's tomllib/tomli
+    fallback, anything else as JSON."""
+    if path.endswith(".toml"):
+        from tendermint_tpu.config.config import tomllib
+        if tomllib is None:
+            raise ImportError(
+                "TOML scenarios need tomllib (Python >= 3.11) or the tomli "
+                "backport; neither is installed — use a JSON scenario")
+        with open(path, "rb") as fh:
+            doc = tomllib.load(fh)
+    else:
+        with open(path) as fh:
+            doc = json.load(fh)
+    doc.setdefault("name", os.path.splitext(os.path.basename(path))[0])
+    return scenario_from_dict(doc)
+
+
+# ---------------------------------------------------------------------------
+# seeded generator mode (extends e2e/generator.py's manifest exploration
+# to the simnet fault space)
+# ---------------------------------------------------------------------------
+
+
+def generate_scenario(seed: int, index: int = 0) -> Scenario:
+    """One reproducible random scenario.  Guarantees: the fault schedule
+    never exceeds the BFT budget (crashed + partitioned-minority +
+    byzantine stays under 1/3 of live power at any instant), the
+    partition minority is always < 1/3, and every crash restarts."""
+    rng = random.Random(f"simnet-{seed}-{index}")
+    n = rng.choice((8, 12, 16, 20, 20, 24))
+    slots = rng.choice((0, n * 5, n * 10, n * 25))
+    target = rng.randint(8, 14)
+    faults: list[FaultOp] = []
+    byz_budget = (n - 1) // 3
+
+    # one partition + heal in most runs: minority strictly under 1/3
+    used = 0
+    if byz_budget >= 1 and rng.random() < 0.8:
+        k = rng.randint(1, max(1, byz_budget - 1)) if byz_budget > 1 else 1
+        minority = rng.sample(range(1, n), k)
+        h = rng.randint(2, max(2, target // 2))
+        faults.append(FaultOp(op="partition", at_height=h, nodes=minority,
+                              one_way=rng.random() < 0.2))
+        faults.append(FaultOp(op="heal", at_height=h + rng.randint(1, 2)))
+        used = max(used, k)
+
+    # a slow-link phase (latency/jitter or bandwidth or drops)
+    if rng.random() < 0.7:
+        mode = rng.choice(("latency", "bandwidth", "drop"))
+        targets = rng.sample(range(n), rng.randint(1, max(1, n // 4)))
+        op = FaultOp(op="slow", at_height=rng.randint(2, max(2, target - 4)),
+                     nodes=targets)
+        if mode == "latency":
+            op.latency_ms = rng.choice((25, 50, 100))
+            op.jitter_ms = rng.choice((0, 10, 25))
+        elif mode == "bandwidth":
+            op.bandwidth = rng.choice((64, 256, 1024)) * 1024
+        else:
+            op.drop = rng.choice((0.05, 0.1, 0.2))
+        faults.append(op)
+        faults.append(FaultOp(op="clear",
+                              at_height=op.at_height + rng.randint(2, 3)))
+
+    # crash-restart (WAL replay), sometimes via a commit-sequence fail point
+    if byz_budget > used and rng.random() < 0.8:
+        victim = rng.randrange(1, n)
+        op = FaultOp(op="crash", at_height=rng.randint(2, max(2, target - 3)),
+                     nodes=[victim], restart_after_s=rng.choice((0.5, 1.0, 2.0)))
+        if rng.random() < 0.5:
+            op.fail_label = rng.choice(COMMIT_FAIL_LABELS)
+        faults.append(op)
+        used += 1
+
+    # at most one maverick, inside the remaining budget
+    mavericks: dict = {}
+    if byz_budget > used and rng.random() < 0.6:
+        node = rng.randrange(1, n)
+        h = rng.randint(2, max(2, target - 3))
+        mavericks[str(node)] = {str(h): rng.choice(MISBEHAVIORS)}
+
+    sc = Scenario(
+        name=f"gen-{seed}-{index}",
+        seed=seed,
+        validators=n,
+        validator_slots=slots,
+        target_height=target,
+        load_rate=rng.choice((0, 5, 10, 20)),
+        max_runtime_s=240.0,
+        mavericks=mavericks,
+        faults=faults,
+        # one event loop: a full mesh past ~12 nodes saturates the core
+        # with O(n^2) gossip and scheduler starvation masquerades as
+        # round churn (docs/simnet.md "Keeping big nets honest")
+        mesh_degree=0 if n <= 12 else 6,
+        gossip_sleep_ms=10 if n <= 12 else 50,
+        timeout_scale=1.0 if n <= 12 else 6.0,
+    )
+    sc.validate()
+    return sc
+
+
+def generate(seed: int, n: int = 4) -> list[Scenario]:
+    """Reproducible scenario list (sweep mode)."""
+    return [generate_scenario(seed, i) for i in range(n)]

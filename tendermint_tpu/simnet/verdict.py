@@ -1,0 +1,256 @@
+"""Invariant checks over a simnet run: the verdict is computed, never
+eyeballed.
+
+Inputs: the scenario, the merged-journal `TimelineReport` (the PR 3
+analyzer — cli/timeline.py), and the runner's `run_info` (final heights,
+per-height header hashes read straight from the block stores, committed
+evidence, fault windows, load counters).
+
+Invariants (each names itself in `violations` on failure):
+
+  progress     every honest live node reached the scenario's target
+               height (expect_min_height overrides)
+  agreement    committed headers identical across the honest live set at
+               every common height — the fork detector
+  stall        no honest node went longer than the stall budget between
+               consecutive commits OUTSIDE fault windows.  The budget is
+               `stall_factor x timeout_commit` with a floor of one full
+               round-trip of all consensus timeouts x 6 — partitions,
+               crash recoveries and slow phases are excluded via the
+               runner's fault windows (each extended by one budget of
+               grace for re-sync).
+  rounds       no height needed more than `max_rounds` rounds
+  evidence     an equivocating maverick (double-prevote/precommit) MUST
+               surface: DuplicateVoteEvidence committed in an honest
+               block, or the timeline's equivocation detector firing.
+               Conversely, equivocation with NO maverick configured is a
+               violation on its own (someone forged votes).
+
+Exit-code contract (cli/main.py simnet): verdict ok -> 0, any violation
+-> 1, with the violated invariant named in the JSON report.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.cli.timeline import TimelineReport, report_json
+
+from .scenario import Scenario
+
+
+def _stall_budget_s(scenario: Scenario, run_info: dict) -> float:
+    if scenario.stall_factor > 0:
+        return scenario.stall_factor * run_info["timeout_commit_ms"] / 1e3
+    # default: a generous multiple of a full timeout round-trip — under
+    # the 50ms-class test timeouts this lands ~5s, far above a healthy
+    # inter-commit gap (~0.3-0.5s) and far below a real liveness stall
+    return max(5.0, 6.0 * run_info["round_ms"] / 1e3)
+
+
+def _windows_for_node(run_info: dict, node_index: int,
+                      grace_ns: int) -> list[tuple[int, int]]:
+    """Fault windows that excuse a stall for this node.  ALL windows
+    count — partitions/slow phases can stall the majority via lost
+    proposers, and even another node's crash removes a proposer — each
+    extended by the grace period for post-heal re-sync.  (node_index is
+    kept for a future per-node tightening of the exclusion.)"""
+    out = []
+    for w in run_info.get("fault_windows", []):
+        t0 = w["t0_ns"]
+        t1 = w.get("t1_ns", t0) + grace_ns
+        out.append((t0, t1))
+    return out
+
+
+def _overlaps(a0: int, a1: int, windows: list[tuple[int, int]]) -> bool:
+    return any(not (a1 < w0 or a0 > w1) for w0, w1 in windows)
+
+
+def _commit_stalls(report: TimelineReport, run_info: dict,
+                   budget_s: float) -> list[dict]:
+    """Per honest node: max inter-commit gap outside fault windows."""
+    budget_ns = int(budget_s * 1e9)
+    stalls = []
+    honest = {n["name"]: n["index"] for n in run_info["nodes"]
+              if n["honest"] and not n["crashed"]}
+    for name, index in honest.items():
+        commits = []
+        for h in sorted(report.heights):
+            nv = report.heights[h].nodes.get(name)
+            if nv is not None and nv.commit_w is not None:
+                commits.append((h, nv.commit_w))
+        windows = _windows_for_node(run_info, index, budget_ns)
+        for (h0, w0), (h1, w1) in zip(commits, commits[1:]):
+            gap = w1 - w0
+            if gap > budget_ns and not _overlaps(w0, w1, windows):
+                stalls.append({
+                    "node": name, "from_height": h0, "to_height": h1,
+                    "gap_s": round(gap / 1e9, 3),
+                    "budget_s": round(budget_s, 3),
+                })
+    return stalls
+
+
+def _recovery_after_heal(report: TimelineReport, run_info: dict) -> list[dict]:
+    """Time from each heal/rejoin/restart to the next commit anywhere on
+    the net — the 'how fast does adversity drain' metric."""
+    commit_ws = sorted(
+        nv.commit_w
+        for hv in report.heights.values()
+        for nv in hv.nodes.values()
+        if nv.commit_w is not None
+    )
+    out = []
+    for heal_ns in run_info.get("heal_times_ns", []):
+        nxt = next((w for w in commit_ws if w >= heal_ns), None)
+        out.append({
+            "heal_t_ns": heal_ns,
+            "first_commit_after_s": (round((nxt - heal_ns) / 1e9, 3)
+                                     if nxt is not None else None),
+        })
+    return out
+
+
+def evaluate(scenario: Scenario, report: TimelineReport,
+             run_info: dict) -> dict:
+    violations: list[dict] = []
+    honest_live = [n for n in run_info["nodes"]
+                   if n["honest"] and not n["crashed"]]
+
+    # -- progress --------------------------------------------------------
+    target = scenario.expect_min_height or scenario.target_height
+    min_height = min((n["height"] for n in honest_live), default=0)
+    if not honest_live:
+        violations.append({"invariant": "progress",
+                           "detail": "no honest node survived the run"})
+    elif min_height < target:
+        laggards = [f"{n['name']}@{n['height']}" for n in honest_live
+                    if n["height"] < target]
+        violations.append({
+            "invariant": "progress",
+            "detail": (f"honest set short of height {target}: "
+                       + ", ".join(laggards)),
+        })
+
+    # -- agreement -------------------------------------------------------
+    forked_at = None
+    for h, hashes in sorted(run_info.get("header_hashes", {}).items()):
+        if len(set(hashes.values())) > 1:
+            forked_at = (h, hashes)
+            break
+    if forked_at is not None:
+        violations.append({
+            "invariant": "agreement",
+            "detail": f"divergent headers at height {forked_at[0]}: "
+                      f"{forked_at[1]}",
+        })
+
+    # -- stall -----------------------------------------------------------
+    budget_s = _stall_budget_s(scenario, run_info)
+    stalls = _commit_stalls(report, run_info, budget_s)
+    if stalls:
+        worst = max(stalls, key=lambda s: s["gap_s"])
+        violations.append({
+            "invariant": "stall",
+            "detail": (f"{worst['node']} stalled {worst['gap_s']}s between "
+                       f"heights {worst['from_height']} and "
+                       f"{worst['to_height']} (budget {worst['budget_s']}s, "
+                       f"{len(stalls)} stall(s) total)"),
+        })
+
+    # -- rounds ----------------------------------------------------------
+    max_round = max((hv.max_round for hv in report.heights.values()),
+                    default=0)
+    if max_round > scenario.max_rounds:
+        heights = [h for h, hv in sorted(report.heights.items())
+                   if hv.max_round > scenario.max_rounds]
+        violations.append({
+            "invariant": "rounds",
+            "detail": (f"round {max_round} exceeded bound "
+                       f"{scenario.max_rounds} (heights {heights})"),
+        })
+
+    # -- evidence --------------------------------------------------------
+    timeline_equivocations = sum(
+        len(hv.equivocations) for hv in report.heights.values())
+    committed = run_info.get("evidence_committed", 0)
+    if scenario.equivocators_expected():
+        if committed == 0 and timeline_equivocations == 0:
+            violations.append({
+                "invariant": "evidence",
+                "detail": "equivocating maverick configured but no "
+                          "DuplicateVoteEvidence committed and no timeline "
+                          "equivocation detected",
+            })
+    elif timeline_equivocations > 0:
+        violations.append({
+            "invariant": "evidence",
+            "detail": f"{timeline_equivocations} equivocation(s) in the "
+                      "timeline with no maverick configured",
+        })
+
+    # -- report ----------------------------------------------------------
+    duration_s = run_info["duration_s"]
+    heights_per_min = (min_height / duration_s * 60.0) if duration_s else 0.0
+    accepted = run_info.get("accepted_tx", 0)
+    recovery = _recovery_after_heal(report, run_info)
+    recovered = [r["first_commit_after_s"] for r in recovery
+                 if r["first_commit_after_s"] is not None]
+    rounds_gt0 = sum(1 for hv in report.heights.values() if hv.max_round > 0)
+    # longest run of consecutive heights needing rounds > 0 (bench metric)
+    streak = best_streak = 0
+    for h in sorted(report.heights):
+        if report.heights[h].max_round > 0:
+            streak += 1
+            best_streak = max(best_streak, streak)
+        else:
+            streak = 0
+
+    return {
+        "ok": not violations,
+        "violations": violations,
+        "scenario": {
+            "name": scenario.name,
+            "seed": scenario.seed,
+            "validators": scenario.validators,
+            "validator_slots": scenario.total_slots(),
+            "target_height": scenario.target_height,
+            "byzantine": sorted(scenario.byzantine_nodes()),
+            "faults": [op.op for op in scenario.faults],
+        },
+        "heights": {
+            "min_honest": min_height,
+            "per_node": {n["name"]: n["height"] for n in run_info["nodes"]},
+            "per_min": round(heights_per_min, 2),
+        },
+        "timed_out": run_info.get("timed_out", False),
+        "duration_s": round(duration_s, 2),
+        "load": {
+            "offered_tx": run_info.get("offered_tx", 0),
+            "accepted_tx": accepted,
+            "accepted_tx_per_s": round(accepted / duration_s, 2)
+                                 if duration_s else 0.0,
+        },
+        "rounds": {
+            "max_round": max_round,
+            "heights_with_rounds_gt0": rounds_gt0,
+            "max_consecutive_gt0": best_streak,
+        },
+        "stall_budget_s": round(budget_s, 3),
+        "stalls": stalls,
+        "recovery": {
+            "events": recovery,
+            "max_recovery_s": round(max(recovered), 3) if recovered else None,
+        },
+        "evidence": {
+            "committed": committed,
+            "timeline_equivocations": timeline_equivocations,
+            "expected": scenario.equivocators_expected(),
+        },
+        "restarts": {n["name"]: n["restarts"] for n in run_info["nodes"]
+                     if n["restarts"]},
+        "wal_replays": run_info.get("wal_replays", {}),
+        "anomalies": report.anomalies,
+        "network": run_info.get("network", {}),
+        "fault_log": run_info.get("fault_log", []),
+        "timeline": report_json(report),
+    }

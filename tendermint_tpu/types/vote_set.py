@@ -167,6 +167,17 @@ class VoteSet:
             raise ValueError("same block vote with non-deterministic signature")
         return False
 
+    def has_exact(self, vote: Vote) -> bool:
+        """True if this exact vote (validator, block, signature) is
+        already admitted — the cheap pre-crypto duplicate probe.  Gossip
+        re-delivers admitted votes until the sender sees our HasVote, so
+        callers use this to skip signature verification entirely;
+        add_vote's own duplicate check then drops the message."""
+        if not (0 <= vote.validator_index < len(self.votes)):
+            return False
+        existing = self._get_vote(vote.validator_index, vote.block_id.key())
+        return existing is not None and existing.signature == vote.signature
+
     def _get_vote(self, val_index: int, block_key: tuple) -> Vote | None:
         existing = self.votes[val_index]
         if existing is not None and existing.block_id.key() == block_key:

@@ -1,18 +1,57 @@
 """Crash-point injection (reference libs/fail/fail.go:9-39).
 
-`fail_point()` increments a process-global counter; when env
-TM_TPU_FAIL_INDEX equals the counter value at a call, the process exits
-immediately (os._exit — no cleanup, no WAL flush beyond what already
-happened), simulating a hard crash at that exact point.  The
-crash/recovery matrix test (reference consensus/replay_test.go:1269)
-restarts the node at every index and asserts the chain recovers.
+Two modes share the same instrumented call sites:
+
+Process mode (the original, reference-parity): `fail_point()` increments
+a process-global counter; when env TM_TPU_FAIL_INDEX equals the counter
+value at a call, the process exits immediately (os._exit — no cleanup,
+no WAL flush beyond what already happened), simulating a hard crash at
+that exact point.  The crash/recovery matrix test (reference
+consensus/replay_test.go:1269) restarts the node at every index and
+asserts the chain recovers.
+
+Scoped in-process mode (simnet): a multi-node simnet runs every node in
+ONE process, so os._exit would kill the whole net and the global
+counter would interleave all nodes' fail points.  `set_scope(name)`
+binds the current asyncio context (contextvars propagate into every
+task created under it) to a named scope with its OWN counter;
+`install(scope, index, labels=...)` arms a crash for that scope alone.
+When it fires, `FailPointCrash` — a BaseException, like
+CancelledError — is raised at the fail point: it punches through the
+consensus receive-loop's `except Exception` containment and kills that
+node's consensus task mid-commit-sequence, which is as close to
+os._exit as an in-process node can get.  The simnet harness observes
+the dead task and restarts the node with WAL replay.
+
+Call sites may pass a `label` (e.g. "commit-before-save") so a scoped
+install can target one specific site instead of a raw call index; the
+env path ignores labels entirely (reference fail.Fail has none).
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
 
 _counter = 0
+
+# scoped in-process fail points: scope -> (index, labels|None, raised flag)
+_scoped: dict[str, dict] = {}
+_scope_var: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "tm_tpu_fail_scope", default="")
+
+
+class FailPointCrash(BaseException):
+    """Simulated hard crash of one in-process node.  BaseException so it
+    escapes the consensus loop's bad-peer-input containment (`except
+    Exception`) exactly like a real crash escapes everything."""
+
+    def __init__(self, scope: str, index: int, label: str):
+        super().__init__(f"fail point {index} ({label or 'unlabeled'}) "
+                         f"in scope {scope!r}")
+        self.scope = scope
+        self.index = index
+        self.label = label
 
 
 def fail_index() -> int | None:
@@ -25,20 +64,66 @@ def fail_index() -> int | None:
         return None
 
 
-def fail_point() -> None:
-    """Exit the process if the configured fail index is reached
-    (reference fail.Fail, instrumented through the commit sequence at
-    consensus/state.go:1524,1538,1559,1577,1595 and :747)."""
+def set_scope(name: str) -> contextvars.Token:
+    """Bind the current context (and every task later created under it)
+    to fail-point scope `name`.  Returns a token for reset_scope."""
+    return _scope_var.set(name)
+
+
+def reset_scope(token: contextvars.Token) -> None:
+    _scope_var.reset(token)
+
+
+def current_scope() -> str:
+    return _scope_var.get()
+
+
+def install(scope: str, index: int, labels=None) -> None:
+    """Arm an in-process crash for `scope`: the index-th fail_point call
+    (counted within the scope, over calls matching `labels` when given)
+    raises FailPointCrash.  Re-installing resets the scope's counter."""
+    _scoped[scope] = {
+        "index": index,
+        "labels": frozenset(labels) if labels else None,
+        "count": 0,
+    }
+
+
+def uninstall(scope: str) -> None:
+    _scoped.pop(scope, None)
+
+
+def installed(scope: str) -> bool:
+    return scope in _scoped
+
+
+def fail_point(label: str = "") -> None:
+    """Crash here if armed — by env index (process mode, os._exit) or by
+    a scoped install (in-process mode, raises FailPointCrash).
+    Reference fail.Fail, instrumented through the commit sequence at
+    consensus/state.go:1524,1538,1559,1577,1595 and :747."""
     global _counter
     idx = fail_index()
-    if idx is None:
-        return
-    if _counter == idx:
-        os.write(2, f"FAIL_POINT triggered at index {idx}\n".encode())
-        os._exit(13)
-    _counter += 1
+    if idx is not None:
+        if _counter == idx:
+            os.write(2, f"FAIL_POINT triggered at index {idx}\n".encode())
+            os._exit(13)
+        _counter += 1
+    scope = _scope_var.get()
+    if scope:
+        armed = _scoped.get(scope)
+        if armed is not None and (armed["labels"] is None
+                                  or label in armed["labels"]):
+            count = armed["count"]
+            armed["count"] = count + 1
+            if count == armed["index"]:
+                # disarm before raising: the restarted node must not
+                # crash again at the same point
+                _scoped.pop(scope, None)
+                raise FailPointCrash(scope, count, label)
 
 
 def reset() -> None:
     global _counter
     _counter = 0
+    _scoped.clear()
