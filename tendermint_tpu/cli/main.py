@@ -849,6 +849,92 @@ def cmd_lint(args) -> int:
                    rules=args.rules, list_rules=args.list_rules)
 
 
+def cmd_warm(args) -> int:
+    """Ahead-of-time shape-plan warming (docs/tpu-verifier.md "AOT and
+    warming"): compile every (kind, rung, impl) in the plan with
+    jit().lower().compile(), serialize the executables where this jax
+    supports it, and save the plan next to the persistent compile cache
+    — so a restarted node/bench reaches full verify throughput in
+    seconds and records zero cold-compile events.  Exit 0 = every entry
+    warmed, 1 = some entries errored, 2 = usage error."""
+    import json as _json
+
+    from tendermint_tpu.ops import shape_plan
+
+    if args.plan and args.rungs:
+        print("--plan and --rungs are mutually exclusive", file=sys.stderr)
+        return 2
+    try:
+        if args.plan:
+            plan = shape_plan.load_plan(args.plan)
+        elif args.rungs:
+            plan = shape_plan.ShapePlan(
+                [int(x) for x in args.rungs.split(",") if x.strip()],
+                name="cli-rungs")
+        else:
+            stats = None
+            if args.stats:
+                with open(args.stats) as fh:
+                    stats = _json.load(fh)
+            plan = shape_plan.plan_for_warm(stats)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"could not resolve a shape plan: {e}", file=sys.stderr)
+        return 2
+    impls = tuple(x.strip() for x in args.impls.split(",") if x.strip()) or None
+    kinds = tuple(x.strip() for x in args.kinds.split(",") if x.strip()) or None
+
+    if args.dry_run:
+        report = {
+            "plan": plan.to_dict(),
+            "max_padding": round(plan.max_padding(), 4),
+            "dry_run": True,
+            "entries": [{"kind": k, "rung": r, "impl": i, "source": "dry-run"}
+                        for k, r, i in plan.entries(kinds=kinds, impls=impls)],
+            "plan_path": shape_plan.plan_path(),
+            "aot_dir": shape_plan.aot_dir(),
+        }
+    else:
+        import jax
+
+        from tendermint_tpu.utils import jaxcache
+
+        jaxcache.enable(jax)
+        report = shape_plan.warm_plan(plan, kinds=kinds, impls=impls,
+                                      serialize=not args.no_serialize,
+                                      save=not args.no_save)
+
+    if args.json:
+        print(_json.dumps(report))
+    else:
+        p = report["plan"]
+        print(f"shape plan {p['name']!r}: {len(p['rungs'])} rungs "
+              f"({p['rungs'][0]}..{p['rungs'][-1]}), "
+              f"impls={','.join(impls or p['impls'])} "
+              f"kinds={','.join(kinds or p['kinds'])} "
+              f"max_padding={report['max_padding']}x")
+        for e in report["entries"]:
+            extra = ""
+            if e.get("serialized"):
+                extra = f"  serialized {e.get('serialized_bytes', 0)}B"
+            elif e.get("serialized") is False:
+                extra = "  (persistent-cache only)"
+            if e.get("error"):
+                extra = f"  ERROR: {e['error']}"
+            print(f"  {e['kind']:>6} r{e['rung']:<6} {e['impl']:<6} "
+                  f"{e['source']:<12} {e.get('seconds', 0.0):7.2f}s{extra}")
+        if report.get("dry_run"):
+            print(f"dry run — nothing compiled; plan would save to "
+                  f"{report['plan_path']}")
+        else:
+            srcs = " ".join(f"{k}={v}"
+                            for k, v in sorted(report["sources"].items()))
+            print(f"warmed {len(report['entries'])} programs in "
+                  f"{report['seconds_total']}s: {srcs}"
+                  + (f"; plan saved to {report['plan_path']}"
+                     if "plan_path" in report else ""))
+    return 1 if any(e.get("error") for e in report["entries"]) else 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -977,6 +1063,37 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="emit the snapshot as JSON (implies one frame)")
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser(
+        "warm",
+        help="AOT-compile the verify shape plan so restarts skip the "
+             "compile tax (serializes executables + plan next to the "
+             "persistent cache)")
+    sp.add_argument("--plan", default="",
+                    help="shape-plan JSON file (default: TM_TPU_RUNGS / "
+                         "TM_TPU_SHAPE_PLAN / the saved plan / the "
+                         "consolidated ladder)")
+    sp.add_argument("--rungs", default="",
+                    help="comma-separated rung override, e.g. 8,64,1024")
+    sp.add_argument("--impls", default="",
+                    help="comma-separated field impls (default: the plan's)")
+    sp.add_argument("--kinds", default="",
+                    help="comma-separated program kinds: verify,rlc "
+                         "(default: the plan's)")
+    sp.add_argument("--stats", default="",
+                    help="devmon device_stats() JSON to tune the "
+                         "consolidated ladder (keeps hot exact-fit rungs)")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the warm report as one JSON object")
+    sp.add_argument("--dry-run", dest="dry_run", action="store_true",
+                    help="resolve and print the plan without compiling")
+    sp.add_argument("--no-serialize", dest="no_serialize",
+                    action="store_true",
+                    help="warm the persistent cache only; write no "
+                         "serialized executables")
+    sp.add_argument("--no-save", dest="no_save", action="store_true",
+                    help="do not save the plan next to the cache")
+    sp.set_defaults(fn=cmd_warm)
 
     sp = sub.add_parser("lint", help="repo-aware static analysis (tmlint)")
     sp.add_argument("paths", nargs="*",

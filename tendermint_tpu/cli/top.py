@@ -95,7 +95,7 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
                    "occupancy": {}, "padding_rows_total": None,
                    "transfer_bytes_total": None},
         "compile": {"total": 0, "seconds_total": 0.0, "recompiles": 0,
-                    "by_rung": {}},
+                    "by_rung": {}, "sources": {}},
         "device_memory": [],
         "errors": [],
     }
@@ -198,12 +198,20 @@ def _fold_metrics(snap: dict, by_name: dict) -> None:
 
     comp = snap["compile"]
     by_rung = {}
+    sources = {}
     total = 0
     for labels, v in by_name.get("tendermint_crypto_jit_compile_total", []):
+        # samples are per (rung, impl, source): fold sources into the
+        # per-rung view, and keep the source totals as the warm-state
+        # summary (cold=0 is the post-warm health check)
         key = f"{labels.get('rung', '?')}/{labels.get('impl', '?')}"
-        by_rung[key] = int(v)
+        by_rung[key] = by_rung.get(key, 0) + int(v)
+        src = labels.get("source")
+        if src:
+            sources[src] = sources.get(src, 0) + int(v)
         total += int(v)
     comp["by_rung"] = by_rung
+    comp["sources"] = sources
     comp["total"] = total
     comp["seconds_total"] = round(sum(
         v for _l, v in by_name.get(
@@ -276,9 +284,17 @@ def render(snap: dict) -> str:
         f"padding    rows {_v(verify['padding_rows_total'])}"
         f"  transfer {_fmt_bytes(verify['transfer_bytes_total'])}")
     ctxt = "  ".join(f"{k}:{v}" for k, v in sorted(comp["by_rung"].items()))
+    # warm-state at a glance: where the programs came from — a warmed
+    # node shows aot/deserialized/persistent-cache and cold:0
+    srcs = comp.get("sources") or {}
+    stxt = "  ".join(f"{k}:{v}" for k, v in sorted(srcs.items()))
+    warm = ("warm" if srcs and not srcs.get("cold")
+            else "COLD-COMPILING" if srcs.get("cold") else "-")
     lines.append(
         f"compile    {comp['total']} programs  {comp['seconds_total']}s"
-        f"  recompiles {comp['recompiles']}" + (f"  [{ctxt}]" if ctxt else ""))
+        f"  recompiles {comp['recompiles']}  state {warm}"
+        + (f"  [{stxt}]" if stxt else "")
+        + (f"  [{ctxt}]" if ctxt else ""))
     if snap["device_memory"]:
         for e in snap["device_memory"]:
             detail = "  ".join(

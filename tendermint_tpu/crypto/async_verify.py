@@ -215,6 +215,20 @@ class VerifyService:
             self._jax_bv = _batch.JAXBatchVerifier(cpu_threshold=cpu_threshold)
         except Exception:  # noqa: BLE001 — no jax: host-only service
             self._jax_bv = None
+        # AOT warm-on-start (ops/shape_plan, ISSUE 7): if an operator
+        # ran `tendermint-tpu warm` (a saved plan exists next to the
+        # compile cache), deserialize/compile its executables on a
+        # daemon thread NOW so the first real flush finds warm programs
+        # instead of paying the ~100 s relay inline.  Strict no-op
+        # otherwise, and TM_TPU_AOT=0 kills it; a wedged tunnel wedges
+        # only the warm thread (same contract as start_device_warmup).
+        if self._jax_bv is not None:
+            try:
+                from tendermint_tpu.ops import shape_plan as _sp
+
+                _sp.start_background_warm("verify-service-start")
+            except Exception:  # noqa: BLE001 — warm is best-effort
+                pass
 
     # -- submission (caller side; never blocks) -----------------------
 

@@ -167,6 +167,14 @@ def start_device_warmup() -> None:
             ok = dev.verify_batch(pubs, msgs, sigs)
             if all(bool(v) for v in ok):
                 _DEVICE_READY.set()
+                # device proven answering: warm the rest of the shape
+                # plan's rungs in the background (ops/shape_plan) so
+                # steady-state buckets are compiled before traffic
+                # needs them — no-op unless `tendermint-tpu warm`
+                # saved a plan, killed by TM_TPU_AOT=0
+                from tendermint_tpu.ops import shape_plan as _sp
+
+                _sp.start_background_warm("device-warmup")
         except Exception:  # noqa: BLE001 — not-ready routes to host
             pass
 

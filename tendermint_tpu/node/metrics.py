@@ -229,7 +229,9 @@ class NodeMetrics:
 
         self.jit_compiles = reg.register(LabeledCallbackGauge(
             "jit_compile_total",
-            "JIT programs compiled (first call per bucket rung), by rung/impl",
+            "JIT programs made ready, by rung/impl/source (source: "
+            "aot | deserialized | persistent-cache | cold — a warmed "
+            "deployment keeps source=\"cold\" at zero)",
             namespace=ns, subsystem="crypto", kind="counter",
             fn=lambda: _dm.TRACKER.compile_count_samples(),
         ))

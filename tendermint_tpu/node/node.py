@@ -440,6 +440,23 @@ class Node:
                 linger_ms=svc.linger_s * 1e3,
                 cache_entries=svc.cache.maxsize,
             )
+        # shape-plan AOT warm (ISSUE 7): when the operator ran
+        # `tendermint-tpu warm`, load/compile its executables on a
+        # daemon thread now — a cold node reaches full verify
+        # throughput in seconds instead of paying first-call compiles
+        # per bucket.  Device contact stays OFF the event loop and off
+        # this thread: start_background_warm only spawns the worker (a
+        # wedged tunnel wedges the worker alone), and it is a strict
+        # no-op without a saved plan or with TM_TPU_AOT=0.
+        try:
+            from tendermint_tpu.ops import shape_plan as _sp
+
+            if await asyncio.to_thread(_sp.start_background_warm,
+                                       "node-start"):
+                self.logger.info("shape-plan AOT warm started",
+                                 plan=_sp.plan_path())
+        except Exception:  # noqa: BLE001 — warm is best-effort
+            pass
         if self._pv_remote == "socket":
             # block until the remote signer dials in and the pubkey primes
             await asyncio.to_thread(self.priv_validator.wait_for_signer, 30.0)

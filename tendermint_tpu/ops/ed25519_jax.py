@@ -30,10 +30,15 @@ Field backends (TM_TPU_FIELD_IMPL, or the `impl=` argument):
 The curve/scalar pipeline below is field-agnostic; both backends share it and
 both are differentially tested against the pure ZIP-215 reference.
 
-Static batch sizes: inputs are padded to a bucket ladder (powers of two up
-to 64, then 3*2^(k-1) interleaved: 96, 128, 192, ...) so XLA compiles one
-program per bucket (first call per bucket pays compile; consensus reuses
-steady-state buckets) with measured worst-case padding 1.49x (n=129→192;
+Static batch sizes: inputs are padded to a bucket ladder — the ACTIVE
+shape plan (ops/shape_plan.py; default: the formula ladder of powers of
+two up to 64, then 3*2^(k-1) interleaved: 96, 128, 192, ...) so XLA
+compiles one program per bucket.  Programs compile lazily on first call
+OR ahead of time: `tendermint-tpu warm` / the shape plan's background
+warm pre-builds (and serializes) every plan rung's executable, so a warm
+node never pays a first-call compile (first call per bucket pays compile
+otherwise; consensus reuses steady-state buckets) with measured
+worst-case padding 1.49x (n=129→192;
 <=1.34x for n>=321 — ADVICE r5: the 1.33x previously stated here holds
 only above the 320 rung); batches over TM_TPU_CHUNK dispatch as a
 pipeline of sub-batches (host prep overlaps device execution — see
@@ -500,6 +505,87 @@ def _verify_core(pub_rows, r_rows, s_rows, k_rows, valid):
     return _core(default_impl()).verify_core(pub_rows, r_rows, s_rows, k_rows, valid)
 
 
+# Donated input buffers (ISSUE 7): donate_argnums on the row arrays lets
+# XLA reuse the freshly-transferred input buffers as scratch/output
+# instead of defensively copying them on device — dropping the
+# steady-state 129 B/row on-device copy devmon measured.  CAVEAT (also
+# docs/tpu-verifier.md): a DEVICE array passed to a donating program is
+# deleted by the call — callers that re-dispatch pre-placed inputs must
+# re-place them (bench's device-only stage does); the production paths
+# all ship fresh numpy rows per flush, which donation cannot invalidate.
+# Resolved lazily, never at import (tmlint import-time-env): "auto"
+# donates only where the backend implements it (not XLA-CPU, which would
+# warn per dispatch AND change the persistent-cache key of every tier-1
+# program).
+_DONATE: bool | None = None
+_DONATE_ARGNUMS = (0, 1, 2, 3)  # the packed row arrays; `valid` stays
+
+
+def donate_rows() -> bool:
+    global _DONATE
+    if _DONATE is None:
+        mode = os.environ.get("TM_TPU_DONATE", "auto")
+        if mode == "1":
+            donate = True
+        elif mode == "0":
+            donate = False
+        else:
+            try:
+                donate = jax.default_backend() != "cpu"
+            except Exception:  # noqa: BLE001 — no backend: nothing to donate
+                donate = False
+        if donate:
+            import warnings
+
+            # shapes here rarely alias (bool verdicts vs u8 rows), and
+            # jax warns per compile when a donated buffer goes unused;
+            # the donation is still worth it where XLA can take it
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+        _DONATE = donate
+    return _DONATE
+
+
+def reload_env() -> None:
+    """Drop lazily-resolved env state (TM_TPU_DONATE) so the next call
+    re-reads the environment — same contract as crypto.batch.reload_env."""
+    global _DONATE
+    _DONATE = None
+
+
+def _jit_for(kind: str, impl: str, *, base_mxu: bool = False,
+             reduce_lanes: int | None = None, donate: bool | None = None):
+    """The raw jax.jit for one (kind, impl, flags) — shared by the lazy
+    _compiled*/ caches below and the AOT shape-plan compiler
+    (ops/shape_plan.py), so ahead-of-time executables and first-call
+    jits have IDENTICAL call conventions, donation included.
+
+    Named wrappers, NOT functools.partial: jit derives the HLO module
+    name from __name__, and the persistent compile cache keys on it —
+    a partial would rename every program and cold-recompile the world."""
+    core = _core(impl)
+    if donate is None:
+        donate = donate_rows()
+    if kind == "rlc":
+        lanes = reduce_lanes if reduce_lanes is not None else 2048
+
+        def verify_core_rlc(pub_rows, r_rows, zk_rows, z_rows, valid):
+            return core.verify_core_rlc(pub_rows, r_rows, zk_rows, z_rows,
+                                        valid, reduce_lanes=lanes)
+
+        fn = verify_core_rlc
+    elif kind == "verify":
+        def verify_core(pub_rows, r_rows, s_rows, k_rows, valid):
+            return core.verify_core(pub_rows, r_rows, s_rows, k_rows, valid,
+                                    base_mxu=base_mxu)
+
+        fn = verify_core
+    else:
+        raise ValueError(f"unknown jit kind {kind!r}")
+    kw = {"donate_argnums": _DONATE_ARGNUMS} if donate else {}
+    return jax.jit(fn, **kw)
+
+
 @functools.cache
 def _compiled(n: int, impl: str | None = None, base_mxu: bool = False):
     # NOTE: callers that care about TM_TPU_FIELD_IMPL changing mid-process
@@ -507,20 +593,28 @@ def _compiled(n: int, impl: str | None = None, base_mxu: bool = False):
     # resolves once per (n, None) cache entry.  base_mxu is part of the
     # cache key because it is baked into the trace.
     impl_r = impl or default_impl()
-    core = _core(impl_r)
+    donate = donate_rows()
 
-    # a named wrapper, NOT functools.partial: jit derives the HLO module
-    # name from __name__, and the persistent compile cache keys on it —
-    # a partial would rename every program and cold-recompile the world
-    def verify_core(pub_rows, r_rows, s_rows, k_rows, valid):
-        return core.verify_core(pub_rows, r_rows, s_rows, k_rows, valid,
-                                base_mxu=base_mxu)
+    # AOT first (ops/shape_plan): an executable warmed ahead of time —
+    # `tendermint-tpu warm`, service/node start, or the bench warm
+    # stages — is handed out directly; its compile event (source aot/
+    # deserialized) was recorded by the warm path, so the proxy is
+    # prerecorded and the steady state records nothing.
+    from . import shape_plan as _plan
+
+    entry = _plan.aot_lookup("verify", n, impl_r, base_mxu=base_mxu,
+                             donate=donate)
+    if entry is not None:
+        return _devmon.track_jit(entry.executable, kind="verify",
+                                 impl=impl_r, rung=n, prerecorded=True,
+                                 base_mxu=base_mxu)
 
     # compile tracking (utils/devmon): the first call per cache entry is
     # the one that pays trace+compile; re-tracing the same key after a
     # cache_clear is the unexpected-recompile the tracker warns about
-    return _devmon.track_jit(jax.jit(verify_core), kind="verify",
-                             impl=impl_r, rung=n, base_mxu=base_mxu)
+    return _devmon.track_jit(
+        _jit_for("verify", impl_r, base_mxu=base_mxu, donate=donate),
+        kind="verify", impl=impl_r, rung=n, base_mxu=base_mxu)
 
 
 def rlc_reduce_lanes() -> int:
@@ -536,16 +630,18 @@ def rlc_reduce_lanes() -> int:
 @functools.cache
 def _compiled_rlc(n: int, impl: str, reduce_lanes: int = 2048):
     # reduce_lanes is baked into the trace -> part of the cache key.
-    # Named wrapper (not partial) to keep the HLO module name stable —
-    # see _compiled.
-    core = _core(impl)
+    donate = donate_rows()
+    from . import shape_plan as _plan
 
-    def verify_core_rlc(pub_rows, r_rows, zk_rows, z_rows, valid):
-        return core.verify_core_rlc(pub_rows, r_rows, zk_rows, z_rows,
-                                    valid, reduce_lanes=reduce_lanes)
-
-    return _devmon.track_jit(jax.jit(verify_core_rlc), kind="rlc",
-                             impl=impl, rung=n, reduce_lanes=reduce_lanes)
+    entry = _plan.aot_lookup("rlc", n, impl, reduce_lanes=reduce_lanes,
+                             donate=donate)
+    if entry is not None:
+        return _devmon.track_jit(entry.executable, kind="rlc", impl=impl,
+                                 rung=n, prerecorded=True,
+                                 reduce_lanes=reduce_lanes)
+    return _devmon.track_jit(
+        _jit_for("rlc", impl, reduce_lanes=reduce_lanes, donate=donate),
+        kind="rlc", impl=impl, rung=n, reduce_lanes=reduce_lanes)
 
 
 # ---------------------------------------------------------------------------
@@ -616,8 +712,8 @@ def prepare_batch(pubs, msgs, sigs):
     return pub_rows, r_rows, s_rows, k_rows, valid
 
 
-def _bucket(n: int) -> int:
-    """Smallest compiled bucket >= n: powers of two up to 64, then
+def _ladder_bucket(n: int) -> int:
+    """The built-in FORMULA ladder: powers of two up to 64, then
     3*2^(k-1) rungs interleaved (96, 128, 192, ...), then 5*2^(k-2)
     rungs too from 320 up (320, 384, 512, 640, 768, 1024, ...).
     Measured worst-case padding over the device-eligible range
@@ -627,7 +723,10 @@ def _bucket(n: int) -> int:
     north-star 10,000-sig commit runs the 10,240 bucket (1.024x padded)
     instead of 16,384 (1.64x) — VERDICT r4 item 2.  Each bucket
     compiles once (persistent XLA cache); steady-state consensus reuses
-    a handful."""
+    a handful.
+
+    This is the DEFAULT shape plan ("legacy") and the above-the-plan
+    fallback; production bucketing goes through _bucket below."""
     b = 8
     while b < n:
         if b >= 256 and 5 * (b // 4) >= n:
@@ -636,6 +735,18 @@ def _bucket(n: int) -> int:
             return 3 * (b // 2)
         b *= 2
     return b
+
+
+def _bucket(n: int) -> int:
+    """Smallest compiled bucket >= n under the ACTIVE shape plan
+    (ops/shape_plan.py).  The default plan IS _ladder_bucket's formula
+    ladder — bit-identical behavior until an operator installs a
+    consolidated plan (`tendermint-tpu warm`, TM_TPU_SHAPE_PLAN,
+    TM_TPU_RUNGS); resolved per call so plan/env changes are honored
+    without re-imports."""
+    from . import shape_plan as _plan
+
+    return _plan.bucket(n)
 
 
 def _chunk_size() -> int:

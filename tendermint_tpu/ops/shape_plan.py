@@ -1,0 +1,630 @@
+"""Shape plan + ahead-of-time compilation for the verify pipeline.
+
+The verifier's dominant operational cost is no longer the kernel — it is
+XLA compilation: devmon measured a real 96.4 s COLD compile for a single
+n=16 bucket through this image's remote-compile relay (~100 s/program),
+and the lazy first-call-compiles design meant a cold node paid that tax
+at the worst moment: when the first commit arrived.  This module replaces
+lazy compilation with an explicit, serializable story in three parts:
+
+  * **ShapePlan** — the bucket ladder as DATA.  `bucket(n)` (the
+    module-level function) is what `ops.ed25519_jax._bucket` delegates
+    to; the ACTIVE plan resolves, per call, from
+      1. `TM_TPU_RUNGS`       comma-separated rung override,
+      2. `TM_TPU_SHAPE_PLAN`  "legacy" | "consolidated" | /path/to.json,
+      3. the plan saved next to the persistent compile cache by
+         `tendermint-tpu warm` (utils/jaxcache.plan_path()),
+      4. the built-in legacy formula ladder (bit-identical to the
+         historical `_bucket`, so nothing changes until an operator
+         opts in).
+    The consolidated plan is the ladder devmon's batch-occupancy
+    histograms argue for: fewer, larger rungs (20 programs to 20480 vs
+    27), dropping the rungs real runs never fill (16, 32, 320, 640,
+    1280, 2560, 5120) while keeping the measured padding bound <= 1.5x
+    over the device-eligible sweep n in [65, 20000] and keeping 10240
+    (the 10k-commit north star runs at 1.024x padded).
+  * **AOT compilation** — `warm_entry`/`warm_rungs`/`warm_plan` build
+    executables with `jit(...).lower().compile()` for every
+    (kind, rung, impl, flags) in the plan, BEFORE traffic needs them,
+    and register them so `ops.ed25519_jax._compiled`/`_compiled_rlc`
+    hand them straight out.  Where `jax.experimental
+    .serialize_executable` exists the compiled artifact is also written
+    to disk (utils/jaxcache.aot_dir()) and later starts deserialize it
+    in well under a second; where it does not, the compile itself warms
+    the persistent cache — either way a restart skips the relay.
+  * **Warm-on-start** — `start_background_warm()` is wired into the
+    async-verify service, `crypto.batch.start_device_warmup`, and node
+    start.  It is a strict opt-in: it does nothing unless a saved plan
+    exists (an operator ran `tendermint-tpu warm` at least once) and
+    `TM_TPU_AOT` != "0", and it runs on a daemon thread so a wedged
+    device tunnel wedges only the warm thread, never the caller — the
+    same degradation philosophy as `crypto.batch._DEVICE_READY`.
+
+Compile provenance: every warm records a devmon compile event with
+`source` = "aot" (compiled here, ahead of traffic) or "deserialized"
+(loaded from a serialized executable); the lazy path's events classify
+as "persistent-cache" or "cold" by the duration heuristic.  A post-warm
+run therefore proves itself: `jit_compile_total{source="cold"}` == 0.
+
+Sharded-mesh caveat: `parallel.sharding` pads buckets to a multiple of
+the mesh size, so on meshes whose device count does not divide the plan
+rungs (3/5/6-device meshes) the effective flush shape can fall outside
+the plan; every plan rung here is a multiple of 8, covering the 1/2/4/8
+meshes the harness runs.  The sharded jits themselves are not AOT'd
+(serialized executables are topology-bound).
+"""
+
+from __future__ import annotations
+
+import bisect
+import functools
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+
+_log = logging.getLogger("tendermint_tpu.shape_plan")
+
+PLAN_VERSION = 1
+
+# Device-eligible range the padding bound is measured over (the
+# `_bucket` docstring's historical exhaustive sweep).
+PADDING_SWEEP = (65, 20_000)
+MAX_PADDING = 1.5
+
+# Materialize the legacy formula ladder up to here; beyond it (rare,
+# compiles lazily) every plan falls back to the formula.
+LADDER_TOP = 20_480
+
+# The consolidated ladder: every step ratio <= 1.5 from the 64 floor up,
+# so padding for n in (r_k, r_{k+1}] is r_{k+1}/(r_k+1) <= 1.5 —
+# worst case 6144/4097 = 1.4996.  10240 stays (10k commit at 1.024x);
+# 8/64 stay (warmup, threshold probes, and the coalescing floor).
+CONSOLIDATED_RUNGS = (
+    8, 64, 96, 128, 192, 256, 384, 512, 768, 1024, 1536, 2048,
+    3072, 4096, 6144, 8192, 10240, 12288, 16384, 20480,
+)
+
+DEFAULT_IMPLS = ("int64",)
+DEFAULT_KINDS = ("verify",)
+
+
+def _ladder_bucket(n: int) -> int:
+    from tendermint_tpu.ops.ed25519_jax import _ladder_bucket as lb
+
+    return lb(n)
+
+
+class ShapePlan:
+    """An explicit bucket ladder: sorted rungs plus the (impls, kinds)
+    the warm path compiles for.  Pure data — JSON round-trips."""
+
+    __slots__ = ("name", "rungs", "impls", "kinds")
+
+    def __init__(self, rungs, *, impls=DEFAULT_IMPLS, kinds=DEFAULT_KINDS,
+                 name: str = "custom"):
+        rs = sorted({int(r) for r in rungs})
+        if not rs or rs[0] < 1:
+            raise ValueError(f"shape plan needs positive rungs, got {rungs!r}")
+        self.rungs = tuple(rs)
+        self.impls = tuple(impls)
+        self.kinds = tuple(kinds)
+        self.name = name
+
+    @property
+    def top(self) -> int:
+        return self.rungs[-1]
+
+    def bucket(self, n: int) -> int:
+        """Smallest plan rung >= n; above the plan's top rung the legacy
+        formula ladder takes over so arbitrarily large batches still
+        bucket (they compile lazily — a plan bounds what warms, not what
+        runs)."""
+        if n <= self.rungs[0]:
+            return self.rungs[0]
+        i = bisect.bisect_left(self.rungs, n)
+        if i < len(self.rungs):
+            return self.rungs[i]
+        return max(_ladder_bucket(n), self.top)
+
+    def max_padding(self, lo: int | None = None, hi: int | None = None) -> float:
+        """Worst-case bucket(n)/n over the device-eligible sweep
+        (exhaustive, like the `_bucket` docstring's [65, 20000])."""
+        lo = PADDING_SWEEP[0] if lo is None else lo
+        hi = PADDING_SWEEP[1] if hi is None else hi
+        worst = 1.0
+        for i in range(bisect.bisect_left(self.rungs, lo), len(self.rungs)):
+            # per covered interval (prev, rung] the worst n is prev+1
+            prev = self.rungs[i - 1] if i else 0
+            n = max(lo, prev + 1)
+            if n > hi:
+                break
+            worst = max(worst, self.rungs[i] / n)
+        if hi > self.top:
+            # formula-ladder tail: the legacy ladder's own bound holds
+            n = self.top + 1
+            worst = max(worst, _ladder_bucket(n) / n)
+        return worst
+
+    def entries(self, kinds=None, impls=None):
+        """[(kind, rung, impl)] the warm path compiles."""
+        out = []
+        for kind in (kinds or self.kinds):
+            for impl in (impls or self.impls):
+                for rung in self.rungs:
+                    out.append((kind, rung, impl))
+        return out
+
+    def to_dict(self) -> dict:
+        return {"version": PLAN_VERSION, "name": self.name,
+                "rungs": list(self.rungs), "impls": list(self.impls),
+                "kinds": list(self.kinds)}
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ShapePlan":
+        if int(doc.get("version", 1)) > PLAN_VERSION:
+            raise ValueError(f"shape plan version {doc.get('version')} "
+                             f"is newer than this build ({PLAN_VERSION})")
+        return cls(doc["rungs"],
+                   impls=tuple(doc.get("impls") or DEFAULT_IMPLS),
+                   kinds=tuple(doc.get("kinds") or DEFAULT_KINDS),
+                   name=str(doc.get("name", "custom")))
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=1)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ShapePlan":
+        return cls.from_dict(json.loads(text))
+
+
+@functools.lru_cache(maxsize=1)
+def _legacy_rungs() -> tuple:
+    return tuple(sorted({_ladder_bucket(n) for n in range(1, LADDER_TOP + 1)}))
+
+
+def legacy_plan() -> ShapePlan:
+    """The historical formula ladder as a plan — the default, so
+    behavior is bit-identical until an operator installs another plan."""
+    return ShapePlan(_legacy_rungs(), name="legacy")
+
+
+def consolidated_plan(device_stats: dict | None = None) -> ShapePlan:
+    """The consolidated ladder, optionally tuned by a devmon
+    `device_stats()` snapshot: rungs the workload already fills well
+    (>= 0.9 mean occupancy over >= 2 flushes) are exact fits whose
+    removal would push those flushes a rung up, so they are kept even
+    when the base ladder dropped them."""
+    rungs = set(CONSOLIDATED_RUNGS)
+    for cell in (device_stats or {}).get("rungs", []):
+        try:
+            if (cell.get("flushes", 0) >= 2
+                    and cell.get("mean_occupancy", 0.0) >= 0.9):
+                rungs.add(int(cell["rung"]))
+        except (TypeError, ValueError):
+            continue
+    return ShapePlan(sorted(rungs), name="consolidated")
+
+
+# ---------------------------------------------------------------------------
+# Active-plan resolution (per-call env, never at import — tmlint
+# import-time-env is exactly the footgun here)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: ShapePlan | None = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def plan_path() -> str:
+    from tendermint_tpu.utils import jaxcache
+
+    return jaxcache.plan_path()
+
+
+def aot_dir() -> str:
+    from tendermint_tpu.utils import jaxcache
+
+    return jaxcache.aot_dir()
+
+
+def load_plan(path: str) -> ShapePlan:
+    with open(path) as fh:
+        return ShapePlan.from_json(fh.read())
+
+
+def save_plan(plan: ShapePlan, path: str | None = None) -> str:
+    path = path or plan_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(plan.to_json())
+    os.replace(tmp, path)
+    return path
+
+
+def _resolve_explicit_plan() -> ShapePlan | None:
+    raw = os.environ.get("TM_TPU_RUNGS", "")
+    if raw:
+        try:
+            return ShapePlan([int(x) for x in raw.split(",") if x.strip()],
+                             name="env-rungs")
+        except ValueError:
+            _log.warning("ignoring malformed TM_TPU_RUNGS=%r", raw)
+    sel = os.environ.get("TM_TPU_SHAPE_PLAN", "")
+    if sel == "legacy":
+        return legacy_plan()
+    if sel == "consolidated":
+        return consolidated_plan()
+    if sel:
+        try:
+            return load_plan(sel)
+        except (OSError, ValueError, KeyError) as e:
+            _log.warning("ignoring unreadable TM_TPU_SHAPE_PLAN=%r: %s",
+                         sel, e)
+    saved = plan_path()
+    if os.path.exists(saved):
+        try:
+            return load_plan(saved)
+        except (OSError, ValueError, KeyError) as e:
+            _log.warning("ignoring unreadable saved shape plan %s: %s",
+                         saved, e)
+    return None
+
+
+def _resolve_plan() -> ShapePlan:
+    return _resolve_explicit_plan() or legacy_plan()
+
+
+def active_plan() -> ShapePlan:
+    global _ACTIVE
+    p = _ACTIVE
+    if p is None:
+        with _ACTIVE_LOCK:
+            if _ACTIVE is None:
+                _ACTIVE = _resolve_plan()
+                if _ACTIVE.name != "legacy":
+                    _log.info("shape plan active: %s (%d rungs, top %d)",
+                              _ACTIVE.name, len(_ACTIVE.rungs), _ACTIVE.top)
+            p = _ACTIVE
+    return p
+
+
+def reload_plan() -> None:
+    """Drop the cached active plan so the next bucket() re-resolves the
+    environment/saved file (tests, `warm`, config reload)."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = None
+
+
+def bucket(n: int) -> int:
+    """Smallest compiled bucket >= n under the ACTIVE plan — the
+    function `ops.ed25519_jax._bucket` delegates to."""
+    return active_plan().bucket(n)
+
+
+def plan_for_warm(device_stats: dict | None = None) -> ShapePlan:
+    """The plan `tendermint-tpu warm` compiles when none is named: an
+    explicit env/saved plan wins (warm refreshes its artifacts);
+    otherwise the consolidated ladder — warming is the opt-in moment
+    where the fewer-larger-rungs tradeoff is taken."""
+    return _resolve_explicit_plan() or consolidated_plan(device_stats)
+
+
+# ---------------------------------------------------------------------------
+# AOT executable registry
+# ---------------------------------------------------------------------------
+
+class AotEntry:
+    __slots__ = ("executable", "source", "seconds")
+
+    def __init__(self, executable, source: str, seconds: float = 0.0):
+        self.executable = executable
+        self.source = source  # "aot" | "deserialized"
+        self.seconds = seconds
+
+
+_REGISTRY: dict[tuple, AotEntry] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _flag_key(flags: dict) -> tuple:
+    return tuple(sorted(flags.items()))
+
+
+def _reg_key(kind: str, rung: int, impl: str, flags: dict) -> tuple:
+    return (kind, int(rung), impl) + _flag_key(flags)
+
+
+def aot_lookup(kind: str, rung: int, impl: str, **flags) -> AotEntry | None:
+    """The pre-compiled executable for one jit cache key, or None —
+    consulted by ops.ed25519_jax._compiled/_compiled_rlc before they
+    build a lazy jit."""
+    with _REG_LOCK:
+        return _REGISTRY.get(_reg_key(kind, rung, impl, flags))
+
+
+def registry_snapshot() -> list[dict]:
+    with _REG_LOCK:
+        return [{"kind": k[0], "rung": k[1], "impl": k[2],
+                 "flags": dict(k[3:]), "source": e.source,
+                 "seconds": round(e.seconds, 3)}
+                for k, e in sorted(_REGISTRY.items(), key=lambda kv: kv[0][:3])]
+
+
+def clear_registry() -> None:
+    """Tests/benchmarks.  Callers holding a functools-cached _compiled
+    proxy keep it; only the NEXT cache build re-consults the registry."""
+    with _REG_LOCK:
+        _REGISTRY.clear()
+
+
+def _entry_flags(kind: str, impl: str) -> dict:
+    """The trace-time flags a production dispatch would resolve for this
+    (kind, impl) right now — the AOT executable must be compiled with
+    the SAME flags or the registry key will never match the runtime
+    lookup."""
+    from tendermint_tpu.ops import ed25519_jax as dev
+
+    if kind == "rlc":
+        return {"reduce_lanes": dev.rlc_reduce_lanes(),
+                "donate": dev.donate_rows()}
+    return {"base_mxu": dev._resolve_optin(impl),
+            "donate": dev.donate_rows()}
+
+
+def abstract_rows(kind: str, rung: int) -> tuple:
+    """jax.ShapeDtypeStruct argument shapes for one rung — what
+    `.lower()` traces against instead of concrete arrays."""
+    import numpy as np
+
+    import jax
+
+    u8row = jax.ShapeDtypeStruct((rung, 32), np.uint8)
+    valid = jax.ShapeDtypeStruct((rung,), np.bool_)
+    if kind == "rlc":
+        return (u8row, u8row, u8row,
+                jax.ShapeDtypeStruct((rung, 16), np.uint8), valid)
+    return (u8row, u8row, u8row, u8row, valid)
+
+
+def _aot_compile(kind: str, rung: int, impl: str, flags: dict):
+    """jit(...).lower().compile() for one plan entry; returns
+    (executable, wall_seconds).  Built through ed25519_jax._jit_for so
+    the call convention (donation included) matches the lazy path
+    exactly."""
+    from tendermint_tpu.ops import ed25519_jax as dev
+
+    kw = dict(flags)
+    donate = kw.pop("donate", None)
+    jitted = dev._jit_for(kind, impl, donate=donate, **kw)
+    t0 = time.perf_counter()
+    compiled = jitted.lower(*abstract_rows(kind, rung)).compile()
+    return compiled, time.perf_counter() - t0
+
+
+# -- serialized executables -------------------------------------------------
+#
+# Trust model: the aot dir lives next to the persistent compile cache
+# (utils/jaxcache — inside the repo tree or the per-user cache dir, never
+# a world-writable /tmp), and deserializing either one executes what the
+# directory owner planted; the pickle here adds no new exposure beyond
+# what jax's own compile cache already carries.
+
+def _dump_executable(compiled) -> bytes | None:
+    """Serialized form of a compiled executable, or None when this jax
+    cannot serialize (the compile still warmed the persistent cache —
+    the documented fallback).  XLA-CPU is excluded by measurement: its
+    JIT'd executables reference process-local symbols and deserialize to
+    "Symbols not found" in the next process, so on the cpu backend the
+    persistent cache IS the warm story."""
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return None
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = se.serialize(compiled)
+        return pickle.dumps((payload, in_tree, out_tree),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception as e:  # noqa: BLE001 — absent API, unpicklable tree
+        _log.info("executable serialization unavailable (%s); relying on "
+                  "the persistent compile cache", str(e)[:200])
+        return None
+
+
+def _load_executable(blob: bytes):
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = pickle.loads(blob)
+    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+def _aot_path(kind: str, rung: int, impl: str, flags: dict) -> str:
+    """Artifact path keyed on everything that makes an executable
+    non-portable: flags, jax version, backend platform, device count."""
+    import jax
+
+    sig = hashlib.sha256(repr((
+        kind, rung, impl, _flag_key(flags), jax.__version__,
+        jax.default_backend(), len(jax.devices()),
+    )).encode()).hexdigest()[:16]
+    return os.path.join(aot_dir(), f"{kind}_{impl}_r{rung}_{sig}.aotx")
+
+
+# ---------------------------------------------------------------------------
+# Warming
+# ---------------------------------------------------------------------------
+
+def warm_entry(kind: str, rung: int, impl: str, *, flags: dict | None = None,
+               serialize: bool = True, force: bool = False) -> dict:
+    """Make one (kind, rung, impl) executable hot: registry hit >
+    deserialize from disk > jit().lower().compile() (which also warms
+    the persistent cache), optionally serializing fresh compiles to
+    disk.  Records a devmon compile event with the true source."""
+    from tendermint_tpu.utils import devmon as _devmon
+
+    flags = dict(flags) if flags is not None else _entry_flags(kind, impl)
+    key = _reg_key(kind, rung, impl, flags)
+    with _REG_LOCK:
+        existing = _REGISTRY.get(key)
+    report = {"kind": kind, "rung": int(rung), "impl": impl,
+              "flags": {k: v for k, v in _flag_key(flags)}}
+    if existing is not None and not force:
+        report.update(source="registered", seconds=0.0, skipped=True)
+        return report
+
+    path = None
+    try:
+        path = _aot_path(kind, rung, impl, flags)
+    except Exception as e:  # noqa: BLE001 — no backend yet: compile decides
+        _log.info("aot artifact path unavailable: %s", e)
+
+    if path and os.path.exists(path) and not force:
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            t0 = time.perf_counter()
+            exe = _load_executable(blob)
+            dt = time.perf_counter() - t0
+            with _REG_LOCK:
+                _REGISTRY[key] = AotEntry(exe, "deserialized", dt)
+            _devmon.TRACKER.record(kind, rung, impl, _flag_key(flags), dt,
+                                   source="deserialized")
+            report.update(source="deserialized", seconds=round(dt, 3),
+                          path=path)
+            return report
+        except Exception as e:  # noqa: BLE001 — stale artifact: recompile
+            _log.warning("stale aot artifact %s (%s); recompiling",
+                         path, str(e)[:200])
+
+    exe, dt = _aot_compile(kind, rung, impl, flags)
+    with _REG_LOCK:
+        _REGISTRY[key] = AotEntry(exe, "aot", dt)
+    _devmon.TRACKER.record(kind, rung, impl, _flag_key(flags), dt,
+                           source="aot")
+    report.update(source="aot", seconds=round(dt, 3))
+    if serialize and path:
+        blob = _dump_executable(exe)
+        if blob is not None:
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "wb") as fh:
+                    fh.write(blob)
+                os.replace(tmp, path)
+                report.update(serialized=True, path=path,
+                              serialized_bytes=len(blob))
+            except OSError as e:
+                _log.warning("could not write aot artifact %s: %s", path, e)
+                report["serialized"] = False
+        else:
+            report["serialized"] = False  # persistent-cache warming only
+    return report
+
+
+def warm_rungs(*, kinds=DEFAULT_KINDS, rungs, impls=DEFAULT_IMPLS,
+               serialize: bool = True) -> list[dict]:
+    """Warm a specific (kinds x impls x rungs) grid; one report dict per
+    entry, failures recorded per entry instead of aborting the sweep
+    (one rung OOMing must not cost the others their warmth)."""
+    out = []
+    for kind in kinds:
+        for impl in impls:
+            for rung in rungs:
+                try:
+                    out.append(warm_entry(kind, rung, impl,
+                                          serialize=serialize))
+                except Exception as e:  # noqa: BLE001
+                    _log.warning("warm %s r%d %s failed: %s",
+                                 kind, rung, impl, e)
+                    out.append({"kind": kind, "rung": int(rung),
+                                "impl": impl, "source": "error",
+                                "seconds": 0.0, "error": str(e)[-300:]})
+    return out
+
+
+def warm_plan(plan: ShapePlan, *, kinds=None, impls=None,
+              serialize: bool = True, save: bool = True) -> dict:
+    """Warm every entry of a plan and (by default) save the plan next to
+    the compile cache so restarts — and start_background_warm — pick it
+    up.  Returns the full report `tendermint-tpu warm --json` prints."""
+    t0 = time.perf_counter()
+    entries = warm_rungs(kinds=kinds or plan.kinds, rungs=plan.rungs,
+                         impls=impls or plan.impls, serialize=serialize)
+    sources: dict[str, int] = {}
+    for e in entries:
+        sources[e["source"]] = sources.get(e["source"], 0) + 1
+    report = {
+        "plan": plan.to_dict(),
+        "max_padding": round(plan.max_padding(), 4),
+        "entries": entries,
+        "sources": sources,
+        "errors": sum(1 for e in entries if e.get("error")),
+        "seconds_total": round(time.perf_counter() - t0, 3),
+        "aot_dir": aot_dir(),
+    }
+    if save:
+        report["plan_path"] = save_plan(plan)
+        reload_plan()  # the saved plan is now the active one
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Warm-on-start (service / node / device-warmup wiring)
+# ---------------------------------------------------------------------------
+
+_BG_LOCK = threading.Lock()
+_BG_STARTED = False
+
+
+def aot_enabled() -> bool:
+    """TM_TPU_AOT kill switch, resolved per call (default on)."""
+    return os.environ.get("TM_TPU_AOT", "1") != "0"
+
+
+def start_background_warm(reason: str = "") -> bool:
+    """Warm the SAVED plan on a daemon thread (idempotent per process).
+
+    Strict opt-in: no saved plan (the operator never ran
+    `tendermint-tpu warm`) or TM_TPU_AOT=0 means no thread, no device
+    contact, nothing — so test suites and host-only deployments are
+    untouched.  With a saved plan, artifacts deserialize in well under a
+    second each and missing entries compile against the (warm)
+    persistent cache; either way the first real flush finds its program
+    ready instead of paying the ~100 s relay inline."""
+    global _BG_STARTED
+    if not aot_enabled():
+        return False
+    try:
+        path = plan_path()
+    except Exception:  # noqa: BLE001 — no cache dir resolvable
+        return False
+    if not os.path.exists(path):
+        return False
+    with _BG_LOCK:
+        if _BG_STARTED:
+            return False
+        _BG_STARTED = True
+
+    def _bg() -> None:
+        try:
+            plan = load_plan(path)
+            rep = warm_plan(plan, serialize=False, save=False)
+            _log.info(
+                "background AOT warm (%s) done: %d entries in %.1fs %s",
+                reason or "start", len(rep["entries"]),
+                rep["seconds_total"], rep["sources"])
+        except Exception as e:  # noqa: BLE001 — warm is best-effort
+            _log.warning("background AOT warm (%s) failed: %s",
+                         reason or "start", e)
+
+    threading.Thread(target=_bg, daemon=True, name="tm-aot-warm").start()
+    return True

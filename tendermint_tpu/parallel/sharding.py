@@ -48,10 +48,15 @@ def sharded_verify_fn(mesh: Mesh):
     batch2 = NamedSharding(mesh, P("batch", None))
     # (pub_rows, r_rows, s_rows, k_rows, valid) — packed [N,32] u8 + bool[N]
     in_sh = (batch2, batch2, batch2, batch2, batch)
+    # donated row buffers, same policy as the single-chip entry points
+    # (ops.ed25519_jax.donate_rows — off on XLA-CPU so cache keys and
+    # tier-1 behavior are unchanged there)
+    kw = {"donate_argnums": _dev._DONATE_ARGNUMS} if _dev.donate_rows() else {}
     # one jit compiles one program per input shape: rung=None tracks the
     # first call per leading-axis size (utils/devmon)
     return _devmon.track_jit(
-        jax.jit(_dev._verify_core, in_shardings=in_sh, out_shardings=batch),
+        jax.jit(_dev._verify_core, in_shardings=in_sh, out_shardings=batch,
+                **kw),
         kind="sharded_verify", impl=_dev.default_impl(),
         devices=int(mesh.devices.size))
 
@@ -80,6 +85,8 @@ def sharded_rlc_fn(mesh: Mesh, impl: str, reduce_lanes: int = 2048):
 
     core = verify_core_rlc
     b2 = P("batch", None)
+    # donated row buffers (see sharded_verify_fn)
+    kw = {"donate_argnums": _dev._DONATE_ARGNUMS} if _dev.donate_rows() else {}
     return _devmon.track_jit(
         jax.jit(
             shard_map(
@@ -87,7 +94,8 @@ def sharded_rlc_fn(mesh: Mesh, impl: str, reduce_lanes: int = 2048):
                 mesh=mesh,
                 in_specs=(b2, b2, b2, b2, P("batch")),
                 out_specs=((b2, b2, b2, b2), P("batch")),
-            )
+            ),
+            **kw,
         ),
         kind="sharded_rlc", impl=impl, devices=int(mesh.devices.size),
         reduce_lanes=reduce_lanes)

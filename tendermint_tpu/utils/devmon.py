@@ -43,7 +43,11 @@ microseconds; the relay compile is the ~100 s term).  Classification of
 persistent-cache hit vs cold compile is a duration heuristic
 (TM_TPU_COMPILE_COLD_S, default 5.0 s): a persisted program loads in
 well under a second while the relay compile is two orders of magnitude
-above the threshold.
+above the threshold.  Ahead-of-time programs (ops/shape_plan) are exempt
+from the heuristic: the warm path records their events with an explicit
+source ("aot" / "deserialized"), and `jit_compile_total` carries the
+source as a label so zero `source="cold"` after a warm is provable from
+/metrics alone.
 """
 
 from __future__ import annotations
@@ -156,7 +160,18 @@ class DeviceStats:
 class CompileTracker:
     """Records one event per (kind, rung, impl, flags) first call; a
     second recording of the same key (the functools.cache was cleared
-    and the program re-traced) is an unexpected recompile."""
+    and the program re-traced) is an unexpected recompile.
+
+    Every event carries a `source` — where the program came from:
+      * "aot"              compiled ahead of traffic (shape-plan warm)
+      * "deserialized"     loaded from a serialized executable artifact
+      * "persistent-cache" first-call compile that hit jax's persistent
+                           cache (duration heuristic, under
+                           TM_TPU_COMPILE_COLD_S)
+      * "cold"             a real compile — the ~100 s relay term a
+                           warmed deployment must never record
+    The warm paths (ops/shape_plan) pass their source explicitly; lazy
+    first calls classify by the duration heuristic."""
 
     def __init__(self, max_events: int = MAX_COMPILE_EVENTS):
         self._lock = threading.Lock()
@@ -164,6 +179,8 @@ class CompileTracker:
         self.events: deque = deque(maxlen=max_events)
         self.compiles: dict[tuple[str, str], int] = {}        # (rung, impl)
         self.compile_seconds: dict[tuple[str, str], float] = {}
+        # (rung, impl, source) -> count; feeds jit_compile_total{source=}
+        self.source_counts: dict[tuple[str, str, str], int] = {}
         self.recompiles = 0
 
     def _begin(self, proxy: "_TrackedJit", rung: int) -> bool:
@@ -176,9 +193,12 @@ class CompileTracker:
             return True
 
     def record(self, kind: str, rung: int, impl: str, flags: tuple,
-               duration_s: float) -> None:
+               duration_s: float, source: str | None = None) -> None:
+        if source is None:
+            source = ("persistent-cache"
+                      if duration_s < _cold_compile_threshold_s() else "cold")
+        cache_hit = source != "cold"
         key = (kind, rung, impl) + flags
-        cache_hit = duration_s < _cold_compile_threshold_s()
         with self._lock:
             recompile = key in self._keys
             self._keys[key] = self._keys.get(key, 0) + 1
@@ -186,6 +206,8 @@ class CompileTracker:
             self.compiles[ck] = self.compiles.get(ck, 0) + 1
             self.compile_seconds[ck] = (self.compile_seconds.get(ck, 0.0)
                                         + duration_s)
+            sk = (str(rung), impl, source)
+            self.source_counts[sk] = self.source_counts.get(sk, 0) + 1
             if recompile:
                 self.recompiles += 1
             self.events.append({
@@ -195,6 +217,7 @@ class CompileTracker:
                 "impl": impl,
                 "flags": dict(flags),
                 "seconds": round(duration_s, 4),
+                "source": source,
                 "cache_hit": cache_hit,
                 "recompile": recompile,
             })
@@ -207,21 +230,32 @@ class CompileTracker:
 
     def snapshot(self) -> dict:
         with self._lock:
+            sources: dict[str, int] = {}
+            for (_r, _i, s), c in self.source_counts.items():
+                sources[s] = sources.get(s, 0) + c
             return {
                 "total": sum(self.compiles.values()),
                 "seconds_total": round(sum(self.compile_seconds.values()), 3),
                 "recompiles": self.recompiles,
+                "sources": sources,
                 "by_rung": {f"{r}/{i}": c
                             for (r, i), c in sorted(self.compiles.items())},
                 "events": list(self.events),
             }
 
+    def cold_compiles(self) -> int:
+        """Programs that paid a REAL compile (source="cold") — the
+        number a post-warm standard run must keep at zero."""
+        with self._lock:
+            return sum(c for (_r, _i, s), c in self.source_counts.items()
+                       if s == "cold")
+
     # -- scrape-time sample helpers (node/metrics.py) -------------------
 
     def compile_count_samples(self) -> list:
         with self._lock:
-            return [({"rung": r, "impl": i}, float(c))
-                    for (r, i), c in sorted(self.compiles.items())]
+            return [({"rung": r, "impl": i, "source": s}, float(c))
+                    for (r, i, s), c in sorted(self.source_counts.items())]
 
     def compile_seconds_samples(self) -> list:
         with self._lock:
@@ -231,13 +265,16 @@ class CompileTracker:
 
 class _TrackedJit:
     """Thin first-call-timing proxy over a jitted callable.  Steady
-    state costs one set-membership test per call (per batch)."""
+    state costs one set-membership test per call (per batch).
+    `prerecorded` proxies (AOT/deserialized executables — the warm path
+    already recorded their compile event with the true source) skip the
+    first-call timing entirely."""
 
     __slots__ = ("fn", "_tracker", "_kind", "_impl", "_flags", "_rung",
-                 "_seen")
+                 "_seen", "_prerecorded")
 
     def __init__(self, fn, tracker: CompileTracker, kind: str, impl: str,
-                 rung: int | None, flags: tuple):
+                 rung: int | None, flags: tuple, prerecorded: bool = False):
         self.fn = fn
         self._tracker = tracker
         self._kind = kind
@@ -245,8 +282,11 @@ class _TrackedJit:
         self._flags = flags
         self._rung = rung        # None: derive per call (sharded jits
         self._seen: set = set()  # compile once per input shape)
+        self._prerecorded = prerecorded
 
     def __call__(self, *args, **kw):
+        if self._prerecorded:
+            return self.fn(*args, **kw)
         rung = self._rung
         if rung is None:
             try:
@@ -263,13 +303,17 @@ class _TrackedJit:
 
 
 def track_jit(fn, *, kind: str, impl: str, rung: int | None = None,
-              tracker: CompileTracker | None = None, **flags):
+              tracker: CompileTracker | None = None,
+              prerecorded: bool = False, **flags):
     """Wrap a jitted callable so its first call per bucket rung records
     a compile event.  `rung=None` derives the rung from the leading axis
     of the first argument per call (the sharded jits compile one program
-    per input shape under a single jit)."""
+    per input shape under a single jit).  `prerecorded=True` is for
+    ahead-of-time executables whose compile event the warm path already
+    recorded (source aot/deserialized) — the proxy then never times."""
     return _TrackedJit(fn, tracker if tracker is not None else TRACKER,
-                       kind, impl, rung, tuple(sorted(flags.items())))
+                       kind, impl, rung, tuple(sorted(flags.items())),
+                       prerecorded)
 
 
 # ---------------------------------------------------------------------------
@@ -370,14 +414,17 @@ def render_text() -> str:
             f"{r['rows']} rows, {r['padding_rows']} padded, "
             f"occupancy {r['mean_occupancy']:.3f}")
     comp = snap["compile"]
+    stxt = " ".join(f"{k}={v}" for k, v in sorted(comp["sources"].items()))
     lines.append(
         f"== jit compiles ==\ntotal={comp['total']} "
-        f"seconds_total={comp['seconds_total']} recompiles={comp['recompiles']}")
+        f"seconds_total={comp['seconds_total']} recompiles={comp['recompiles']}"
+        + (f" [{stxt}]" if stxt else ""))
     for ev in comp["events"]:
+        src = ev.get("source") or ("cache-hit" if ev["cache_hit"] else "cold")
         lines.append(
             f"  {ev['kind']:>14} rung {ev['rung']:>6} impl={ev['impl']} "
             f"{ev['seconds']:.3f}s "
-            f"{'cache-hit' if ev['cache_hit'] else 'COLD'}"
+            f"{src.upper() if src == 'cold' else src}"
             f"{' RECOMPILE' if ev['recompile'] else ''}")
     lines.append("== device memory ==")
     mem = snap["device_memory"]

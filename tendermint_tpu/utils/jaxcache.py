@@ -31,6 +31,23 @@ def cache_dir() -> str:
     return os.path.expanduser("~/.cache/tendermint_tpu_jax")
 
 
+def plan_path() -> str:
+    """The shape plan `tendermint-tpu warm` serializes ALONGSIDE the
+    compile cache (ops/shape_plan.py): the plan and the programs it
+    names are one artifact — a cache warmed for plan A is cold for plan
+    B, so they travel (and are overridden via TM_BENCH_CACHE) together."""
+    return os.path.join(cache_dir(), "shape_plan.json")
+
+
+def aot_dir() -> str:
+    """Serialized ahead-of-time executables (jax.experimental
+    .serialize_executable), next to the persistent cache for the same
+    reason — and under the same trust model: both directories hold
+    deserializable compiled code, so both stay out of world-writable
+    paths (the ADVICE r3 rationale above)."""
+    return os.path.join(cache_dir(), "aot")
+
+
 def enable(jax_module) -> None:
     """Point JAX's persistent compile cache at cache_dir().
 
