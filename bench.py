@@ -76,6 +76,7 @@ def _fail(err: str) -> None:
         "elapsed_s": round(time.monotonic() - _t_start, 1),
     }
     out.update(_partial)  # keep any stage results measured before the failure
+    _flush_partial()
     _emit(out)
 
 
@@ -91,8 +92,31 @@ def _watchdog() -> None:
     os._exit(0)
 
 
+def _flush_partial() -> None:
+    """Write the stages measured SO FAR to disk (atomic replace).  The
+    in-memory `_partial` only reaches stdout via the failure handler or
+    the final emit — a watchdog KILL mid-stage (the BENCH_r05 failure
+    mode: the driver's timeout fired and every tail stage vanished)
+    loses everything after the last flush, so flush after every stage.
+    TM_BENCH_PARTIAL overrides the path; "0" disables."""
+    path = os.environ.get("TM_BENCH_PARTIAL", "bench_partial.json")
+    if not path or path == "0":
+        return
+    try:
+        doc = {"stage": _stage,
+               "elapsed_s": round(time.monotonic() - _t_start, 1)}
+        doc.update(_partial)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps(doc, default=str) + "\n")
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — the flush is advisory; a read-only
+        pass           # cwd or odd value must not cost the bench
+
+
 def _stage_set(name: str) -> None:
     global _stage
+    _flush_partial()  # everything measured before this stage is on disk
     _stage = name
     print(f"[bench] stage={name} t={time.monotonic() - _t_start:.1f}s", file=sys.stderr)
 
@@ -1046,6 +1070,32 @@ def main() -> None:
         }
         for k, v in _partial.items():
             out.setdefault(k, v)
+
+        # -- benchdiff (round 8, ISSUE 8): compare THIS run against the
+        # newest checked-in BENCH_r*.json and embed the verdict, so a
+        # throughput regression like r04→r05 (-4.7% sigs/s, which
+        # shipped unflagged) is named in the artifact itself instead of
+        # waiting for a human to eyeball two JSON files.  Never fails
+        # the bench — the verdict keys are the signal.
+        _stage_set("benchdiff")
+        try:
+            from tendermint_tpu.cli import benchdiff as _bd
+
+            base_path = os.environ.get("TM_BENCH_DIFF_BASE") or \
+                _bd.latest_artifact(os.path.dirname(os.path.abspath(__file__)))
+            if base_path:
+                base_metrics, _meta = _bd.normalize(
+                    _bd.load_artifact(base_path))
+                rep = _bd.diff(base_metrics, out)
+                out["benchdiff_base"] = os.path.basename(base_path)
+                out["benchdiff_regressions"] = rep["regressions"]
+                out["benchdiff_missing"] = rep["missing_in_b"]
+                out["benchdiff_ok"] = rep["ok"]
+        except Exception as e:  # noqa: BLE001 — diffing must not cost the run
+            out["benchdiff_error"] = str(e)[-300:]
+
+        _partial.update(out)
+        _flush_partial()
         _emit(out)
     except BaseException:  # noqa: BLE001
         _fail(traceback.format_exc())

@@ -935,6 +935,33 @@ def cmd_warm(args) -> int:
     return 1 if any(e.get("error") for e in report["entries"]) else 0
 
 
+def cmd_profile(args) -> int:
+    """Per-rung kernel performance profiling (cli/profile.py): HLO
+    cost-analysis FLOPs/bytes for every program in the selected shape
+    plan plus budgeted timed windows (wall p50, sigs/s, FLOPs-util %)
+    and optional Perfetto capture (docs/performance.md "Roofline").
+    Exit 0 = every entry reported, 1 = some entries errored, 2 = usage
+    error."""
+    from tendermint_tpu.cli.profile import run_profile
+
+    return run_profile(rungs=args.rungs, impls=args.impls, kinds=args.kinds,
+                       runs=args.runs, budget=args.budget,
+                       cost_only=args.cost_only, as_json=args.json,
+                       perfetto=args.perfetto)
+
+
+def cmd_benchdiff(args) -> int:
+    """Stage-by-stage BENCH artifact comparison (cli/benchdiff.py) with
+    per-metric relative thresholds: exit 0 = no regressions, 1 =
+    regressions (or, with --fail-on-missing, lost stages), 2 = usage
+    error (docs/observability.md)."""
+    from tendermint_tpu.cli.benchdiff import run_cli as benchdiff_cli
+
+    return benchdiff_cli(args.a, args.b, thresholds_path=args.thresholds,
+                         as_json=args.json,
+                         fail_on_missing=args.fail_on_missing)
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -1094,6 +1121,51 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--no-save", dest="no_save", action="store_true",
                     help="do not save the plan next to the cache")
     sp.set_defaults(fn=cmd_warm)
+
+    sp = sub.add_parser(
+        "profile",
+        help="per-rung kernel cost/roofline profile (HLO FLOPs/bytes + "
+             "budgeted timed windows; --perfetto captures a device trace)")
+    sp.add_argument("--rungs", default="",
+                    help="comma-separated rung override (default: the "
+                         "ACTIVE shape plan's rungs)")
+    sp.add_argument("--impls", default="",
+                    help="comma-separated field impls (default: the plan's)")
+    sp.add_argument("--kinds", default="",
+                    help="comma-separated program kinds: verify,rlc "
+                         "(default: the plan's)")
+    sp.add_argument("--runs", type=int, default=3,
+                    help="timed runs per rung (default 3)")
+    sp.add_argument("--budget", type=float, default=120.0,
+                    help="seconds of execution budget; rungs past it keep "
+                         "their cost rows and skip the timed window "
+                         "(default 120; 0 = cost-only)")
+    sp.add_argument("--cost-only", dest="cost_only", action="store_true",
+                    help="skip the timed windows entirely (no device "
+                         "execution, no compiles)")
+    sp.add_argument("--perfetto", default="",
+                    help="write a Perfetto-loadable device trace of the "
+                         "timed windows to this path")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the full profile report as one JSON object")
+    sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser(
+        "benchdiff",
+        help="diff two BENCH artifacts with per-metric regression "
+             "thresholds (exit 1 on regression)")
+    sp.add_argument("a", help="older BENCH json (wrapper or flat shape)")
+    sp.add_argument("b", help="newer BENCH json")
+    sp.add_argument("--thresholds", default="",
+                    help="TOML/JSON file: [thresholds] metric = rel, "
+                         "[defaults] class = rel")
+    sp.add_argument("--fail-on-missing", dest="fail_on_missing",
+                    action="store_true",
+                    help="also exit 1 when tracked metrics present in A "
+                         "are missing from B (lost tail stages)")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the diff report as one JSON object")
+    sp.set_defaults(fn=cmd_benchdiff)
 
     sp = sub.add_parser("lint", help="repo-aware static analysis (tmlint)")
     sp.add_argument("paths", nargs="*",

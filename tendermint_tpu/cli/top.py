@@ -96,6 +96,7 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
                    "transfer_bytes_total": None},
         "compile": {"total": 0, "seconds_total": 0.0, "recompiles": 0,
                     "by_rung": {}, "sources": {}},
+        "costs": {},
         "device_memory": [],
         "errors": [],
     }
@@ -219,6 +220,40 @@ def _fold_metrics(snap: dict, by_name: dict) -> None:
     rc = _scalar(by_name, "tendermint_crypto_jit_recompile_total", 0)
     comp["recompiles"] = int(rc or 0)
 
+    # per-rung roofline from the costmodel gauges: FLOPs-util % needs
+    # the measured device-execute mean (histogram sum/count) and the
+    # peak gauge; every piece degrades to absence independently
+    costs: dict[str, dict] = {}
+
+    def _fold_cost(series: str, field: str) -> None:
+        for labels, v in by_name.get(series, []):
+            if labels.get("kind", "verify") != "verify":
+                continue  # the panel is the per-row verify program's
+            costs.setdefault(labels.get("rung", "?"), {})[field] = v
+
+    _fold_cost("tendermint_crypto_verify_rung_flops", "flops")
+    _fold_cost("tendermint_crypto_verify_rung_bytes_accessed",
+               "bytes_accessed")
+    _fold_cost("tendermint_crypto_verify_rung_peak_memory_bytes",
+               "peak_memory_bytes")
+    peak = _scalar(by_name, "tendermint_crypto_verify_device_peak_flops_per_s")
+    ex_count = {labels.get("rung", "?"): v for labels, v in by_name.get(
+        "tendermint_crypto_verify_device_execute_seconds_count", [])}
+    ex_sum = {labels.get("rung", "?"): v for labels, v in by_name.get(
+        "tendermint_crypto_verify_device_execute_seconds_sum", [])}
+    for rung, cell in costs.items():
+        try:
+            cell["hlo_bytes_per_row"] = cell["bytes_accessed"] / int(rung)
+        except (KeyError, ValueError, ZeroDivisionError):
+            pass
+        c = ex_count.get(rung)
+        if c and cell.get("flops") and ex_sum.get(rung):
+            achieved = cell["flops"] / (ex_sum[rung] / c)
+            cell["achieved_flops_per_s"] = achieved
+            if peak:
+                cell["flops_util"] = achieved / peak
+    snap["costs"] = costs
+
     mem: dict[str, dict] = {}
     for labels, v in by_name.get("tendermint_crypto_device_memory_bytes", []):
         dev = labels.get("device", "?")
@@ -276,10 +311,32 @@ def render(snap: dict) -> str:
         f"  cache-hit {_v(ratio if ratio is None else round(100 * ratio, 1), '{}%')}"
         f"  backend {_v(verify['backend'])}/{ready}")
     occ = verify["occupancy"]
+    costs = snap.get("costs") or {}
+
+    def _roof(rung: str) -> str:
+        # roofline column: FLOPs-util % + HLO bytes/row, blank when the
+        # cost data for this rung has not been harvested
+        cell = costs.get(rung)
+        if not cell:
+            return ""
+        parts = []
+        if cell.get("flops_util") is not None:
+            parts.append(f"u:{100 * cell['flops_util']:.1f}%")
+        if cell.get("hlo_bytes_per_row") is not None:
+            parts.append(f"{_fmt_bytes(cell['hlo_bytes_per_row'])}/row")
+        return f" [{' '.join(parts)}]" if parts else ""
+
     if occ:
         otxt = "  ".join(
-            f"{rung}:{d['flushes']}x@{d['mean_ratio']}" for rung, d in occ.items())
+            f"{rung}:{d['flushes']}x@{d['mean_ratio']}{_roof(rung)}"
+            for rung, d in occ.items())
         lines.append(f"occupancy  {otxt}")
+    elif costs:
+        # no flushes yet, but harvested program costs exist (post-warm
+        # idle node): show the roofline rows on their own
+        ctxt = "  ".join(f"{rung}:{_roof(rung).strip() or '-'}"
+                         for rung in sorted(costs, key=_rung_key))
+        lines.append(f"roofline   {ctxt}")
     lines.append(
         f"padding    rows {_v(verify['padding_rows_total'])}"
         f"  transfer {_fmt_bytes(verify['transfer_bytes_total'])}")

@@ -274,6 +274,42 @@ class NodeMetrics:
             fn=_dm.memory_gauge_samples,
         ))
 
+        # -- per-program HLO costs (utils/costmodel) --------------------
+        # harvested from compiled executables (AOT warm) or lowered
+        # programs (`tendermint-tpu profile`); absent until a harvest
+        # happens — a scrape never triggers one.
+        from tendermint_tpu.utils import costmodel as _cm
+
+        self.verify_rung_flops = reg.register(LabeledCallbackGauge(
+            "verify_rung_flops",
+            "HLO cost-analysis FLOPs for one execution of the compiled "
+            "program, by kind/rung/impl",
+            namespace=ns, subsystem="crypto",
+            fn=lambda: _cm.COSTS.flops_samples(),
+        ))
+        self.verify_rung_bytes_accessed = reg.register(LabeledCallbackGauge(
+            "verify_rung_bytes_accessed",
+            "HLO cost-analysis bytes accessed (working-set traffic, not "
+            "host transfer) per execution, by kind/rung/impl",
+            namespace=ns, subsystem="crypto",
+            fn=lambda: _cm.COSTS.bytes_samples(),
+        ))
+        self.verify_rung_peak_memory = reg.register(LabeledCallbackGauge(
+            "verify_rung_peak_memory_bytes",
+            "Compiled-program device footprint (arguments + outputs + "
+            "temps + code), by kind/rung/impl — compiled harvests only",
+            namespace=ns, subsystem="crypto",
+            fn=lambda: _cm.COSTS.peak_memory_samples(),
+        ))
+        self.verify_device_peak_flops = reg.register(Gauge(
+            "verify_device_peak_flops_per_s",
+            "Peak device FLOPs/s used as the roofline denominator "
+            "(TM_TPU_PEAK_FLOPS or device-kind table; omitted when "
+            "unknown)",
+            namespace=ns, subsystem="crypto",
+            fn=lambda: float(_cm.peak_flops_per_s()),
+        ))
+
         # -- latency histograms fed at their source ---------------------
         # Process-wide module singletons (the verify service, the FSM,
         # blocksync and RPC observe them where the timing happens); this

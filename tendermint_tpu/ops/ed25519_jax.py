@@ -612,9 +612,18 @@ def _compiled(n: int, impl: str | None = None, base_mxu: bool = False):
     # compile tracking (utils/devmon): the first call per cache entry is
     # the one that pays trace+compile; re-tracing the same key after a
     # cache_clear is the unexpected-recompile the tracker warns about
+    jitted = _jit_for("verify", impl_r, base_mxu=base_mxu, donate=donate)
+    # cost model (utils/costmodel): register the program for HLO-cost
+    # harvest; the thunk only runs when `tendermint-tpu profile` (or a
+    # costmodel.resolve_pending caller) asks — a trace, never a compile
+    from tendermint_tpu.utils import costmodel as _cost
+
+    if _cost.COSTS.enabled:
+        _cost.COSTS.record_pending(
+            "verify", n, impl_r, {"base_mxu": base_mxu, "donate": donate},
+            lambda: jitted.lower(*_plan.abstract_rows("verify", n)))
     return _devmon.track_jit(
-        _jit_for("verify", impl_r, base_mxu=base_mxu, donate=donate),
-        kind="verify", impl=impl_r, rung=n, base_mxu=base_mxu)
+        jitted, kind="verify", impl=impl_r, rung=n, base_mxu=base_mxu)
 
 
 def rlc_reduce_lanes() -> int:
@@ -639,9 +648,15 @@ def _compiled_rlc(n: int, impl: str, reduce_lanes: int = 2048):
         return _devmon.track_jit(entry.executable, kind="rlc", impl=impl,
                                  rung=n, prerecorded=True,
                                  reduce_lanes=reduce_lanes)
+    jitted = _jit_for("rlc", impl, reduce_lanes=reduce_lanes, donate=donate)
+    from tendermint_tpu.utils import costmodel as _cost
+
+    if _cost.COSTS.enabled:
+        _cost.COSTS.record_pending(
+            "rlc", n, impl, {"reduce_lanes": reduce_lanes, "donate": donate},
+            lambda: jitted.lower(*_plan.abstract_rows("rlc", n)))
     return _devmon.track_jit(
-        _jit_for("rlc", impl, reduce_lanes=reduce_lanes, donate=donate),
-        kind="rlc", impl=impl, rung=n, reduce_lanes=reduce_lanes)
+        jitted, kind="rlc", impl=impl, rung=n, reduce_lanes=reduce_lanes)
 
 
 # ---------------------------------------------------------------------------

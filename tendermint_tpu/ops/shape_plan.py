@@ -459,6 +459,18 @@ def _aot_path(kind: str, rung: int, impl: str, flags: dict) -> str:
     return os.path.join(aot_dir(), f"{kind}_{impl}_r{rung}_{sig}.aotx")
 
 
+def _harvest_costs(kind: str, rung: int, impl: str, flags: dict,
+                   executable) -> None:
+    """Read cost_analysis()/memory_analysis() off a just-warmed
+    executable into the cost model (utils/costmodel) — the cheapest
+    possible harvest: the executable is already compiled, so this is a
+    pair of C++ accessor calls, and record_compiled never raises."""
+    from tendermint_tpu.utils import costmodel as _cost
+
+    if _cost.COSTS.enabled:
+        _cost.COSTS.record_compiled(kind, rung, impl, flags, executable)
+
+
 # ---------------------------------------------------------------------------
 # Warming
 # ---------------------------------------------------------------------------
@@ -498,6 +510,7 @@ def warm_entry(kind: str, rung: int, impl: str, *, flags: dict | None = None,
                 _REGISTRY[key] = AotEntry(exe, "deserialized", dt)
             _devmon.TRACKER.record(kind, rung, impl, _flag_key(flags), dt,
                                    source="deserialized")
+            _harvest_costs(kind, rung, impl, flags, exe)
             report.update(source="deserialized", seconds=round(dt, 3),
                           path=path)
             return report
@@ -510,6 +523,7 @@ def warm_entry(kind: str, rung: int, impl: str, *, flags: dict | None = None,
         _REGISTRY[key] = AotEntry(exe, "aot", dt)
     _devmon.TRACKER.record(kind, rung, impl, _flag_key(flags), dt,
                            source="aot")
+    _harvest_costs(kind, rung, impl, flags, exe)
     report.update(source="aot", seconds=round(dt, 3))
     if serialize and path:
         blob = _dump_executable(exe)

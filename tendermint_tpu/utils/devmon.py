@@ -392,10 +392,19 @@ def reset(enabled: bool | None = None) -> None:
 
 def device_stats() -> dict:
     """One snapshot of the device layer: batch efficiency, compile
-    events, device memory."""
+    events, device memory, and per-program HLO costs/roofline (the
+    `costs` block — utils/costmodel; cheap: only already-harvested
+    records, a snapshot never lowers or compiles anything)."""
     out = STATS.snapshot()
     out["compile"] = TRACKER.snapshot()
     out["device_memory"] = device_memory()
+    try:
+        from tendermint_tpu.utils import costmodel
+
+        out["costs"] = costmodel.costs_block()
+    except Exception:  # noqa: BLE001 — cost harvest must never break a scrape
+        out["costs"] = {"enabled": False, "pending": 0, "records": [],
+                        "peak_flops_per_s": None}
     return out
 
 
@@ -436,4 +445,21 @@ def render_text() -> str:
                            "live_buffers", "live_buffer_bytes") if k in e)
         lines.append(f"  dev{e['id']} {e['platform']} {e['device_kind']} "
                      f"{detail}".rstrip())
+    costs = snap.get("costs") or {}
+    recs = costs.get("records") or []
+    lines.append(
+        f"== program costs (harvested {len(recs)}, "
+        f"pending {costs.get('pending', 0)}) ==")
+    for r in recs:
+
+        def _f(key, fmt="{:.3g}"):
+            v = r.get(key)
+            return fmt.format(v) if v is not None else "n/a"
+
+        lines.append(
+            f"  {r['kind']:>14} rung {r['rung']:>6} impl={r['impl']} "
+            f"flops={_f('flops')} bytes={_f('bytes_accessed')} "
+            f"AI={_f('arithmetic_intensity')} "
+            f"peak_mem={_f('peak_memory_bytes')} "
+            f"util={_f('flops_utilization', '{:.2%}')} [{r['source']}]")
     return "\n".join(lines) + "\n"

@@ -144,6 +144,13 @@ class Histogram(_Metric):
                 return
         counts[-1] += 1
 
+    def label_stats(self) -> dict:
+        """Per-labelset (count, sum) snapshot keyed by the label-value
+        tuple — the read-side accessor derived views use (costmodel's
+        achieved-FLOPs/s needs the device-execute mean per rung without
+        re-parsing exposition text)."""
+        return {key: (cell[2], cell[1]) for key, cell in self._series.items()}
+
     def samples(self):
         out = []
         for key in sorted(self._series):

@@ -245,6 +245,75 @@ def test_jaxcache_enable_logs_dir_and_preexistence(
     assert "entries=1" in cap.lines[-1]
 
 
+def test_top_roofline_fold_and_render():
+    """ISSUE 8 satellite: the per-rung verify panel folds the cost
+    gauges into a roofline column (FLOPs-util %, bytes/row) and blanks
+    every piece that is absent."""
+    from tendermint_tpu.cli import top as top_mod
+
+    exposition = "\n".join([
+        'tendermint_crypto_verify_batch_occupancy_ratio_count{rung="192"} 4',
+        'tendermint_crypto_verify_batch_occupancy_ratio_sum{rung="192"} 2.7',
+        'tendermint_crypto_verify_batch_occupancy_ratio_count{rung="64"} 2',
+        'tendermint_crypto_verify_batch_occupancy_ratio_sum{rung="64"} 2.0',
+        'tendermint_crypto_verify_rung_flops'
+        '{impl="int64",kind="verify",rung="192"} 45400000',
+        'tendermint_crypto_verify_rung_bytes_accessed'
+        '{impl="int64",kind="verify",rung="192"} 1660000000',
+        # an rlc row at the same rung must NOT shadow the verify panel
+        'tendermint_crypto_verify_rung_flops'
+        '{impl="int64",kind="rlc",rung="192"} 1',
+        'tendermint_crypto_verify_device_peak_flops_per_s 1e12',
+        'tendermint_crypto_verify_device_execute_seconds_count{rung="192"} 4',
+        'tendermint_crypto_verify_device_execute_seconds_sum{rung="192"} 0.2',
+    ])
+    snap = {"ts": 0.0, "node": {}, "height": 1, "round": 0, "step": "NEW",
+            "peers": {"count": 0, "send_queue_depths": {}},
+            "verify": {"queue_depth": 0, "submitted": 0, "flushes": 0,
+                       "device_batches": 0, "cache_hit_ratio": 0.0,
+                       "backend": None, "device_ready": None,
+                       "occupancy": {}, "padding_rows_total": 0,
+                       "transfer_bytes_total": 0},
+            "compile": {"total": 0, "seconds_total": 0.0, "recompiles": 0,
+                        "by_rung": {}, "sources": {}},
+            "costs": {}, "device_memory": [], "errors": []}
+    by_name = top_mod._index(top_mod.parse_exposition(exposition))
+    top_mod._fold_metrics(snap, by_name)
+
+    cell = snap["costs"]["192"]
+    assert cell["flops"] == 45400000  # the verify row, not the rlc one
+    assert cell["hlo_bytes_per_row"] == pytest.approx(1660000000 / 192)
+    # achieved = flops / (0.2/4) = 9.08e8; util = achieved / 1e12
+    assert cell["flops_util"] == pytest.approx(9.08e8 / 1e12)
+    assert "64" not in snap["costs"]  # no cost gauge for rung 64
+
+    text = top_mod.render(snap)
+    # rung 192 carries the roofline column; rung 64 degrades to blanks
+    assert "u:0.1%" in text and "/row]" in text
+    line = next(l for l in text.splitlines() if l.startswith("occupancy"))
+    assert "64:2x@1.0 " in line and "[" not in line.split("192:")[0]
+
+
+def test_top_roofline_line_when_idle():
+    """Harvested costs but zero flushes (post-warm idle node): the
+    roofline shows on its own line instead of vanishing."""
+    from tendermint_tpu.cli import top as top_mod
+
+    snap = {"ts": 0.0, "node": {}, "height": 1, "round": 0, "step": "NEW",
+            "peers": {"count": 0, "send_queue_depths": {}},
+            "verify": {"queue_depth": 0, "submitted": 0, "flushes": 0,
+                       "device_batches": 0, "cache_hit_ratio": 0.0,
+                       "backend": None, "device_ready": None,
+                       "occupancy": {}, "padding_rows_total": 0,
+                       "transfer_bytes_total": 0},
+            "compile": {"total": 0, "seconds_total": 0.0, "recompiles": 0,
+                        "by_rung": {}, "sources": {}},
+            "costs": {"8": {"flops": 1.0, "hlo_bytes_per_row": 1024.0}},
+            "device_memory": [], "errors": []}
+    text = top_mod.render(snap)
+    assert "roofline" in text and "1.0KiB/row" in text
+
+
 # ---------------------------------------------------------------------------
 # live single node: top --once --json golden, status verify_service,
 # metrics TYPE conformance for every new series, pprof device dump
@@ -260,6 +329,11 @@ NEW_SERIES_TYPES = [
     ("tendermint_crypto_verify_rung_flushes_total", "counter"),
     ("tendermint_crypto_verify_queue_depth", "gauge"),
     ("tendermint_crypto_device_memory_bytes", "gauge"),
+    # ISSUE 8: per-program HLO cost gauges (utils/costmodel)
+    ("tendermint_crypto_verify_rung_flops", "gauge"),
+    ("tendermint_crypto_verify_rung_bytes_accessed", "gauge"),
+    ("tendermint_crypto_verify_rung_peak_memory_bytes", "gauge"),
+    ("tendermint_crypto_verify_device_peak_flops_per_s", "gauge"),
 ]
 
 

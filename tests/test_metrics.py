@@ -313,6 +313,15 @@ def test_node_serves_prometheus(tmp_path):
             assert "# TYPE tendermint_crypto_verify_e2e_seconds histogram" in text
             assert "# TYPE tendermint_blocksync_request_duration_seconds histogram" in text
             assert "# TYPE tendermint_rpc_request_duration_seconds histogram" in text
+            # per-program HLO cost gauges (ISSUE 8, utils/costmodel):
+            # present and typed even before any program is harvested
+            assert "# TYPE tendermint_crypto_verify_rung_flops gauge" in text
+            assert ("# TYPE tendermint_crypto_verify_rung_bytes_accessed "
+                    "gauge") in text
+            assert ("# TYPE tendermint_crypto_verify_rung_peak_memory_bytes "
+                    "gauge") in text
+            assert ("# TYPE tendermint_crypto_verify_device_peak_flops_per_s "
+                    "gauge") in text
             step_counts = [
                 float(v) for k, v in lines.items()
                 if k.startswith("tendermint_consensus_step_duration_seconds_count")
