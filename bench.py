@@ -392,6 +392,49 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["simnet_error"] = str(e)[-300:]
 
+        # -- tx latency (round 9, ISSUE 9): finality percentiles on a
+        # clean 4-node localnet — the latency twin of the simnet stage's
+        # accepted-tx/s.  The metric keys end in _ms so benchdiff tracks
+        # them in the latency class (10% rel threshold).  Placed BEFORE
+        # the device stages with the simnet stage (the BENCH_r05 lesson:
+        # tail stages silently vanish when the watchdog fires mid-RLC),
+        # and budgeted so the device pipeline keeps its reserve.
+        _stage_set("tx-latency")
+        try:
+            budget = min(70.0, _deadline_left() - 240.0)
+            if budget < 35:
+                raise RuntimeError("skipped: %.0fs left" % _deadline_left())
+            import tempfile
+
+            from tendermint_tpu.simnet.harness import run_scenario
+            from tendermint_tpu.simnet.scenario import Scenario
+
+            lat_sc = Scenario(
+                name="txlat-4", seed=901, validators=4, target_height=6,
+                max_runtime_s=budget, load_rate=30, timeout_scale=2.0,
+                max_rounds=10,
+            )
+            with tempfile.TemporaryDirectory() as td:
+                rep = run_scenario(lat_sc, td)
+            fin = rep.get("finality", {})
+
+            def _ms(key):
+                v = fin.get(key)
+                return round(v * 1e3, 2) if v is not None else None
+
+            _partial.update({
+                "tx_latency_ok": rep["ok"],
+                "tx_latency_count": fin.get("count", 0),
+                "tx_finality_p50_ms": _ms("p50_s"),
+                "tx_finality_p95_ms": _ms("p95_s"),
+                "tx_finality_p99_ms": _ms("p99_s"),
+                "tx_finality_max_ms": _ms("max_s"),
+                "tx_latency_accepted_tx_per_s":
+                    rep["load"]["accepted_tx_per_s"],
+            })
+        except Exception as e:  # noqa: BLE001
+            _partial["tx_latency_error"] = str(e)[-300:]
+
         if platform == "cpu":
             _stage_set("timed-production-cpu")
             from tendermint_tpu.crypto.batch import new_batch_verifier
@@ -971,6 +1014,46 @@ def main() -> None:
                 f"journal {enabled_us:.1f}us/event exceeds {budget_us}us")
         except Exception as e:  # noqa: BLE001
             _partial["journal_overhead_error"] = str(e)[-300:]
+
+        # Tx lifecycle overhead (round 9, ISSUE 9): the cost contract of
+        # EVERY lifecycle hook site (rpc ingress, mempool admit/recv,
+        # gossip send, proposal inclusion, commit/apply) is the journal's
+        # — the DISABLED path is one attribute-load + branch against the
+        # NOP singleton, and the ENABLED path (dict ops, no journal, no
+        # hashing: sites reuse the mempool's sha256 keys) stays under a
+        # stated per-stamp budget.
+        _stage_set("txlife-overhead")
+        try:
+            from tendermint_tpu.utils import txlife as _tl
+
+            N_EV = 20_000
+            nop = _tl.NOP
+            t0 = time.perf_counter()
+            for _ in range(N_EV):
+                # measured exactly as hook sites write it
+                if nop.enabled:
+                    nop.stamp(b"k" * 32, "admit")
+            disabled_ns = (time.perf_counter() - t0) / N_EV * 1e9
+
+            life = _tl.TxLifecycle(node="bench")  # journal off: store cost
+            keys = [i.to_bytes(32, "big") for i in range(N_EV)]
+            t0 = time.perf_counter()
+            for k in keys:  # distinct keys: insert + eviction-bound path
+                if life.enabled:
+                    life.stamp(k, "admit")
+            enabled_us = (time.perf_counter() - t0) / N_EV * 1e6
+            budget_us = 25.0  # per stamp; a tx makes ~6 stamps per node
+            _partial.update({
+                "txlife_disabled_ns_per_stamp": round(disabled_ns, 1),
+                "txlife_enabled_us_per_stamp": round(enabled_us, 2),
+                "txlife_budget_us_per_stamp": budget_us,
+                "txlife_within_budget": bool(enabled_us <= budget_us),
+                "txlife_evicted": life.evicted,
+            })
+            assert enabled_us <= budget_us, (
+                f"txlife {enabled_us:.1f}us/stamp exceeds {budget_us}us")
+        except Exception as e:  # noqa: BLE001
+            _partial["txlife_overhead_error"] = str(e)[-300:]
 
         # Device observability (round 9, ISSUE 4): the occupancy/padding
         # accounting rides EVERY device flush site, so its cost contract

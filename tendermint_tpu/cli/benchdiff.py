@@ -67,7 +67,8 @@ _CLASS_RULES = (
      "boolean", "higher"),
     (re.compile(r"(_p50_ms|_ms)$"), "latency", "lower"),
     (re.compile(r"(_ns_per_event|_us_per_event|_ns_per_flush"
-                r"|_us_per_flush)$"), "latency", "lower"),
+                r"|_us_per_flush|_ns_per_stamp|_us_per_stamp)$"),
+     "latency", "lower"),
     (re.compile(r"(_seconds|_s)$"), "timing", "lower"),
     (re.compile(r"(cold_compiles|recompiles|_findings|frames_dropped"
                 r"|padding_rows_total|wal_replays|_violations)$"),

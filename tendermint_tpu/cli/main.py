@@ -724,23 +724,14 @@ def cmd_light(args) -> int:
     return 0
 
 
-def cmd_timeline(args) -> int:
-    """Merge N nodes' consensus event journals (TM_TPU_JOURNAL output;
-    consensus/eventlog.py) into one cross-node timeline: proposal
-    propagation, per-node polka and commit times, timeout distribution,
-    vote-arrival skew, anomaly flags.  With --wal the inputs are raw
-    consensus WAL files instead and the journal subset is reconstructed
-    offline (post-mortems where the journal was off)."""
-    import json as _json
-
+def _load_journals(args, wal: bool = False) -> "dict | None":
+    """Shared journal loading for the timeline/txtrace subcommands:
+    name resolution (testnet node-home directories), journal or WAL
+    decoding, per-file error reporting.  None means a usage/IO error
+    was already printed."""
     from tendermint_tpu.consensus.eventlog import (
         events_from_wal_file,
         read_events,
-    )
-    from tendermint_tpu.cli.timeline import (
-        build_timeline,
-        render_timeline,
-        report_json,
     )
 
     names = [n.strip() for n in (args.names or "").split(",") if n.strip()]
@@ -757,24 +748,75 @@ def cmd_timeline(args) -> int:
             if name in journals:
                 name = f"{name}#{i}"
         try:
-            events = (events_from_wal_file(path, node=name) if args.wal
+            events = (events_from_wal_file(path, node=name) if wal
                       else read_events(path))
         except OSError as e:
             print(f"cannot read {path}: {e}", file=sys.stderr)
-            return 1
+            return None
         except Exception as e:
             print(f"cannot decode {path}: {e}", file=sys.stderr)
-            return 1
+            return None
         journals[name] = events
     if not any(journals.values()):
         print("no events found in any input", file=sys.stderr)
+        return None
+    return journals
+
+
+def cmd_timeline(args) -> int:
+    """Merge N nodes' consensus event journals (TM_TPU_JOURNAL output;
+    consensus/eventlog.py) into one cross-node timeline: proposal
+    propagation, per-node polka and commit times, timeout distribution,
+    vote-arrival skew, anomaly flags.  Cross-node clock skew is
+    estimated from matched journal event pairs and corrected before
+    alignment (--no-skew restores raw wall clocks).  With --wal the
+    inputs are raw consensus WAL files instead and the journal subset is
+    reconstructed offline (post-mortems where the journal was off)."""
+    import json as _json
+
+    from tendermint_tpu.cli.timeline import (
+        build_timeline,
+        estimate_offsets,
+        render_timeline,
+        report_json,
+    )
+
+    journals = _load_journals(args, wal=args.wal)
+    if journals is None:
         return 1
-    report = build_timeline(journals)
+    offsets = None if args.no_skew else estimate_offsets(journals)
+    report = build_timeline(journals, offsets=offsets)
     if args.json:
-        print(_json.dumps(report_json(report), indent=2))
+        print(_json.dumps(report_json(report, offsets=offsets), indent=2))
     else:
-        print(render_timeline(report, height=args.height))
+        print(render_timeline(report, height=args.height, offsets=offsets))
     return 0
+
+
+def cmd_txtrace(args) -> int:
+    """Merge N nodes' event journals into per-transaction cross-node
+    waterfalls (cli/txtrace.py): submit → gossip → propose → quorum →
+    commit → apply, with skew-corrected timestamps (the same estimator
+    the timeline uses).  Exit 0 when at least one tx lifecycle was
+    found, 1 otherwise."""
+    import json as _json
+
+    from tendermint_tpu.cli.timeline import estimate_offsets
+    from tendermint_tpu.cli.txtrace import build_txtrace, render_txtrace
+
+    journals = _load_journals(args)
+    if journals is None:
+        return 1
+    offsets = None if args.no_skew else estimate_offsets(journals)
+    doc = build_txtrace(journals, offsets=offsets)
+    if args.tx:
+        want = args.tx.lower()
+        doc["txs"] = [t for t in doc["txs"] if t["tx"].startswith(want)]
+    if args.json:
+        print(_json.dumps(doc, indent=2))
+    else:
+        print(render_txtrace(doc, limit=args.limit))
+    return 0 if doc["txs"] else 1
 
 
 def cmd_simnet(args) -> int:
@@ -1045,9 +1087,33 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--wal", action="store_true",
                     help="inputs are consensus WALs; reconstruct the "
                          "journal subset offline")
+    sp.add_argument("--no-skew", dest="no_skew", action="store_true",
+                    help="skip the pairwise clock-offset estimation; "
+                         "align on raw wall clocks")
     sp.add_argument("--json", action="store_true",
                     help="emit the merged report as JSON")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "txtrace",
+        help="merge N nodes' event journals into per-tx cross-node "
+             "waterfalls (submit → gossip → propose → quorum → commit)")
+    sp.add_argument("journals", nargs="+",
+                    help="journal.jsonl files (one per node), written "
+                         "with TM_TPU_JOURNAL on")
+    sp.add_argument("--names", default="",
+                    help="comma-separated node names matching the inputs")
+    sp.add_argument("--tx", default="",
+                    help="render only txs whose hash prefix starts with "
+                         "this hex string")
+    sp.add_argument("--limit", type=int, default=10,
+                    help="max txs rendered (0 = all; default 10)")
+    sp.add_argument("--no-skew", dest="no_skew", action="store_true",
+                    help="skip the pairwise clock-offset estimation; "
+                         "align on raw wall clocks")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the waterfalls as JSON")
+    sp.set_defaults(fn=cmd_txtrace)
 
     sp = sub.add_parser(
         "simnet",

@@ -13,10 +13,18 @@ where the prevote polka actually formed" is answerable only by merging
 every node's record of the same height.
 
 Alignment uses wall-clock ns (`w`).  In-process test nets share one
-clock; across real machines the skew is whatever NTP leaves (document
-says: read offsets relative to each height's first event, so a constant
-per-node clock offset shifts that node's column but never reorders its
-own events).
+clock; across real machines the residual skew is ESTIMATED and
+corrected: `estimate_offsets` runs an NTP-style pairwise exchange over
+matched journal event pairs — a vote/proposal journaled by its ORIGIN
+node (`from == ""`) and the same message's admission line on every
+receiving node.  For each ordered node pair the minimum observed
+(receive − origin) delta approximates one-way latency plus clock
+offset; with both directions available the symmetric-latency half
+difference isolates the offset, and offsets propagate to all nodes over
+the pair graph from a reference node.  `build_timeline(journals,
+offsets=...)` subtracts each node's offset before merging, so height
+alignment and `vote_skew_ms` measure propagation, not clocks; the
+renderer annotates the per-node offsets it applied.
 
 Everything here is pure data-in/data-out so tests can drive it without
 a CLI process; `cmd_timeline` in cli/main.py is a thin arg-parsing shell.
@@ -61,28 +69,131 @@ class HeightView:
 
 
 @dataclass
+class TxView:
+    """Cross-node first-arrival view of one transaction's lifecycle
+    (from the tx_* journal events the txlife hooks write)."""
+
+    first: dict = field(default_factory=dict)   # milestone -> (w, node)
+    height: int | None = None                   # commit height
+
+
+@dataclass
 class TimelineReport:
     nodes: list
     heights: dict                       # height -> HeightView
     anomalies: list = field(default_factory=list)
+    txs: dict = field(default_factory=dict)     # tx prefix -> TxView
 
 
-def merge_events(journals: dict[str, list[dict]]) -> list[dict]:
+def merge_events(journals: dict[str, list[dict]],
+                 offsets: dict[str, float] | None = None) -> list[dict]:
     """Tag each event with its node (overriding any stale `n` from a
-    copied journal file) and sort the union by wall clock."""
+    copied journal file) and sort the union by wall clock.  With
+    `offsets` (node → estimated clock offset in ns, from
+    `estimate_offsets`), each event's `w` is skew-corrected by
+    subtracting its node's offset before the merge."""
     merged = []
     for name, events in journals.items():
+        off = int(offsets.get(name, 0)) if offsets else 0
         for ev in events:
             ev = dict(ev)
             ev["n"] = name
+            if off and "w" in ev:
+                ev["w"] = ev["w"] - off
             merged.append(ev)
     merged.sort(key=lambda e: (e.get("w", 0), e.get("h", 0)))
     return merged
 
 
-def build_timeline(journals: dict[str, list[dict]]) -> TimelineReport:
-    """Fold merged journals into per-height views + anomaly list."""
-    merged = merge_events(journals)
+# ---------------------------------------------------------------------------
+# pairwise clock-offset estimation
+# ---------------------------------------------------------------------------
+
+
+def _pair_min_deltas(journals: dict[str, list[dict]]) -> dict[tuple, float]:
+    """(origin_node, recv_node) -> min observed (recv_w - origin_w) over
+    matched event pairs.  A matched pair is one vote/proposal journaled
+    with `from == ""` on exactly one node (the origin — its own message
+    through the internal queue) and admitted on another.  The minimum
+    over many messages approximates min one-way latency + clock offset;
+    relays only ADD latency, so the bound direction is preserved."""
+    origins: dict[tuple, object] = {}   # key -> (node, w) | None=ambiguous
+    receives: dict[tuple, list] = {}
+    for name, events in journals.items():
+        for ev in events:
+            e = ev.get("e")
+            if e == "vote":
+                key = ("v", ev.get("h"), ev.get("r"), ev.get("type"),
+                       ev.get("val"))
+            elif e == "proposal":
+                key = ("p", ev.get("h"), ev.get("r"), ev.get("block"))
+            else:
+                continue
+            w = ev.get("w")
+            if w is None:
+                continue
+            if ev.get("from", "") == "":
+                cur = origins.get(key, ())
+                if cur == ():
+                    origins[key] = (name, w)
+                elif cur is not None and cur[0] != name:
+                    origins[key] = None  # two origins (equivocation): drop
+            else:
+                receives.setdefault(key, []).append((name, w))
+    deltas: dict[tuple, float] = {}
+    for key, org in origins.items():
+        if org is None:
+            continue
+        a, wa = org
+        for b, wb in receives.get(key, ()):
+            if b == a:
+                continue
+            d = wb - wa
+            pk = (a, b)
+            if pk not in deltas or d < deltas[pk]:
+                deltas[pk] = d
+    return deltas
+
+
+def estimate_offsets(journals: dict[str, list[dict]]) -> dict[str, float]:
+    """Per-node clock offset (ns) relative to a reference node, from
+    matched origin/receive journal event pairs.  For a node pair with
+    traffic in BOTH directions, offset(b) − offset(a) ≈
+    (min_delta(a→b) − min_delta(b→a)) / 2 (symmetric-latency
+    assumption — the standard NTP exchange, one level up).  Offsets
+    propagate over the pair graph from the first node of each connected
+    component; nodes with no usable pairs keep offset 0.  Subtract a
+    node's offset from its `w` stamps to align (merge_events does)."""
+    deltas = _pair_min_deltas(journals)
+    adj: dict[str, list] = {}
+    for (a, b), dab in deltas.items():
+        dba = deltas.get((b, a))
+        if dba is None:
+            continue
+        off = (dab - dba) / 2.0  # b's clock minus a's clock
+        adj.setdefault(a, []).append((b, off))
+        adj.setdefault(b, []).append((a, -off))
+    offsets: dict[str, float] = {}
+    for root in sorted(journals):
+        if root in offsets:
+            continue
+        offsets[root] = 0.0
+        stack = [root]
+        while stack:
+            cur = stack.pop()
+            for nb, off in adj.get(cur, ()):
+                if nb not in offsets:
+                    offsets[nb] = offsets[cur] + off
+                    stack.append(nb)
+    return offsets
+
+
+def build_timeline(journals: dict[str, list[dict]],
+                   offsets: dict[str, float] | None = None) -> TimelineReport:
+    """Fold merged journals into per-height views + anomaly list (and
+    per-tx lifecycle first-arrivals).  `offsets` skew-corrects every
+    wall stamp before merging (see estimate_offsets)."""
+    merged = merge_events(journals, offsets=offsets)
     heights: dict[int, HeightView] = {}
     report = TimelineReport(nodes=sorted(journals), heights=heights)
 
@@ -90,6 +201,19 @@ def build_timeline(journals: dict[str, list[dict]]) -> TimelineReport:
     vote_blocks: dict[tuple, set] = {}
 
     for ev in merged:
+        e = ev.get("e", "")
+        if isinstance(e, str) and e.startswith("tx_"):
+            tx = ev.get("tx")
+            if tx:
+                tv = report.txs.get(tx)
+                if tv is None:
+                    tv = report.txs[tx] = TxView()
+                m = e[3:]
+                if m not in tv.first:  # merged is w-sorted: first wins
+                    tv.first[m] = (ev.get("w", 0), ev["n"])
+                if m == "commit" and tv.height is None:
+                    tv.height = ev.get("h")
+            continue
         h = ev.get("h")
         if h is None:
             continue
@@ -198,7 +322,10 @@ def _rel_ms(w: int | None, t0: int | None) -> str:
 
 def vote_skew_ms(hv: HeightView) -> dict:
     """Per-validator prevote arrival skew across nodes (max - min wall
-    arrival, ms): how unevenly each validator's vote reached the net."""
+    arrival, ms): how unevenly each validator's vote reached the net.
+    When the timeline was built with estimated offsets, arrivals are
+    already skew-corrected, so this measures propagation unevenness
+    rather than clock disagreement."""
     out = {}
     for (val, vtype), arr in sorted(hv.vote_arrivals.items()):
         if vtype != "prevote" or len(arr) < 2 or val is None:
@@ -207,12 +334,19 @@ def vote_skew_ms(hv: HeightView) -> dict:
     return out
 
 
-def render_timeline(report: TimelineReport, height: int | None = None) -> str:
-    """Text rendering, one block per height (offsets relative to the
-    height's earliest event across all journals)."""
+def render_timeline(report: TimelineReport, height: int | None = None,
+                    offsets: dict[str, float] | None = None) -> str:
+    """Text rendering, one block per height (per-height times relative
+    to the height's earliest event across all journals).  `offsets` are
+    the estimated per-node clock offsets ALREADY APPLIED to the report
+    (estimate_offsets → build_timeline); they are annotated so the
+    reader knows the columns are skew-corrected."""
     lines: list[str] = []
     nodes = report.nodes
     lines.append(f"nodes: {', '.join(nodes)}")
+    if offsets is not None:
+        lines.append("clock offsets (estimated, applied): " + "  ".join(
+            f"{n} {offsets.get(n, 0.0) / 1e6:+.2f}ms" for n in nodes))
     wanted = ([height] if height is not None
               else sorted(report.heights))
     for h in wanted:
@@ -268,10 +402,23 @@ def render_timeline(report: TimelineReport, height: int | None = None) -> str:
     return "\n".join(lines)
 
 
-def report_json(report: TimelineReport) -> dict:
+def report_json(report: TimelineReport,
+                offsets: dict[str, float] | None = None) -> dict:
     """JSON-ready dump of the report (the --json CLI path)."""
     out = {"nodes": report.nodes, "anomalies": report.anomalies,
            "heights": {}}
+    if offsets is not None:
+        out["clock_offsets_ms"] = {
+            n: round(offsets.get(n, 0.0) / 1e6, 3) for n in report.nodes}
+    if report.txs:
+        out["txs"] = {
+            tx: {
+                "height": tv.height,
+                "first": {m: {"w": w, "node": n}
+                          for m, (w, n) in sorted(tv.first.items())},
+            }
+            for tx, tv in sorted(report.txs.items())
+        }
     for h, hv in sorted(report.heights.items()):
         out["heights"][str(h)] = {
             "proposer": hv.proposer,

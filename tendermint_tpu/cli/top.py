@@ -97,6 +97,7 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
         "compile": {"total": 0, "seconds_total": 0.0, "recompiles": 0,
                     "by_rung": {}, "sources": {}},
         "costs": {},
+        "txlife": {"finality": None, "residency": None, "quorum_wait": {}},
         "device_memory": [],
         "errors": [],
     }
@@ -254,6 +255,21 @@ def _fold_metrics(snap: dict, by_name: dict) -> None:
                 cell["flops_util"] = achieved / peak
     snap["costs"] = costs
 
+    # tx lifecycle summary from the always-on histograms: count + mean +
+    # bucket-quantile upper bounds (p50/p95 read "≤ bucket edge")
+    tl = snap.setdefault(
+        "txlife", {"finality": None, "residency": None, "quorum_wait": {}})
+    tl["finality"] = _hist_summary(
+        by_name, "tendermint_tx_time_to_finality_seconds")
+    tl["residency"] = _hist_summary(
+        by_name, "tendermint_mempool_residency_seconds")
+    for vtype in ("prevote", "precommit"):
+        cell = _hist_summary(
+            by_name, "tendermint_consensus_quorum_wait_seconds",
+            match={"type": vtype})
+        if cell:
+            tl["quorum_wait"][vtype] = cell
+
     mem: dict[str, dict] = {}
     for labels, v in by_name.get("tendermint_crypto_device_memory_bytes", []):
         dev = labels.get("device", "?")
@@ -261,6 +277,40 @@ def _fold_metrics(snap: dict, by_name: dict) -> None:
                                      "platform": labels.get("platform", "?")})
         entry[labels.get("kind", "bytes")] = int(v)
     snap["device_memory"] = [mem[k] for k in sorted(mem)]
+
+
+def _hist_summary(by_name, base: str, match: dict | None = None):
+    """{count, mean_s, p50_s, p95_s} from a histogram's exposition
+    series (quantiles are cumulative-bucket UPPER bounds — read '≤');
+    None when the histogram has no observations.  `match` filters by
+    label values (labeled histograms, e.g. quorum_wait by type)."""
+    def _rows(suffix):
+        rows = by_name.get(base + suffix, [])
+        if match:
+            rows = [(l, v) for l, v in rows
+                    if all(l.get(k) == v2 for k, v2 in match.items())]
+        return rows
+
+    count = sum(v for _l, v in _rows("_count"))
+    if not count:
+        return None
+    total = sum(v for _l, v in _rows("_sum"))
+    # cumulative buckets, folded across labelsets, sorted by edge
+    cum: dict[float, float] = {}
+    for labels, v in _rows("_bucket"):
+        le = labels.get("le", "+Inf")
+        edge = float("inf") if le == "+Inf" else float(le)
+        cum[edge] = cum.get(edge, 0.0) + v
+
+    def quantile(q):
+        target = q * count
+        for edge in sorted(cum):
+            if cum[edge] >= target:
+                return None if edge == float("inf") else edge
+        return None
+
+    return {"count": int(count), "mean_s": round(total / count, 4),
+            "p50_s": quantile(0.5), "p95_s": quantile(0.95)}
 
 
 def _rung_key(rung: str):
@@ -352,6 +402,22 @@ def render(snap: dict) -> str:
         f"  recompiles {comp['recompiles']}  state {warm}"
         + (f"  [{stxt}]" if stxt else "")
         + (f"  [{ctxt}]" if ctxt else ""))
+    tl = snap.get("txlife") or {}
+
+    def _lat(cell) -> str:
+        if not cell:
+            return "-"
+        p50 = f"≤{1e3 * cell['p50_s']:.0f}ms" if cell["p50_s"] is not None else "-"
+        p95 = f"≤{1e3 * cell['p95_s']:.0f}ms" if cell["p95_s"] is not None else "-"
+        return f"n={cell['count']} p50{p50} p95{p95}"
+
+    if tl.get("finality") or tl.get("residency") or tl.get("quorum_wait"):
+        qw = tl.get("quorum_wait") or {}
+        qtxt = "  ".join(f"{k} {_lat(v)}" for k, v in sorted(qw.items()))
+        lines.append(
+            f"txlife     finality {_lat(tl.get('finality'))}"
+            f"  residency {_lat(tl.get('residency'))}"
+            + (f"  quorum-wait {qtxt}" if qtxt else ""))
     if snap["device_memory"]:
         for e in snap["device_memory"]:
             detail = "  ".join(

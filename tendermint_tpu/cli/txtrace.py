@@ -1,0 +1,165 @@
+"""`tendermint-tpu txtrace` — per-transaction cross-node waterfalls.
+
+Merges N nodes' event journals (the tx_* lifecycle lines written by
+utils/txlife.py plus the consensus quorum/commit events) into one
+waterfall per transaction:
+
+  submit (rpc/admit) → gossip send/first-recv per node → proposal
+  inclusion per node → prevote-quorum (polka) → precommit-quorum
+  (commit_maj) → commit → ABCI apply
+
+Cross-node timestamps are skew-corrected with the same pairwise
+clock-offset estimator the `timeline` subcommand uses
+(cli/timeline.estimate_offsets), so a constant per-node clock offset
+does not masquerade as gossip latency.  All times render relative to
+the tx's submit stamp.
+
+Pure data-in/data-out like cli/timeline.py; `cmd_txtrace` in
+cli/main.py is the arg-parsing shell.  Worked example in
+docs/observability.md "Transaction lifecycle".
+"""
+
+from __future__ import annotations
+
+from .timeline import estimate_offsets, merge_events
+
+#: waterfall row order; tx_* milestones come from the lifecycle hooks,
+#: the quorum rows from the height's consensus events
+STAGES = ("rpc", "admit", "send", "recv", "propose",
+          "prevote_quorum", "precommit_quorum", "commit", "apply")
+
+#: consensus journal events folded in as per-height context rows
+_HEIGHT_STAGE = {"polka": "prevote_quorum", "commit_maj": "precommit_quorum"}
+
+
+def build_txtrace(journals: dict[str, list[dict]],
+                  offsets: dict[str, float] | None = None) -> dict:
+    """Fold merged (optionally skew-corrected) journals into one
+    waterfall document per tx.
+
+    Returns {"nodes": [...], "clock_offsets_ms": {...}|None,
+    "txs": [waterfall, ...]} with each waterfall carrying the tx prefix,
+    the submit node/milestone, the commit height, per-(stage, node)
+    offsets in ms relative to submit, and the finality latency."""
+    merged = merge_events(journals, offsets=offsets)
+    txs: dict[str, dict] = {}
+    heights: dict[int, dict] = {}   # h -> stage -> node -> w
+
+    for ev in merged:
+        e = ev.get("e", "")
+        if isinstance(e, str) and e.startswith("tx_"):
+            tx = ev.get("tx")
+            if not tx:
+                continue
+            rec = txs.setdefault(tx, {"tx": tx, "height": None,
+                                      "per_node": {}, "peers": {}})
+            m = e[3:]
+            node, w = ev["n"], ev.get("w", 0)
+            stages = rec["per_node"].setdefault(node, {})
+            if m not in stages:   # merged is w-sorted: first-wins per node
+                stages[m] = w
+                peer = ev.get("to") or ev.get("from")
+                if peer and m in ("send", "recv"):
+                    rec["peers"][(m, node)] = peer
+            if m == "commit" and rec["height"] is None:
+                rec["height"] = ev.get("h")
+        elif e in _HEIGHT_STAGE:
+            h = ev.get("h")
+            if h is None:
+                continue
+            cell = heights.setdefault(h, {}).setdefault(_HEIGHT_STAGE[e], {})
+            cell.setdefault(ev["n"], ev.get("w", 0))
+
+    out = []
+    for tx, rec in txs.items():
+        # submit = the rpc ingress stamp when one exists, else the first
+        # mempool admission anywhere (gossip-only / direct-injection nets)
+        submit = None
+        for m in ("rpc", "admit"):
+            cands = [(stages[m], node)
+                     for node, stages in rec["per_node"].items()
+                     if m in stages]
+            if cands:
+                submit = (min(cands), m)
+                break
+        if submit is None:
+            continue  # stray tail events with no submit-side milestone
+        (t0, origin), submit_m = submit
+
+        rows: dict[str, dict] = {}
+        for node, stages in sorted(rec["per_node"].items()):
+            for m, w in stages.items():
+                rows.setdefault(m, {})[node] = round((w - t0) / 1e6, 3)
+        if rec["height"] in heights:
+            for stage, per_node in heights[rec["height"]].items():
+                rows[stage] = {n: round((w - t0) / 1e6, 3)
+                               for n, w in sorted(per_node.items())}
+
+        end = None
+        for m in ("apply", "commit"):
+            if m in rows:
+                end = min(rows[m].values())
+                break
+        out.append({
+            "tx": tx,
+            "height": rec["height"],
+            "submit_node": origin,
+            "submit_milestone": submit_m,
+            "submit_w": t0,
+            "finality_ms": end,
+            "stages": {m: rows[m] for m in STAGES if m in rows},
+            "gossip_peers": {f"{m}@{node}": peer
+                             for (m, node), peer in sorted(rec["peers"].items())},
+        })
+    out.sort(key=lambda r: r["submit_w"])
+    doc = {"nodes": sorted(journals), "txs": out}
+    if offsets is not None:
+        doc["clock_offsets_ms"] = {
+            n: round(offsets.get(n, 0.0) / 1e6, 3) for n in sorted(journals)}
+    return doc
+
+
+def render_txtrace(doc: dict, limit: int = 10) -> str:
+    """Text waterfalls, one block per tx (first `limit` by submit time;
+    0 = all)."""
+    lines = [f"nodes: {', '.join(doc['nodes'])}"]
+    offs = doc.get("clock_offsets_ms")
+    if offs is not None:
+        lines.append("clock offsets (estimated, applied): " + "  ".join(
+            f"{n} {offs.get(n, 0.0):+.2f}ms" for n in doc["nodes"]))
+    txs = doc["txs"]
+    shown = txs if limit <= 0 else txs[:limit]
+    for rec in shown:
+        fin = (f"{rec['finality_ms']:.1f}ms" if rec["finality_ms"] is not None
+               else "incomplete")
+        h = rec["height"] if rec["height"] is not None else "?"
+        lines.append("")
+        lines.append(f"tx {rec['tx']}  submit {rec['submit_node']}"
+                     f"@{rec['submit_milestone']}  height {h}"
+                     f"  finality {fin}")
+        for stage in STAGES:
+            cells = rec["stages"].get(stage)
+            if not cells:
+                continue
+            txt = "  ".join(f"{n} +{ms:.1f}ms"
+                            for n, ms in sorted(cells.items()))
+            arrow = "->" if stage == "send" else "<-"
+            peer_notes = [f"{k.split('@')[1]}{arrow}{p[:8]}"
+                          for k, p in rec.get("gossip_peers", {}).items()
+                          if k.startswith(f"{stage}@")]
+            note = f"  [{', '.join(peer_notes)}]" if peer_notes else ""
+            lines.append(f"  {stage:<16} {txt}{note}")
+    if len(txs) > len(shown):
+        lines.append("")
+        lines.append(f"({len(txs) - len(shown)} more tx(s) — raise --limit)")
+    if not txs:
+        lines.append("no tx lifecycle events in the journals "
+                     "(TM_TPU_TXLIFE off, or no load)")
+    return "\n".join(lines)
+
+
+def txtrace_from_journals(journals: dict[str, list[dict]],
+                          skew_correct: bool = True) -> dict:
+    """Convenience wrapper: estimate offsets (optional) then build."""
+    offsets = estimate_offsets(journals) if skew_correct else None
+    return build_txtrace(journals, offsets=offsets)

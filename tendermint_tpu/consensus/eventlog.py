@@ -21,7 +21,8 @@ One JSON object per line.  Common fields on every record:
 Event-specific fields are documented in docs/observability.md (one line
 per event type).  Heights/rounds ride as `h`/`r`; validator indices as
 `val`; peer attribution as `from` ("" = our own message via the
-internal queue); block hashes as 16-hex-char prefixes (`block`).
+internal queue); block hashes as 16-hex-char prefixes (`block`), tx
+hashes likewise (`tx`, written by the utils/txlife lifecycle hooks).
 
 Cost contract: the journal is OFF by default and every event site pays
 ONE branch — `ConsensusState.journal` is the shared `NOP` singleton
@@ -66,10 +67,20 @@ EVENT_TYPES = (
     "new_round",  # h, r, proposer (hex addr), val (proposer index)
     "proposal",   # h, r, proposer?, block, pol_round, from
     "vote",       # h, r, type (prevote|precommit), val, from, block, at_r
-    "polka",      # +2/3 prevotes: h, r, block ("" = nil polka)
-    "commit_maj", # +2/3 precommits for a block: h, r, block
+    "polka",      # +2/3 prevotes: h, r, block ("" = nil polka), wait_ms
+    "commit_maj", # +2/3 precommits for a block: h, r, block, wait_ms
     "timeout",    # timeout fired: h, r, step, dur_ms
     "commit",     # block committed: h, r, block, txs
+    # transaction lifecycle (utils/txlife.py; merged cross-node by
+    # `tendermint-tpu txtrace`).  All carry tx (16-hex sha256 prefix);
+    # heights ride as h where the milestone has one.
+    "tx_rpc",     # RPC broadcast_tx_* ingress: tx
+    "tx_admit",   # mempool admission (CheckTx OK, inserted): tx
+    "tx_send",    # mempool gossip first-send: tx, to (peer id)
+    "tx_recv",    # mempool gossip first-recv: tx, from (peer id)
+    "tx_propose", # tx seen in a completed proposal block: tx, h
+    "tx_commit",  # tx's block committed: tx, h
+    "tx_apply",   # tx applied through ABCI: tx, h
 )
 
 # Rotation/pruning checks stat() files, so they are amortized — but on a

@@ -26,6 +26,7 @@ import time
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.state import State
 from tendermint_tpu.utils import trace as _trace
+from tendermint_tpu.utils import txlife as _txlife
 from tendermint_tpu.utils.metrics import Histogram
 from tendermint_tpu.types import (
     Block,
@@ -113,9 +114,16 @@ class ConsensusState:
         # node wires a real one; every site guards on `.enabled` so the
         # disabled path costs one branch (bench.py journal-overhead stage)
         self.journal = eventlog.NOP
+        # tx lifecycle store (utils/txlife.py): NOP unless the node wires
+        # one; same one-branch-when-off contract as the journal
+        self.lifecycle = _txlife.NOP
         self._task: asyncio.Task | None = None
         self._stopping = False
         self._step_t0: float | None = None  # when the current step began
+        # quorum-wait anchors: "prevote"/"precommit" -> (h, r, mono t0),
+        # set when this node enters the step (casts its own vote) and
+        # consumed when the matching +2/3 quorum forms
+        self._quorum_t0: dict[str, tuple[int, int, float]] = {}
 
         self.reconstruct_last_commit(state)
         self.update_to_state(state)
@@ -456,10 +464,32 @@ class ConsensusState:
         prev = self.rs.step
         self.rs.round = round_
         self.rs.step = step
+        if step == Step.PREVOTE:
+            self._quorum_t0["prevote"] = (
+                self.rs.height, round_, time.perf_counter())
+        elif step == Step.PRECOMMIT:
+            self._quorum_t0["precommit"] = (
+                self.rs.height, round_, time.perf_counter())
         if self.journal.enabled and not self.replay_mode:
             self.journal.log("step", h=self.rs.height, r=round_,
                              step=step.name, prev=prev.name)
         self._emit("new_round_step")
+
+    def _quorum_wait(self, kind: str, height: int, round_: int) -> float | None:
+        """Seconds from this node entering the `kind` vote step (casting
+        its own vote) to the +2/3 quorum forming — observed once per
+        quorum into QUORUM_WAIT_SECONDS.  None (no observation) when the
+        anchor is missing or belongs to another (height, round), e.g.
+        after a round skip, or during WAL replay."""
+        ent = self._quorum_t0.pop(kind, None)
+        if ent is None or self.replay_mode:
+            return None
+        h, r, t0 = ent
+        if h != height or r != round_:
+            return None
+        dt = time.perf_counter() - t0
+        _txlife.QUORUM_WAIT_SECONDS.observe(dt, type=kind)
+        return dt
 
     def _observe_step(self) -> None:
         """Record how long the step we are leaving lasted — the
@@ -722,9 +752,13 @@ class ConsensusState:
             self.sign_add_vote(SignedMsgType.PRECOMMIT, b"", PartSetHeader())
             return
 
+        wait_s = self._quorum_wait("prevote", height, round_)
         if self.journal.enabled and not self.replay_mode:
-            self.journal.log("polka", h=height, r=round_,
-                             block=block_id.hash[:8].hex())
+            fields = {"h": height, "r": round_,
+                      "block": block_id.hash[:8].hex()}
+            if wait_s is not None:
+                fields["wait_ms"] = round(wait_s * 1e3, 3)
+            self.journal.log("polka", **fields)
         self._emit("polka", block_id)
 
         if block_id.is_zero():
@@ -795,9 +829,13 @@ class ConsensusState:
             raise RuntimeError("enter_commit without +2/3 precommits for a block")
         rs.commit_round = commit_round
         rs.commit_time_ns = now_ns()
+        wait_s = self._quorum_wait("precommit", height, commit_round)
         if self.journal.enabled and not self.replay_mode:
-            self.journal.log("commit_maj", h=height, r=commit_round,
-                             block=block_id.hash[:8].hex())
+            fields = {"h": height, "r": commit_round,
+                      "block": block_id.hash[:8].hex()}
+            if wait_s is not None:
+                fields["wait_ms"] = round(wait_s * 1e3, 3)
+            self.journal.log("commit_maj", **fields)
         self._update_round_step(rs.round, Step.COMMIT)
 
         if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
@@ -863,6 +901,14 @@ class ConsensusState:
             self.journal.log("commit", h=height, r=rs.commit_round,
                              block=block_id.hash[:8].hex(),
                              txs=len(block.data.txs))
+        if self.lifecycle.enabled and not self.replay_mode:
+            # committed-and-applied: both milestones stamp here, after
+            # the critical section (a lifecycle/journal I/O error must
+            # never read as a consensus-safety failure).  `commit` closes
+            # the mempool-residency window, `apply` the time-to-finality
+            # one and retires the tx from the live store.
+            self._stamp_block_txs(block, "commit")
+            self._stamp_block_txs(block, "apply")
         if retain_height > 0:
             try:
                 pruned = self.block_store.prune_blocks(retain_height)
@@ -882,6 +928,19 @@ class ConsensusState:
     # ------------------------------------------------------------------
     # message ingestion
     # ------------------------------------------------------------------
+
+    def _stamp_block_txs(self, block: Block, milestone: str) -> None:
+        """Stamp every tx in `block` with `milestone` (lifecycle store +
+        tx_* journal lines when the journal is on)."""
+        from tendermint_tpu.crypto.tmhash import sum_sha256
+
+        life = self.lifecycle
+        h = block.header.height
+        for tx in block.data.txs:
+            # both call sites hold the `lifecycle.enabled and not
+            # replay_mode` guard; this helper only shares the hash loop
+            # tmlint: disable=ungated-observability
+            life.stamp(sum_sha256(bytes(tx)), milestone, h=h)
 
     def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
         """Reference defaultSetProposal (state.go:1719)."""
@@ -933,6 +992,11 @@ class ConsensusState:
             return added
 
         rs.proposal_block = Block.decode(rs.proposal_block_parts.assemble())
+        if self.lifecycle.enabled and not self.replay_mode:
+            # proposal-inclusion milestone: the first time this node saw
+            # each tx inside a (completed) proposed block — the proposer
+            # itself assembles through the same internal-parts path
+            self._stamp_block_txs(rs.proposal_block, "propose")
         self._emit("complete_proposal", rs.proposal_block)
 
         prevotes = rs.votes.prevotes(rs.round)

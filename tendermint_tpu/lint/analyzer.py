@@ -14,8 +14,8 @@ Six rules, each motivated by a shipped bug or a hot-path invariant:
                            processes that never touch a device.
   ungated-observability    Sinks whose cost contract is "caller pays one
                            branch when disabled" (devmon STATS, the
-                           consensus journal) called without the
-                           `.enabled` guard.
+                           consensus journal, the txlife lifecycle
+                           store) called without the `.enabled` guard.
   host-sync-in-jit         `.item()` / `np.asarray` / `jax.device_get` /
                            `.block_until_ready` reachable inside a
                            jit-compiled function body: a host sync baked
@@ -61,8 +61,8 @@ RULES: dict[str, str] = {
         "— defer to point of use or gate with try/except",
     "ungated-observability":
         "observability sink whose disabled-path contract is one caller "
-        "branch (STATS.record_flush, journal.log) called without an "
-        "`.enabled` guard",
+        "branch (STATS.record_flush, journal.log, lifecycle.stamp) "
+        "called without an `.enabled` guard",
     "host-sync-in-jit":
         "host synchronization (.item/.tolist/np.asarray/jax.device_get/"
         ".block_until_ready) inside a jit-compiled function body",
@@ -86,7 +86,8 @@ JAX_ALLOWED_DIRS = {"ops", "parallel"}
 
 #: files that DEFINE the observability sinks: internal calls inside them
 #: are the implementation, not a call site
-OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py"}
+OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
+                           "txlife.py"}
 
 #: label names that explode series cardinality on a real network
 HIGH_CARDINALITY_LABELS = {"height", "hash", "tx_hash", "block_hash",
@@ -494,6 +495,16 @@ class _Walker:
                         node, "ungated-observability",
                         "journal.log() without an `if ...enabled:` guard "
                         "— the disabled path must cost one branch")
+            elif func.attr == "stamp" and not st.gated:
+                recv = func.value
+                recv_name = recv.attr if isinstance(recv, ast.Attribute) \
+                    else (recv.id if isinstance(recv, ast.Name) else "")
+                if recv_name.endswith(("lifecycle", "txlife")) \
+                        or recv_name in ("life", "LIFE"):
+                    self._report(
+                        node, "ungated-observability",
+                        "lifecycle.stamp() without an `if ...enabled:` "
+                        "guard — the disabled path must cost one branch")
 
         # host-sync-in-jit
         if st.in_jit and isinstance(func, ast.Attribute):

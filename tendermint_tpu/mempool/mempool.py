@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 
 from tendermint_tpu import abci
 from tendermint_tpu.crypto.tmhash import sum_sha256
+from tendermint_tpu.utils import txlife as _txlife
 from tendermint_tpu.utils.log import Logger, nop_logger
 
 from .cache import LRUTxCache, NopTxCache
@@ -105,6 +106,9 @@ class Mempool:
         self.post_check = None  # callable(tx, ResponseCheckTx) -> None
         self._txs_available: asyncio.Event | None = None
         self._notified_txs_available = False
+        # tx lifecycle store (utils/txlife.py): NOP unless the node wires
+        # one; the admission/gossip hook sites pay one branch when off
+        self.lifecycle = _txlife.NOP
         # optional raw-tx WAL (reference clist_mempool.go InitWAL: recovery
         # aid only — replayed manually by operators, never by the node)
         self._wal = None
@@ -209,8 +213,15 @@ class Mempool:
                 )
                 if sender:
                     memtx.senders.add(sender)
-                self._txs[sum_sha256(tx)] = memtx
+                key = sum_sha256(tx)
+                self._txs[key] = memtx
                 self._total_bytes += len(tx)
+                if self.lifecycle.enabled:
+                    # admission milestone; a gossip-delivered tx (sender
+                    # set) is also this node's first-recv of it
+                    self.lifecycle.stamp(key, "admit")
+                    if sender:
+                        self.lifecycle.stamp(key, "recv", peer=sender)
                 self._notify_txs_available()
                 return
         # invalid: evict from cache unless configured to keep

@@ -142,6 +142,11 @@ class MempoolReactor:
                     advanced = True
                     if node_id in memtx.senders:
                         continue  # peer gave us this tx
+                    life = self.mempool.lifecycle
+                    if life.enabled:
+                        # gossip first-send (first-wins in the store, so
+                        # later peers never move the stamp)
+                        life.stamp(key, "send", peer=node_id)
                     pending.append(memtx.tx)
                     if len(pending) >= self.batch_txs:
                         await self.ch.send(

@@ -329,6 +329,16 @@ class NodeMetrics:
         for hist in _av.PIPELINE_HISTOGRAMS:
             reg.register(hist)
 
+        # -- transaction lifecycle (utils/txlife.py) --------------------
+        # the user-facing latency signal: time-to-finality (rpc ingress →
+        # applied), mempool residency (admission → commit) and quorum
+        # wait (own vote → +2/3), observed at their source milestones —
+        # per tx at commit and per quorum formation, never per signature
+        from tendermint_tpu.utils import txlife as _txlife
+
+        for hist in _txlife.LIFECYCLE_HISTOGRAMS:
+            reg.register(hist)
+
         # -- state ------------------------------------------------------
         self.state = StateMetrics(reg, ns)
 

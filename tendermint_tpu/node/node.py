@@ -303,6 +303,18 @@ class Node:
             node=config.base.moniker or self.node_key.node_id[:8],
             data_dir=config.db_dir,
         )
+        # tx lifecycle tracer (TM_TPU_TXLIFE, default on; utils/txlife.py):
+        # one store per node, shared by the RPC ingress hooks, the
+        # mempool admission/gossip hooks and the consensus commit/apply
+        # hooks; tx_* journal lines ride the consensus journal above
+        from tendermint_tpu.utils import txlife as _txlife
+
+        self.txlife = _txlife.from_env(
+            journal=self.consensus.journal,
+            node=config.base.moniker or self.node_key.node_id[:8],
+        )
+        self.consensus.lifecycle = self.txlife
+        self.mempool.lifecycle = self.txlife
         self.consensus_reactor = ConsensusReactor(
             self.consensus, self.router, self.block_store, logger=self.logger
         )
@@ -380,6 +392,7 @@ class Node:
             add_private_peer_id=self.add_private_peer_id,
             node_id=self.node_key.node_id,
             moniker=config.base.moniker,
+            txlife=self.txlife,
         )
         self.grpc_server = None
         self.pprof_server = None

@@ -16,6 +16,7 @@ import itertools
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import tmhash
 from tendermint_tpu.pubsub import SubscriptionCancelledError
+from tendermint_tpu.utils import txlife as _txlife
 from tendermint_tpu.pubsub.query import parse as parse_query
 from tendermint_tpu.types import events as tmevents
 
@@ -47,6 +48,7 @@ class Environment:
         node_id: str = "",
         moniker: str = "tpu-node",
         version: str = "0.1.0",
+        txlife=None,
     ):
         self.config = config
         self.genesis = genesis
@@ -66,6 +68,9 @@ class Environment:
         self.node_id = node_id
         self.moniker = moniker
         self.version = version
+        # tx lifecycle store (utils/txlife.py): the broadcast_tx_* routes
+        # stamp RPC ingress — the start of the time-to-finality clock
+        self.txlife = txlife if txlife is not None else _txlife.NOP
 
 
 def _latest_height(env: Environment) -> int:
@@ -368,13 +373,19 @@ _tx_commit_seq = itertools.count(1)
 
 def broadcast_tx_async(env: Environment, tx=None) -> dict:
     data = _bytes_param(tx)
+    tx_hash = tmhash.sum_sha256(data)
+    if env.txlife.enabled:
+        env.txlife.stamp(tx_hash, "rpc")
     # fire-and-forget (reference mempool.go:22-36): CheckTx result ignored
     env.mempool.check_tx(data)
-    return {"code": 0, "data": "", "log": "", "hash": enc.hexu(tmhash.sum_sha256(data))}
+    return {"code": 0, "data": "", "log": "", "hash": enc.hexu(tx_hash)}
 
 
 def broadcast_tx_sync(env: Environment, tx=None) -> dict:
     data = _bytes_param(tx)
+    tx_hash = tmhash.sum_sha256(data)
+    if env.txlife.enabled:
+        env.txlife.stamp(tx_hash, "rpc")
     try:
         res = env.mempool.check_tx(data)
     except Exception as e:
@@ -384,7 +395,7 @@ def broadcast_tx_sync(env: Environment, tx=None) -> dict:
         "data": enc.b64(res.data),
         "log": res.log,
         "codespace": res.codespace,
-        "hash": enc.hexu(tmhash.sum_sha256(data)),
+        "hash": enc.hexu(tx_hash),
     }
 
 
@@ -393,6 +404,8 @@ async def broadcast_tx_commit(env: Environment, tx=None) -> dict:
     rpc/core/mempool.go:55-136, 10s timeout)."""
     data = _bytes_param(tx)
     tx_hash = tmhash.sum_sha256(data)
+    if env.txlife.enabled:
+        env.txlife.stamp(tx_hash, "rpc")
     if env.event_bus is None:
         raise RPCError(INTERNAL_ERROR, "event bus unavailable")
     # unique per request: two concurrent broadcasts of the SAME tx must not

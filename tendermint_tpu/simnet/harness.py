@@ -52,6 +52,7 @@ from tendermint_tpu.types import GenesisDoc, GenesisValidator
 from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 from tendermint_tpu.utils import fail
 from tendermint_tpu.utils.log import Logger, nop_logger
+from tendermint_tpu.utils.txlife import TxLifecycle
 
 from tendermint_tpu.cli.timeline import build_timeline
 
@@ -147,6 +148,12 @@ class SimNode:
         )
         self.journal_path = os.path.join(home, "journal.jsonl")
         self.cs.journal = EventJournal(self.journal_path, node=self.name)
+        # tx lifecycle tracer: milestones (admit/gossip/propose/commit/
+        # apply) ride this node's journal as tx_* lines, which is what
+        # the verdict's finality percentiles and `txtrace` read back
+        self.txlife = TxLifecycle(journal=self.cs.journal, node=self.name)
+        self.cs.lifecycle = self.txlife
+        self.mempool.lifecycle = self.txlife
 
         self.router = Router(self.node_id,
                              network.create_transport(self.node_id),

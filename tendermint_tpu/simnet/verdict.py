@@ -26,6 +26,12 @@ Invariants (each names itself in `violations` on failure):
                Conversely, equivocation with NO maverick configured is a
                violation on its own (someone forged votes).
 
+Beyond the invariants, the report carries the BENCH metrics (accepted
+tx/s, heights/min, rounds>0 streaks, recovery-after-heal) and — from the
+tx_* lifecycle journal lines — per-scenario time-to-finality percentiles
+with fault windows excluded (`finality`), so adversity runs report
+latency next to throughput.
+
 Exit-code contract (cli/main.py simnet): verdict ok -> 0, any violation
 -> 1, with the violated invariant named in the JSON report.
 """
@@ -88,6 +94,49 @@ def _commit_stalls(report: TimelineReport, run_info: dict,
                     "budget_s": round(budget_s, 3),
                 })
     return stalls
+
+
+def _finality_stats(report: TimelineReport, run_info: dict,
+                    grace_ns: int) -> dict:
+    """Time-to-finality distribution over the run's transactions, from
+    the tx_* journal events the lifecycle hooks wrote: first submit-side
+    milestone anywhere (rpc, else mempool admission — the simnet load
+    driver injects straight into mempools) to first commit-side
+    milestone anywhere (apply, else commit).  Lifecycles overlapping a
+    fault window (each extended by the stall grace, same exclusion rule
+    as the stall budget) are excluded, so the percentiles report
+    steady-state latency and `max_s` its worst clean case."""
+    windows = [(w["t0_ns"], w.get("t1_ns", w["t0_ns"]) + grace_ns)
+               for w in run_info.get("fault_windows", [])]
+    samples: list[float] = []
+    excluded = incomplete = 0
+    for tv in report.txs.values():
+        start = tv.first.get("rpc") or tv.first.get("admit")
+        end = tv.first.get("apply") or tv.first.get("commit")
+        if start is None or end is None or end[0] < start[0]:
+            incomplete += 1
+            continue
+        if _overlaps(start[0], end[0], windows):
+            excluded += 1
+            continue
+        samples.append((end[0] - start[0]) / 1e9)
+    samples.sort()
+
+    def pct(q: float):
+        if not samples:
+            return None
+        idx = min(len(samples) - 1, int(q * (len(samples) - 1) + 0.5))
+        return round(samples[idx], 4)
+
+    return {
+        "count": len(samples),
+        "p50_s": pct(0.50),
+        "p95_s": pct(0.95),
+        "p99_s": pct(0.99),
+        "max_s": round(samples[-1], 4) if samples else None,
+        "excluded_in_fault_windows": excluded,
+        "incomplete": incomplete,
+    }
 
 
 def _recovery_after_heal(report: TimelineReport, run_info: dict) -> list[dict]:
@@ -230,6 +279,10 @@ def evaluate(scenario: Scenario, report: TimelineReport,
             "accepted_tx_per_s": round(accepted / duration_s, 2)
                                  if duration_s else 0.0,
         },
+        # accepted-tx/s finally gets its latency twin: per-tx
+        # time-to-finality from the merged journals, fault windows
+        # excluded like the stall budget
+        "finality": _finality_stats(report, run_info, int(budget_s * 1e9)),
         "rounds": {
             "max_round": max_round,
             "heights_with_rounds_gt0": rounds_gt0,

@@ -1,0 +1,204 @@
+"""Transaction-lifecycle observability: the per-tx milestone store and
+the user-facing latency histograms behind it.
+
+Everything observed so far (spans, per-peer p2p series, the consensus
+journal, devmon) answers "how is the machinery doing"; nothing answered
+the question a USER asks — how long does a transaction take from RPC
+ingress to committed-and-applied.  This module is that signal:
+
+  * every hook site (rpc broadcast_tx_*, mempool admission, mempool
+    gossip first-send/first-recv, proposal inclusion, commit, ABCI
+    apply) stamps the tx hash with a milestone via `stamp()`;
+  * milestones land in a BOUNDED per-node store (oldest tx evicted);
+  * completing milestones feed three always-on histograms —
+    `tendermint_tx_time_to_finality_seconds` (rpc|first-seen → applied),
+    `tendermint_mempool_residency_seconds` (admission → committed) and
+    `tendermint_consensus_quorum_wait_seconds{type=prevote|precommit}`
+    (own vote cast → +2/3 observed; observed by consensus/state.py at
+    quorum formation, a handful of events per block);
+  * when the node's event journal (consensus/eventlog.py) is enabled,
+    each FIRST stamp also writes a `tx_*` journal line, which is what
+    `tendermint-tpu txtrace` merges across N nodes into the per-tx
+    cross-node waterfall.
+
+Cost contract (same rule as the journal and devmon.STATS, enforced by
+tmlint's `ungated-observability` and the bench `txlife-overhead` stage):
+every hook site guards with `if <lifecycle>.enabled:` so the disabled
+path costs one attribute load + branch; the module-level `NOP` singleton
+is the disabled counterpart.  The enabled path is dict ops + (when the
+journal is on) one journal line — no hashing: every site already holds
+the sha256 tx key the mempool keys its pool by.
+
+Env knobs (resolved at construction, never at import — tmlint
+`import-time-env`):
+  TM_TPU_TXLIFE   default on; "0"/"false"/"off" disables (all hook
+                  sites collapse to the one-branch NOP path).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict, deque
+
+from tendermint_tpu.utils.metrics import Histogram
+
+ENV_FLAG = "TM_TPU_TXLIFE"
+
+#: milestone names, in lifecycle order; each journals as "tx_<name>"
+MILESTONES = ("rpc", "admit", "send", "recv", "propose", "commit", "apply")
+
+DEFAULT_MAX_ENTRIES = 4096   # live (not yet applied) txs tracked
+DEFAULT_KEEP_DONE = 64       # completed lifecycle records kept for top/debug
+
+_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                    5.0, 10.0, 30.0, 60.0)
+
+# Always-on histograms (node/metrics.py registers them; multiple in-proc
+# nodes share them like STEP_DURATION_SECONDS — per-node separation is
+# the journal's job).  Observed per tx at commit/apply and per quorum at
+# formation — never in a per-signature path.
+TX_TIME_TO_FINALITY_SECONDS = Histogram(
+    "tx_time_to_finality_seconds",
+    "Transaction latency from RPC ingress (or first local sighting for "
+    "gossip-only txs) to committed-and-applied",
+    namespace="tendermint",
+    buckets=_LATENCY_BUCKETS,
+)
+MEMPOOL_RESIDENCY_SECONDS = Histogram(
+    "residency_seconds",
+    "Time a transaction spent in the mempool, admission to commit",
+    namespace="tendermint", subsystem="mempool",
+    buckets=_LATENCY_BUCKETS,
+)
+QUORUM_WAIT_SECONDS = Histogram(
+    "quorum_wait_seconds",
+    "Time from this node casting its own vote (entering the step) to "
+    "observing the +2/3 quorum, by vote type",
+    namespace="tendermint", subsystem="consensus",
+    label_names=("type",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0),
+)
+
+#: the set node/metrics.py registers (mirrors async_verify's
+#: PIPELINE_HISTOGRAMS idiom)
+LIFECYCLE_HISTOGRAMS = (
+    TX_TIME_TO_FINALITY_SECONDS,
+    MEMPOOL_RESIDENCY_SECONDS,
+    QUORUM_WAIT_SECONDS,
+)
+
+
+class _NopJournal:
+    enabled = False
+
+    def log(self, event: str, **fields) -> None:
+        pass
+
+
+_NOP_JOURNAL = _NopJournal()
+
+
+class TxLifecycle:
+    """One node's bounded tx-milestone store.  `enabled` is True so the
+    one-branch guard at hook sites passes; `NOP` is the disabled twin.
+
+    Milestones are first-wins per tx (gossip echoes and re-sends never
+    move a stamp), keyed by the sha256 tx hash the mempool already
+    maintains.  A tx retires from the live store at `apply` into a small
+    completed ring; the live store evicts oldest-first at `max_entries`
+    so a flood of never-committed txs cannot grow memory.
+    """
+
+    enabled = True
+
+    def __init__(self, journal=None, node: str = "",
+                 max_entries: int = DEFAULT_MAX_ENTRIES,
+                 keep_done: int = DEFAULT_KEEP_DONE):
+        self.journal = journal if journal is not None else _NOP_JOURNAL
+        self.node = node
+        self.max_entries = max(1, max_entries)
+        self._live: OrderedDict[bytes, dict] = OrderedDict()
+        self.done: deque = deque(maxlen=keep_done)
+        self.stamped = 0    # first-stamps recorded
+        self.finalized = 0  # txs that reached `apply`
+        self.evicted = 0    # live entries dropped by the bound
+
+    def stamp(self, tx_hash: bytes, milestone: str, h: int | None = None,
+              peer: str = "") -> None:
+        """Record `milestone` for `tx_hash` (first-wins).  `h` is the
+        block height where meaningful (propose/commit/apply); `peer` is
+        the gossip counterparty (`recv`: who delivered it; `send`: who
+        it was sent to)."""
+        rec = self._live.get(tx_hash)
+        if rec is None:
+            rec = self._live[tx_hash] = {}
+            while len(self._live) > self.max_entries:
+                self._live.popitem(last=False)
+                self.evicted += 1
+        if milestone in rec:
+            return
+        w = time.time_ns()
+        rec[milestone] = w
+        self.stamped += 1
+        if self.journal.enabled:
+            fields: dict = {"tx": tx_hash[:8].hex()}
+            if h is not None:
+                fields["h"] = h
+            if peer:
+                fields["to" if milestone == "send" else "from"] = peer
+            self.journal.log("tx_" + milestone, **fields)
+        if milestone == "commit":
+            admit = rec.get("admit")
+            if admit is not None:
+                MEMPOOL_RESIDENCY_SECONDS.observe((w - admit) / 1e9)
+        elif milestone == "apply":
+            start = rec.get("rpc", rec.get("admit"))
+            if start is not None:
+                TX_TIME_TO_FINALITY_SECONDS.observe((w - start) / 1e9)
+            self.finalized += 1
+            self.done.append({"tx": tx_hash[:8].hex(), "h": h, **rec})
+            self._live.pop(tx_hash, None)
+
+    def live_count(self) -> int:
+        return len(self._live)
+
+    def stats(self) -> dict:
+        """Debug snapshot (rpc/top never require it; tests do)."""
+        return {
+            "live": len(self._live),
+            "stamped": self.stamped,
+            "finalized": self.finalized,
+            "evicted": self.evicted,
+        }
+
+
+class _NopLifecycle:
+    """Disabled lifecycle: `.enabled` is False and the (never-taken)
+    stamp path is a no-op, so a hook site costs one branch."""
+
+    enabled = False
+    done: deque = deque()
+
+    def stamp(self, tx_hash: bytes, milestone: str, h: int | None = None,
+              peer: str = "") -> None:
+        pass
+
+    def live_count(self) -> int:
+        return 0
+
+    def stats(self) -> dict:
+        return {"live": 0, "stamped": 0, "finalized": 0, "evicted": 0}
+
+
+NOP = _NopLifecycle()
+
+
+def from_env(journal=None, node: str = "") -> "TxLifecycle | _NopLifecycle":
+    """Build a lifecycle store per TM_TPU_TXLIFE (default ON), or return
+    the NOP singleton when disabled."""
+    raw = os.environ.get(ENV_FLAG, "1").lower()
+    if raw in ("0", "false", "off"):
+        return NOP
+    return TxLifecycle(journal=journal, node=node)

@@ -5,8 +5,9 @@ from tendermint_tpu.utils import devmon
 
 
 class Site:
-    def __init__(self, journal):
+    def __init__(self, journal, lifecycle):
         self.journal = journal
+        self.lifecycle = lifecycle
         self.replay_mode = False
 
     def flush_ungated(self, n, rung):
@@ -14,6 +15,23 @@ class Site:
 
     def journal_ungated(self, h):
         self.journal.log("step", h=h)  # LINT: ungated-observability
+
+    def stamp_ungated(self, key):
+        self.lifecycle.stamp(key, "admit")  # LINT: ungated-observability
+
+    def stamp_ungated_local(self, key):
+        life = self.lifecycle
+        life.stamp(key, "recv", peer="p")  # LINT: ungated-observability
+
+    def stamp_gated(self, key):
+        if self.lifecycle.enabled:
+            self.lifecycle.stamp(key, "admit")
+
+    def stamp_early_exit(self, key):
+        life = self.lifecycle
+        if not life.enabled:
+            return
+        life.stamp(key, "send", peer="p")
 
     def flush_gated(self, n, rung):
         if devmon.STATS.enabled:

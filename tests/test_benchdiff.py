@@ -86,6 +86,14 @@ def test_normalize_checked_in_artifacts_all_shapes():
     ("commit10k_p50_ms", "latency", "lower"),
     ("commit10k_device_only_p50_ms", "latency", "lower"),
     ("journal_enabled_us_per_event", "latency", "lower"),
+    # tx-latency stage (ISSUE 9): finality percentiles are tracked at
+    # the latency class's 10% default threshold
+    ("tx_finality_p50_ms", "latency", "lower"),
+    ("tx_finality_p95_ms", "latency", "lower"),
+    ("tx_finality_p99_ms", "latency", "lower"),
+    ("txlife_enabled_us_per_stamp", "latency", "lower"),
+    ("tx_latency_accepted_tx_per_s", "throughput", "higher"),
+    ("tx_latency_ok", "boolean", "higher"),
     ("warmstart_cold_s", "timing", "lower"),
     ("lint_seconds", "timing", "lower"),
     ("warmstart_cold_compiles", "count", "lower"),

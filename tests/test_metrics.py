@@ -322,6 +322,26 @@ def test_node_serves_prometheus(tmp_path):
                     "gauge") in text
             assert ("# TYPE tendermint_crypto_verify_device_peak_flops_per_s "
                     "gauge") in text
+            # tx lifecycle histograms (ISSUE 9, utils/txlife): typed on
+            # every scrape; this node committed a tx it admitted itself,
+            # so finality + mempool residency have observations, and the
+            # single-validator quorum (its own vote) fed quorum-wait
+            assert ("# TYPE tendermint_tx_time_to_finality_seconds "
+                    "histogram") in text
+            assert ("# TYPE tendermint_mempool_residency_seconds "
+                    "histogram") in text
+            assert ("# TYPE tendermint_consensus_quorum_wait_seconds "
+                    "histogram") in text
+            assert float(
+                lines["tendermint_tx_time_to_finality_seconds_count"]) >= 1
+            assert float(
+                lines["tendermint_mempool_residency_seconds_count"]) >= 1
+            qw_counts = [
+                float(v) for k, v in lines.items()
+                if k.startswith(
+                    "tendermint_consensus_quorum_wait_seconds_count")
+            ]
+            assert qw_counts and sum(qw_counts) >= 1
             step_counts = [
                 float(v) for k, v in lines.items()
                 if k.startswith("tendermint_consensus_step_duration_seconds_count")

@@ -515,6 +515,13 @@ def test_simnet_smoke_partition_crash_maverick(tmp_path):
     rep = _run(sc, tmp_path)
     assert rep["ok"], rep["violations"]
     assert rep["heights"]["min_honest"] >= 6
+    # accepted-tx/s carries its latency twin: time-to-finality
+    # percentiles from the tx_* journal lines, fault windows excluded
+    fin = rep["finality"]
+    assert fin["count"] > 0, fin
+    assert fin["p50_s"] is not None and fin["p50_s"] > 0
+    assert fin["p99_s"] >= fin["p95_s"] >= fin["p50_s"]
+    assert fin["max_s"] >= fin["p99_s"]
     # recovery metrics recorded for the heal and the restart
     assert rep["recovery"]["max_recovery_s"] is not None
     assert rep["restarts"] == {"node2": 1}

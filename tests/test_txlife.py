@@ -1,0 +1,432 @@
+"""Transaction-lifecycle observability (utils/txlife.py + its hook
+sites).
+
+Covers: the bounded first-wins milestone store and its histogram
+observations; the NOP one-branch disabled contract at every hook site
+(rpc ingress, mempool admission/gossip, consensus propose/commit/apply);
+TM_TPU_TXLIFE gating; tx_* journal emission; quorum-wait observation and
+the polka/commit_maj `wait_ms` enrichment through a real committed
+height; and the ISSUE 9 acceptance — a live in-process 4-node net whose
+finality lands in the /metrics histograms and whose merged journals
+render a per-tx cross-node waterfall through `txtrace` with
+skew-corrected timestamps.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import AppConns
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus.eventlog import EventJournal, read_events
+from tendermint_tpu.crypto.batch import set_default_backend
+from tendermint_tpu.crypto.tmhash import sum_sha256
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.mempool.mempool import MempoolConfig
+from tendermint_tpu.utils import txlife
+from tendermint_tpu.utils.metrics import Registry
+
+from test_multinode import make_net, start_mesh, wait_all_height
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def _hist_count(hist, **labels) -> int:
+    key = tuple(str(labels.get(n, "")) for n in hist.label_names)
+    stats = hist.label_stats()
+    return stats.get(key, (0, 0.0))[0]
+
+
+def _mk_mempool():
+    return Mempool(MempoolConfig(), AppConns(KVStoreApplication()).mempool())
+
+
+# ---------------------------------------------------------------------------
+# store unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_store_first_wins_and_milestone_order():
+    life = txlife.TxLifecycle(node="n0")
+    k = b"\xaa" * 32
+    life.stamp(k, "admit")
+    first = life._live[k]["admit"]
+    life.stamp(k, "admit")  # echo: must not move
+    assert life._live[k]["admit"] == first
+    life.stamp(k, "send", peer="p1")
+    life.stamp(k, "recv", peer="p2")
+    assert set(life._live[k]) == {"admit", "send", "recv"}
+    assert life.stats()["stamped"] == 3
+
+
+def test_store_is_bounded_oldest_evicted():
+    life = txlife.TxLifecycle(node="n0", max_entries=8)
+    keys = [i.to_bytes(32, "big") for i in range(20)]
+    for k in keys:
+        life.stamp(k, "admit")
+    assert life.live_count() == 8
+    assert life.evicted == 12
+    # the newest 8 survive
+    assert all(k in life._live for k in keys[-8:])
+
+
+def test_finality_and_residency_observed_and_tx_retires():
+    life = txlife.TxLifecycle(node="n0")
+    fin0 = _hist_count(txlife.TX_TIME_TO_FINALITY_SECONDS)
+    res0 = _hist_count(txlife.MEMPOOL_RESIDENCY_SECONDS)
+    k = b"\xbb" * 32
+    life.stamp(k, "rpc")
+    life.stamp(k, "admit")
+    life.stamp(k, "propose", h=3)
+    life.stamp(k, "commit", h=3)
+    life.stamp(k, "apply", h=3)
+    assert _hist_count(txlife.TX_TIME_TO_FINALITY_SECONDS) == fin0 + 1
+    assert _hist_count(txlife.MEMPOOL_RESIDENCY_SECONDS) == res0 + 1
+    # retired from the live store into the completed ring
+    assert k not in life._live
+    done = life.done[-1]
+    assert done["h"] == 3 and done["tx"] == k[:8].hex()
+    assert {"rpc", "admit", "propose", "commit", "apply"} <= set(done)
+    assert life.finalized == 1
+
+
+def test_finality_falls_back_to_admit_without_rpc():
+    life = txlife.TxLifecycle(node="n0")
+    fin0 = _hist_count(txlife.TX_TIME_TO_FINALITY_SECONDS)
+    k = b"\xcc" * 32
+    life.stamp(k, "admit")
+    life.stamp(k, "commit", h=1)
+    life.stamp(k, "apply", h=1)
+    assert _hist_count(txlife.TX_TIME_TO_FINALITY_SECONDS) == fin0 + 1
+    # a tx this node never saw pre-commit observes nothing
+    fin1 = _hist_count(txlife.TX_TIME_TO_FINALITY_SECONDS)
+    k2 = b"\xcd" * 32
+    life.stamp(k2, "commit", h=2)
+    life.stamp(k2, "apply", h=2)
+    assert _hist_count(txlife.TX_TIME_TO_FINALITY_SECONDS) == fin1
+
+
+def test_nop_contract_and_env_gating(monkeypatch):
+    assert txlife.NOP.enabled is False
+    txlife.NOP.stamp(b"\x00" * 32, "admit")  # harmless no-op
+    assert txlife.NOP.stats()["stamped"] == 0
+    monkeypatch.setenv("TM_TPU_TXLIFE", "0")
+    assert txlife.from_env() is txlife.NOP
+    monkeypatch.setenv("TM_TPU_TXLIFE", "off")
+    assert txlife.from_env() is txlife.NOP
+    monkeypatch.delenv("TM_TPU_TXLIFE")
+    life = txlife.from_env(node="x")
+    assert isinstance(life, txlife.TxLifecycle) and life.enabled
+
+
+def test_journal_tx_event_emission(tmp_path):
+    jr = EventJournal(str(tmp_path / "j.jsonl"), node="n0")
+    life = txlife.TxLifecycle(journal=jr, node="n0")
+    k = b"\xee" * 32
+    life.stamp(k, "rpc")
+    life.stamp(k, "send", peer="peer-b")
+    life.stamp(k, "recv", peer="peer-a")
+    life.stamp(k, "propose", h=4)
+    life.stamp(k, "propose", h=4)  # dup: no second line
+    jr.close()
+    events = read_events(str(tmp_path / "j.jsonl"))
+    assert [e["e"] for e in events] == ["tx_rpc", "tx_send", "tx_recv",
+                                       "tx_propose"]
+    assert all(e["tx"] == k[:8].hex() for e in events)
+    assert events[1]["to"] == "peer-b"       # send records the recipient
+    assert events[2]["from"] == "peer-a"     # recv records the deliverer
+    assert events[3]["h"] == 4
+
+
+# ---------------------------------------------------------------------------
+# hook sites
+# ---------------------------------------------------------------------------
+
+
+def test_mempool_admission_hooks():
+    mp = _mk_mempool()
+    # default: the NOP — admission costs one branch, records nothing
+    mp.check_tx(b"off=1")
+    assert txlife.NOP.stats()["stamped"] == 0
+
+    life = txlife.TxLifecycle(node="n0")
+    mp.lifecycle = life
+    mp.check_tx(b"local=1")                    # RPC/local: admit only
+    mp.check_tx(b"gossip=1", sender="peerX")   # gossip: admit + recv
+    k_local = sum_sha256(b"local=1")
+    k_gossip = sum_sha256(b"gossip=1")
+    assert set(life._live[k_local]) == {"admit"}
+    assert set(life._live[k_gossip]) == {"admit", "recv"}
+
+
+def test_mempool_reactor_gossip_send_stamp():
+    """The gossip loop stamps first-send with the peer it sent to,
+    exercised through the real reactor against a 2-node memory net."""
+
+    async def run():
+        nodes = make_net(2)
+        lives = []
+        for n in nodes:
+            life = txlife.TxLifecycle(node="t")
+            n.mempool.lifecycle = life
+            n.cs.lifecycle = life
+            lives.append(life)
+        await start_mesh(nodes)
+        nodes[0].mempool.check_tx(b"send=stamp")
+        k = sum_sha256(b"send=stamp")
+
+        async def wait_send():
+            while True:
+                rec = lives[0]._live.get(k) or next(
+                    (d for d in lives[0].done if d["tx"] == k[:8].hex()), None)
+                if rec and "send" in rec:
+                    return
+                await asyncio.sleep(0.02)
+
+        try:
+            await asyncio.wait_for(wait_send(), 20.0)
+        finally:
+            for n in nodes:
+                await n.stop()
+        # receiver saw it as gossip: admit + recv stamped
+        rec1 = lives[1]._live.get(k) or next(
+            (d for d in lives[1].done if d["tx"] == k[:8].hex()), None)
+        assert rec1 is not None and "recv" in rec1 and "admit" in rec1
+
+    asyncio.run(run())
+
+
+def test_rpc_broadcast_stamps_ingress():
+    from tendermint_tpu.rpc import core as rpc_core
+
+    mp = _mk_mempool()
+    life = txlife.TxLifecycle(node="n0")
+    mp.lifecycle = life
+    env = rpc_core.Environment(mempool=mp, txlife=life)
+    res = rpc_core.broadcast_tx_sync(env, tx=b"rpc=1".hex())
+    k = sum_sha256(b"rpc=1")
+    assert res["hash"] == k.hex().upper()
+    rec = life._live[k]
+    assert "rpc" in rec and "admit" in rec
+    assert rec["rpc"] <= rec["admit"]
+    # the default Environment carries the NOP: route pays one branch
+    env2 = rpc_core.Environment(mempool=_mk_mempool())
+    assert env2.txlife is txlife.NOP
+    rpc_core.broadcast_tx_async(env2, tx=b"rpc=2".hex())
+
+
+def test_consensus_disabled_path_is_nop():
+    """Every consensus hook site behind the NOP: committing a height with
+    lifecycle off stamps nothing (the one-branch contract's semantic
+    half; bench's txlife-overhead stage times both arms)."""
+    from tendermint_tpu.consensus.state import ConsensusState
+
+    from fsm_harness import Harness
+
+    h = Harness()
+    assert h.cs.lifecycle is txlife.NOP
+    assert isinstance(h.cs, ConsensusState)
+    assert txlife.NOP.stats()["stamped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# quorum wait + journal enrichment through a real committed height
+# ---------------------------------------------------------------------------
+
+
+def test_quorum_wait_and_tx_journal_through_commit(tmp_path):
+    from tendermint_tpu.consensus.round_state import Step
+    from tendermint_tpu.types.basic import BlockID, SignedMsgType
+
+    from fsm_harness import Harness
+
+    pv0 = _hist_count(txlife.QUORUM_WAIT_SECONDS, type="prevote")
+    pc0 = _hist_count(txlife.QUORUM_WAIT_SECONDS, type="precommit")
+
+    async def run():
+        h = Harness()
+        jr_path = str(tmp_path / "journal.jsonl")
+        h.cs.journal = EventJournal(jr_path, node="n0")
+        life = txlife.TxLifecycle(journal=h.cs.journal, node="n0")
+        h.cs.lifecycle = life
+        h.mempool.lifecycle = life
+        cs = h.cs
+        await cs.start()
+        try:
+            await h.wait_step(1, 0, Step.PROPOSE)
+            proposer = h.proposer_index(1, 0)
+            if proposer == 0:
+                h.mempool.check_tx(b"life=works")
+                await h.wait_step(1, 0, Step.PREVOTE)
+                bid = BlockID(hash=cs.rs.proposal_block.hash(),
+                              part_set_header=cs.rs.proposal_block_parts.header())
+            else:
+                block, parts = h.make_block(txs=(b"life=works",))
+                bid = await h.inject_proposal(proposer, block, parts, 0)
+            await h.wait_our_vote(SignedMsgType.PREVOTE, 1, 0)
+            await h.inject_votes(SignedMsgType.PREVOTE, 1, 0, bid, [1, 2, 3])
+            await h.wait_our_vote(SignedMsgType.PRECOMMIT, 1, 0)
+            await h.inject_votes(SignedMsgType.PRECOMMIT, 1, 0, bid, [1, 2])
+            await h.wait_height(1)
+        finally:
+            await cs.stop()
+        return jr_path, life
+
+    jr_path, life = asyncio.run(run())
+    events = read_events(jr_path)
+
+    # quorum-wait histograms observed for both vote types
+    assert _hist_count(txlife.QUORUM_WAIT_SECONDS, type="prevote") > pv0
+    assert _hist_count(txlife.QUORUM_WAIT_SECONDS, type="precommit") > pc0
+
+    # polka/commit_maj journal lines carry the measured wait
+    polkas = [e for e in events if e["e"] == "polka" and e["h"] == 1]
+    majs = [e for e in events if e["e"] == "commit_maj" and e["h"] == 1]
+    assert polkas and "wait_ms" in polkas[0] and polkas[0]["wait_ms"] >= 0
+    assert majs and "wait_ms" in majs[0] and majs[0]["wait_ms"] >= 0
+
+    # the committed block's tx walked the whole journaled lifecycle
+    k = sum_sha256(b"life=works").hex()[:16]
+    kinds = {e["e"] for e in events if e.get("tx") == k}
+    assert {"tx_admit", "tx_propose", "tx_commit", "tx_apply"} <= kinds
+    commit_ev = next(e for e in events
+                     if e["e"] == "tx_commit" and e["tx"] == k)
+    assert commit_ev["h"] == 1
+    # and retired through the completed ring with a finality observation
+    assert any(d["tx"] == k for d in life.done)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: live 4-node net → /metrics histograms + txtrace waterfall
+# ---------------------------------------------------------------------------
+
+
+def test_four_node_net_finality_metrics_and_txtrace(tmp_path):
+    """ISSUE 9 acceptance: a 4-node in-process net reports time-to-
+    finality through the /metrics histograms (exposition built from the
+    same registry code the metrics server serves), and `txtrace` over
+    the four merged journals renders a per-tx cross-node waterfall with
+    skew-corrected timestamps."""
+    from tendermint_tpu.cli.timeline import estimate_offsets
+    from tendermint_tpu.cli.txtrace import build_txtrace, render_txtrace
+    from tendermint_tpu.rpc import core as rpc_core
+
+    fin0 = _hist_count(txlife.TX_TIME_TO_FINALITY_SECONDS)
+    res0 = _hist_count(txlife.MEMPOOL_RESIDENCY_SECONDS)
+
+    async def run():
+        nodes = make_net(4)
+        for i, n in enumerate(nodes):
+            jr = EventJournal(str(tmp_path / f"node{i}.jsonl"),
+                              node=f"node{i}")
+            n.cs.journal = jr
+            life = txlife.TxLifecycle(journal=jr, node=f"node{i}")
+            n.cs.lifecycle = life
+            n.mempool.lifecycle = life
+        await start_mesh(nodes)
+        # genuine RPC ingress on node1 (the handler stamps `rpc`)
+        env = rpc_core.Environment(mempool=nodes[1].mempool,
+                                   txlife=nodes[1].mempool.lifecycle)
+        rpc_core.broadcast_tx_sync(env, tx=b"txtrace=works".hex())
+        try:
+            await wait_all_height(nodes, 3)
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(run())
+
+    # -- /metrics: the finality + residency histograms observed, and the
+    # exposition (what the prometheus listener serves) carries all three
+    assert _hist_count(txlife.TX_TIME_TO_FINALITY_SECONDS) > fin0
+    assert _hist_count(txlife.MEMPOOL_RESIDENCY_SECONDS) > res0
+    reg = Registry()
+    for hist in txlife.LIFECYCLE_HISTOGRAMS:
+        reg.register(hist)
+    text = reg.expose()
+    for series in ("tendermint_tx_time_to_finality_seconds",
+                   "tendermint_mempool_residency_seconds",
+                   "tendermint_consensus_quorum_wait_seconds"):
+        assert f"# TYPE {series} histogram" in text
+    assert "tendermint_tx_time_to_finality_seconds_count" in text
+
+    # -- txtrace over the merged journals
+    journals = {f"node{i}": read_events(str(tmp_path / f"node{i}.jsonl"))
+                for i in range(4)}
+    assert all(journals.values())
+    offsets = estimate_offsets(journals)
+    # one process, one clock: the estimator must not invent big offsets
+    assert all(abs(v) < 50e6 for v in offsets.values()), offsets
+    doc = build_txtrace(journals, offsets=offsets)
+    k = sum_sha256(b"txtrace=works").hex()[:16]
+    wf = next(t for t in doc["txs"] if t["tx"] == k)
+    assert wf["submit_node"] == "node1" and wf["submit_milestone"] == "rpc"
+    assert wf["height"] is not None and wf["finality_ms"] > 0
+    stages = wf["stages"]
+    # cross-node: the gossiped tx was received by other nodes, proposed
+    # and committed across the net, with the quorum rows folded in
+    assert len(stages.get("recv", {})) >= 2
+    assert len(stages.get("propose", {})) == 4
+    assert len(stages.get("commit", {})) == 4
+    assert stages.get("prevote_quorum") and stages.get("precommit_quorum")
+    # submit is the zero point; everything downstream is ordered after it
+    assert stages["rpc"]["node1"] == 0.0
+    assert min(stages["commit"].values()) >= max(stages["admit"].values())
+
+    text = render_txtrace(doc)
+    assert f"tx {k}" in text
+    for row in ("rpc", "admit", "recv", "propose", "prevote_quorum",
+                "precommit_quorum", "commit", "apply"):
+        assert row in text, text
+
+
+def test_txtrace_cli_subcommand(tmp_path, capsys):
+    """`tendermint-tpu txtrace` end to end over journal files, including
+    the exit-1 no-tx contract and --json."""
+    import json
+
+    from tendermint_tpu.cli.main import main
+
+    s = 1_700_000_000 * 10**9
+    k = "ab" * 8
+
+    def ev(e, w, n, **kw):
+        return {"e": e, "w": w, "m": w, "n": n, **kw}
+
+    files = []
+    for i, events in enumerate((
+        [ev("tx_rpc", s + 100, "n0", tx=k),
+         ev("tx_admit", s + 200, "n0", tx=k),
+         ev("tx_send", s + 300, "n0", tx=k, to="p1"),
+         ev("tx_commit", s + 5_000_000, "n0", tx=k, h=2),
+         ev("tx_apply", s + 5_100_000, "n0", tx=k, h=2)],
+        [ev("tx_recv", s + 1_200_000, "n1", tx=k, **{"from": "p0"}),
+         ev("tx_commit", s + 5_200_000, "n1", tx=k, h=2)],
+    )):
+        p = tmp_path / f"n{i}.jsonl"
+        with open(p, "w") as fh:
+            for e in events:
+                fh.write(json.dumps(e) + "\n")
+        files.append(str(p))
+
+    rc = main(["txtrace", *files, "--names", "n0,n1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"tx {k}" in out and "recv" in out and "finality" in out
+
+    rc = main(["txtrace", "--json", "--names", "n0,n1", *files])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["txs"][0]["tx"] == k
+    assert doc["txs"][0]["stages"]["recv"]["n1"] > 0
+
+    # filter that matches nothing -> exit 1
+    rc = main(["txtrace", "--tx", "ffff", *files, "--names", "n0,n1"])
+    capsys.readouterr()
+    assert rc == 1

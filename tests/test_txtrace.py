@@ -1,0 +1,206 @@
+"""Clock-offset estimation + skew-corrected timeline/txtrace.
+
+Covers: the pairwise estimator recovering a known injected offset from
+matched origin/receive vote pairs (both directions, relay-inflated
+one-way deltas, ambiguous-origin rejection), BFS propagation across the
+pair graph, skew application in build_timeline (vote_skew_ms and height
+alignment measure propagation, not clocks), the timeline CLI's offset
+annotation + --no-skew, and the txtrace waterfall builder on synthetic
+journals.
+"""
+
+import json
+
+from tendermint_tpu.cli.timeline import (
+    build_timeline,
+    estimate_offsets,
+    render_timeline,
+    report_json,
+)
+from tendermint_tpu.cli.txtrace import build_txtrace, render_txtrace
+
+S = 1_700_000_000 * 10**9
+MS = 1_000_000
+
+
+def _ev(e, w, n, **kw):
+    return {"e": e, "w": w, "m": w, "n": n, **kw}
+
+
+def _vote(w, n, val, frm, h=1, r=0):
+    return _ev("vote", w, n, h=h, r=r, type="prevote", val=val,
+               block="cc" * 8, at_r=r, **{"from": frm})
+
+
+def _two_node_journals(off_ns: int, lat_ns: int = MS):
+    """node1's clock reads `off_ns` ahead; symmetric one-way latency.
+    Each node journals its own vote (from="") and the peer's (from=X)."""
+    j0 = [
+        _vote(S, "n0", val=0, frm=""),
+        _vote(S + lat_ns, "n0", val=1, frm="p1"),
+    ]
+    j1 = [
+        _vote(S + off_ns, "n1", val=1, frm=""),
+        _vote(S + lat_ns + off_ns, "n1", val=0, frm="p0"),
+    ]
+    return {"n0": j0, "n1": j1}
+
+
+def test_estimator_recovers_known_offset():
+    for off in (5 * MS, -3 * MS, 0):
+        offsets = estimate_offsets(_two_node_journals(off))
+        assert offsets["n0"] == 0.0
+        assert abs(offsets["n1"] - off) < 0.01 * MS, (off, offsets)
+
+
+def test_estimator_tolerates_asymmetric_noise_via_min():
+    """Extra slower deliveries of the same votes must not move the
+    estimate: the min-delta filter keeps the fastest exchange."""
+    js = _two_node_journals(4 * MS)
+    # a later height whose votes were delivered SLOWLY both ways (e.g.
+    # relayed): those 20ms deltas must lose to the fast exchange's 1ms
+    js["n0"].append(_vote(S + 10 * MS, "n0", val=0, frm="", h=2))
+    js["n1"].append(_vote(S + (10 + 20 + 4) * MS, "n1", val=0, frm="p0", h=2))
+    js["n1"].append(_vote(S + (10 + 4) * MS, "n1", val=1, frm="", h=2))
+    js["n0"].append(_vote(S + (10 + 20) * MS, "n0", val=1, frm="p1", h=2))
+    offsets = estimate_offsets(js)
+    assert abs(offsets["n1"] - 4 * MS) < 0.01 * MS, offsets
+
+
+def test_estimator_drops_ambiguous_origin():
+    """A vote claimed as own (`from=""`) by TWO nodes (equivocation /
+    copied journal) must contribute nothing."""
+    js = {
+        "n0": [_vote(S, "n0", val=0, frm="")],
+        "n1": [_vote(S + MS, "n1", val=0, frm="")],
+    }
+    offsets = estimate_offsets(js)
+    assert offsets == {"n0": 0.0, "n1": 0.0}
+
+
+def test_offsets_propagate_over_pair_graph():
+    """n2 exchanges only with n1: its offset composes n0->n1->n2."""
+    js = _two_node_journals(5 * MS)
+    # n1 <-> n2 exchange at height 3; n2's clock is +2ms vs n1 (+7 vs n0)
+    js["n1"] += [
+        _vote(S + 5 * MS, "n1", val=1, frm="", h=3),
+        _vote(S + MS + 5 * MS, "n1", val=2, frm="p2", h=3),
+    ]
+    js["n2"] = [
+        _vote(S + 7 * MS, "n2", val=2, frm="", h=3),
+        _vote(S + MS + 7 * MS, "n2", val=1, frm="p1", h=3),
+    ]
+    offsets = estimate_offsets(js)
+    assert abs(offsets["n1"] - 5 * MS) < 0.01 * MS
+    assert abs(offsets["n2"] - 7 * MS) < 0.01 * MS
+    # a node with no usable pairs keeps offset 0
+    js["n3"] = [_ev("commit", S, "n3", h=1, r=0, block="cc" * 8, txs=0)]
+    offsets = estimate_offsets(js)
+    assert offsets["n3"] == 0.0
+
+
+def test_timeline_applies_offsets_to_skew_and_alignment():
+    off = 8 * MS
+    js = _two_node_journals(off)
+    raw = build_timeline(js)
+    corrected = build_timeline(js, offsets=estimate_offsets(js))
+    # raw: val0's vote "arrives" 8ms+1ms apart across nodes (clock lie);
+    # corrected: 1ms of real propagation
+    from tendermint_tpu.cli.timeline import vote_skew_ms
+
+    raw_skew = vote_skew_ms(raw.heights[1])
+    cor_skew = vote_skew_ms(corrected.heights[1])
+    assert raw_skew[0] >= 8.0
+    assert abs(cor_skew[0] - 1.0) < 0.05, cor_skew
+    # height t0 anchoring: corrected earliest event is n0's own vote
+    assert corrected.heights[1].t0 == S
+
+
+def test_render_and_json_annotate_offsets():
+    js = _two_node_journals(2 * MS)
+    offsets = estimate_offsets(js)
+    report = build_timeline(js, offsets=offsets)
+    text = render_timeline(report, offsets=offsets)
+    assert "clock offsets (estimated, applied)" in text
+    assert "n1 +2.00ms" in text
+    doc = report_json(report, offsets=offsets)
+    assert doc["clock_offsets_ms"]["n1"] == 2.0
+    # without offsets neither annotation appears
+    assert "clock offsets" not in render_timeline(build_timeline(js))
+    assert "clock_offsets_ms" not in report_json(build_timeline(js))
+
+
+def test_timeline_cli_skew_flags(tmp_path, capsys):
+    from tendermint_tpu.cli.main import main
+
+    js = _two_node_journals(3 * MS)
+    files = []
+    for name, events in js.items():
+        p = tmp_path / f"{name}.jsonl"
+        with open(p, "w") as fh:
+            for e in events:
+                fh.write(json.dumps(e) + "\n")
+        files.append(str(p))
+
+    rc = main(["timeline", *files, "--names", "n0,n1"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "clock offsets (estimated, applied)" in out
+    assert "n1 +3.00ms" in out
+
+    rc = main(["timeline", "--no-skew", *files, "--names", "n0,n1"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "clock offsets" not in out
+
+    rc = main(["timeline", "--json", *files, "--names", "n0,n1"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["clock_offsets_ms"]["n1"] == 3.0
+
+
+def test_txtrace_builder_stages_and_quorum_context():
+    k = "ab" * 8
+    js = {
+        "n0": [
+            _ev("tx_rpc", S + 100, "n0", tx=k),
+            _ev("tx_admit", S + 200, "n0", tx=k),
+            _ev("tx_send", S + 300, "n0", tx=k, to="p1"),
+            _ev("tx_propose", S + 2 * MS, "n0", tx=k, h=5),
+            _ev("polka", S + 3 * MS, "n0", h=5, r=0, block="cc" * 8,
+                wait_ms=1.0),
+            _ev("commit_maj", S + 4 * MS, "n0", h=5, r=0, block="cc" * 8,
+                wait_ms=0.8),
+            _ev("tx_commit", S + 5 * MS, "n0", tx=k, h=5),
+            _ev("tx_apply", S + 5 * MS + 100, "n0", tx=k, h=5),
+        ],
+        "n1": [
+            _ev("tx_recv", S + MS, "n1", tx=k, **{"from": "p0"}),
+            _ev("tx_propose", S + 2 * MS + 500, "n1", tx=k, h=5),
+            _ev("polka", S + 3 * MS + 500, "n1", h=5, r=0, block="cc" * 8),
+            _ev("tx_commit", S + 5 * MS + 500, "n1", tx=k, h=5),
+        ],
+    }
+    doc = build_txtrace(js)
+    (wf,) = doc["txs"]
+    assert wf["tx"] == k and wf["height"] == 5
+    assert wf["submit_node"] == "n0" and wf["submit_milestone"] == "rpc"
+    assert wf["stages"]["rpc"]["n0"] == 0.0
+    assert abs(wf["stages"]["recv"]["n1"] - 1.0) < 0.01
+    assert set(wf["stages"]["prevote_quorum"]) == {"n0", "n1"}
+    assert wf["stages"]["precommit_quorum"]["n0"] > 0
+    # finality ends at the first apply anywhere
+    assert abs(wf["finality_ms"] - 5.0001) < 0.01
+    assert wf["gossip_peers"]["send@n0"] == "p1"
+    text = render_txtrace(doc)
+    assert "prevote_quorum" in text and "n0->p1" in text and "n1<-p0" in text
+
+    # limit + empty cases
+    assert "no tx lifecycle events" in render_txtrace(
+        {"nodes": ["n0"], "txs": []})
+
+
+def test_txtrace_ignores_tail_only_tx():
+    """tx_* events with no submit-side milestone (journal rotated away)
+    must not produce a waterfall anchored at commit."""
+    k = "cd" * 8
+    js = {"n0": [_ev("tx_commit", S, "n0", tx=k, h=9),
+                 _ev("tx_apply", S + 100, "n0", tx=k, h=9)]}
+    assert build_txtrace(js)["txs"] == []
