@@ -1055,6 +1055,65 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["txlife_overhead_error"] = str(e)[-300:]
 
+        # Health watchdog overhead (round 10, ISSUE 10): the monitor's
+        # cost contract — the DISABLED path is one attribute-load +
+        # branch against the NOP singleton per call site, and one
+        # ENABLED sample (probe merge + six detector updates) stays
+        # under a stated budget.  Plus a short soak: a monitor fed a
+        # healthy synthetic node (height advancing, round 0, flat RSS,
+        # empty queue, quiet peers) must record ZERO critical
+        # transitions — the spurious-alarm guard for real soak runs.
+        _stage_set("health-overhead")
+        try:
+            from tendermint_tpu.utils import health as _hl
+
+            N_EV = 20_000
+            nop = _hl.NOP
+            t0 = time.perf_counter()
+            for _ in range(N_EV):
+                # measured exactly as call sites write it
+                if nop.enabled:
+                    nop.sample()
+            disabled_ns = (time.perf_counter() - t0) / N_EV * 1e9
+
+            state = {"h": 0, "t": 0.0}
+
+            def _healthy_probe():
+                state["h"] += 1
+                return {"height": state["h"], "round": 0,
+                        "rss_bytes": 100 << 20, "verify_queue_depth": 0,
+                        "peer_disconnects": 0, "cold_compiles": 0}
+
+            mon = _hl.HealthMonitor(
+                node="bench", probes={"bench": _healthy_probe},
+                detectors=_hl.default_detectors(expected_block_s=0.5),
+                clock=lambda: state["t"])
+            N_S = 5_000
+            t0 = time.perf_counter()
+            for _ in range(N_S):
+                state["t"] += 0.5   # healthy cadence: one commit/sample
+                if mon.enabled:
+                    mon.sample()
+            enabled_us = (time.perf_counter() - t0) / N_S * 1e6
+            budget_us = 50.0  # per sample; default cadence is 1/2s
+            criticals = sum(1 for tr in mon.report()["transitions"]
+                            if tr["to"] == _hl.CRITICAL)
+            _partial.update({
+                "health_disabled_ns_per_sample": round(disabled_ns, 1),
+                "health_enabled_us_per_sample": round(enabled_us, 2),
+                "health_budget_us_per_sample": budget_us,
+                "health_within_budget": bool(enabled_us <= budget_us),
+                "health_soak_samples": N_S,
+                "health_soak_criticals": criticals,
+            })
+            assert enabled_us <= budget_us, (
+                f"health {enabled_us:.1f}us/sample exceeds {budget_us}us")
+            assert criticals == 0, (
+                f"{criticals} spurious critical transition(s) on a "
+                "healthy synthetic node")
+        except Exception as e:  # noqa: BLE001
+            _partial["health_overhead_error"] = str(e)[-300:]
+
         # Device observability (round 9, ISSUE 4): the occupancy/padding
         # accounting rides EVERY device flush site, so its cost contract
         # mirrors the journal's — the DISABLED path is one branch per

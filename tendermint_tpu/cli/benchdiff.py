@@ -67,11 +67,13 @@ _CLASS_RULES = (
      "boolean", "higher"),
     (re.compile(r"(_p50_ms|_ms)$"), "latency", "lower"),
     (re.compile(r"(_ns_per_event|_us_per_event|_ns_per_flush"
-                r"|_us_per_flush|_ns_per_stamp|_us_per_stamp)$"),
+                r"|_us_per_flush|_ns_per_stamp|_us_per_stamp"
+                r"|_ns_per_sample|_us_per_sample)$"),
      "latency", "lower"),
     (re.compile(r"(_seconds|_s)$"), "timing", "lower"),
     (re.compile(r"(cold_compiles|recompiles|_findings|frames_dropped"
-                r"|padding_rows_total|wal_replays|_violations)$"),
+                r"|padding_rows_total|wal_replays|_violations"
+                r"|_soak_criticals)$"),
      "count", "lower"),
 )
 

@@ -1,0 +1,93 @@
+"""`tendermint-tpu health` — one node's watchdog verdict over RPC.
+
+Reads the `health` block the HealthMonitor (utils/health.py) publishes
+through RPC `status` and renders it as a detector table (or raw JSON
+with `--json`).  `--watch` refreshes like `top`; the default is one
+report.
+
+Exit-code contract (scriptable soak runs):
+  0  every detector ok
+  1  at least one detector at warn
+  2  at least one detector CRITICAL (the detector is named in the
+     output — the acceptance path: `health --once --json` exits 2
+     naming height_stall on a partitioned node)
+  3  node unreachable, or the monitor is disabled (TM_TPU_HEALTH=0) /
+     absent from this node's status
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from tendermint_tpu.cli.top import _get_json, _http_base
+from tendermint_tpu.utils.health import LEVEL_NAMES
+
+
+def fetch_health(rpc_base: str, timeout: float = 5.0) -> dict | None:
+    """The status.health block, or None when unreachable/absent."""
+    try:
+        st = _get_json(f"{rpc_base}/status", timeout)
+    except Exception as e:  # noqa: BLE001 — node down is a report, not a crash
+        print(f"cannot reach {rpc_base}: {e}", file=sys.stderr)
+        return None
+    block = st.get("health")
+    if not isinstance(block, dict):
+        return None
+    return block
+
+
+def exit_code(block: dict | None) -> int:
+    if block is None or not block.get("enabled"):
+        return 3
+    return min(2, int(block.get("level", 0)))
+
+
+def render_health(block: dict) -> str:
+    level = int(block.get("level", 0))
+    lines = [
+        f"health — {block.get('node') or 'node'}  "
+        f"level {LEVEL_NAMES[level].upper()}"
+        f"  samples {block.get('samples', 0)}"
+        f"  transitions {block.get('transitions_total', 0)}"
+        + ("  [fault window open]" if block.get("in_fault_window") else ""),
+    ]
+    for name, d in (block.get("detectors") or {}).items():
+        state = LEVEL_NAMES[int(d.get("level", 0))]
+        since = (f"  ({d['since_s']:.1f}s)"
+                 if d.get("since_s") is not None and d.get("level") else "")
+        detail = f"  {d['detail']}" if d.get("detail") else ""
+        lines.append(f"  {name:<26} {state.upper() if d.get('level') else 'ok':<10}"
+                     f"{since}{detail}")
+    crit = block.get("critical") or []
+    if crit:
+        lines.append(f"CRITICAL: {', '.join(crit)}")
+    return "\n".join(lines) + "\n"
+
+
+def run_health(rpc_addr: str, *, watch: bool = False, as_json: bool = False,
+               interval: float = 2.0, timeout: float = 5.0) -> int:
+    rpc_base = _http_base(rpc_addr)
+    while True:
+        block = fetch_health(rpc_base, timeout=timeout)
+        rc = exit_code(block)
+        if as_json:
+            sys.stdout.write(json.dumps(
+                block if block is not None else {"enabled": False,
+                                                 "error": "unreachable"})
+                + "\n")
+        elif block is None:
+            sys.stdout.write("no health block (node unreachable?)\n")
+        elif not block.get("enabled"):
+            sys.stdout.write("health monitor disabled (TM_TPU_HEALTH=0)\n")
+        else:
+            prefix = "\x1b[H\x1b[2J" if watch and not as_json else ""
+            sys.stdout.write(prefix + render_health(block))
+        sys.stdout.flush()
+        if not watch:
+            return rc
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return rc

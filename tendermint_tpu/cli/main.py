@@ -880,6 +880,18 @@ def cmd_top(args) -> int:
                    as_json=args.json, timeout=args.timeout)
 
 
+def cmd_health(args) -> int:
+    """One node's health-watchdog verdict over RPC (cli/health.py):
+    per-detector status table or JSON, `--watch` refresh loop.  Exit 0
+    ok / 1 warn / 2 critical (the firing detector is named) / 3 when
+    the node is unreachable or the monitor is disabled
+    (docs/observability.md "Health & watchdog")."""
+    from tendermint_tpu.cli.health import run_health
+
+    return run_health(args.rpc_laddr, watch=args.watch, as_json=args.json,
+                      interval=args.interval, timeout=args.timeout)
+
+
 def cmd_lint(args) -> int:
     """Repo-aware static analysis (tendermint_tpu/lint): six rules, each
     grounded in a shipped bug or a hot-path invariant.  Exit 0 = clean,
@@ -1156,6 +1168,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="emit the snapshot as JSON (implies one frame)")
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser(
+        "health",
+        help="node health watchdog status over RPC "
+             "(exit 0 ok / 1 warn / 2 critical / 3 unreachable)")
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr",
+                    default="http://127.0.0.1:26657")
+    sp.add_argument("--once", action="store_true",
+                    help="print one report and exit (the default; kept "
+                         "for scripting symmetry with top)")
+    sp.add_argument("--watch", action="store_true",
+                    help="refresh every --interval seconds until "
+                         "interrupted")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the raw health block as JSON")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds for --watch")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-request HTTP timeout")
+    sp.set_defaults(fn=cmd_health)
 
     sp = sub.add_parser(
         "warm",

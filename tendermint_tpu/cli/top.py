@@ -98,6 +98,7 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
                     "by_rung": {}, "sources": {}},
         "costs": {},
         "txlife": {"finality": None, "residency": None, "quorum_wait": {}},
+        "health": {"level": None, "detectors": {}},
         "device_memory": [],
         "errors": [],
     }
@@ -112,6 +113,14 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
         sync = st.get("sync_info", {})
         snap["height"] = int(sync.get("latest_block_height", 0))
         snap["node"]["catching_up"] = bool(sync.get("catching_up", False))
+        hb = st.get("health", {})
+        if hb.get("enabled"):
+            snap["health"] = {
+                "level": int(hb.get("level", 0)),
+                "detectors": {name: int(d.get("level", 0))
+                              for name, d in
+                              (hb.get("detectors") or {}).items()},
+            }
         vs = st.get("verify_service", {})
         if vs:
             verify["backend"] = vs.get("backend")
@@ -270,6 +279,16 @@ def _fold_metrics(snap: dict, by_name: dict) -> None:
         if cell:
             tl["quorum_wait"][vtype] = cell
 
+    # health watchdog: the per-detector gauge is the metrics-side twin
+    # of the RPC status block (whichever source answered fills it)
+    hl = snap.setdefault("health", {"level": None, "detectors": {}})
+    if hl["level"] is None:
+        dets = {labels.get("detector", "?"): int(v)
+                for labels, v in by_name.get("tendermint_health_status", [])}
+        if dets:
+            hl["detectors"] = dets
+            hl["level"] = max(dets.values())
+
     mem: dict[str, dict] = {}
     for labels, v in by_name.get("tendermint_crypto_device_memory_bytes", []):
         dev = labels.get("device", "?")
@@ -418,6 +437,13 @@ def render(snap: dict) -> str:
             f"txlife     finality {_lat(tl.get('finality'))}"
             f"  residency {_lat(tl.get('residency'))}"
             + (f"  quorum-wait {qtxt}" if qtxt else ""))
+    hl = snap.get("health") or {}
+    if hl.get("level") is not None:
+        state = ("ok", "WARN", "CRITICAL")[min(2, hl["level"])]
+        firing = "  ".join(f"{name}:{lvl}" for name, lvl in
+                           sorted(hl.get("detectors", {}).items()) if lvl)
+        lines.append(f"health     {state}"
+                     + (f"  [{firing}]" if firing else ""))
     if snap["device_memory"]:
         for e in snap["device_memory"]:
             detail = "  ".join(

@@ -81,6 +81,12 @@ EVENT_TYPES = (
     "tx_propose", # tx seen in a completed proposal block: tx, h
     "tx_commit",  # tx's block committed: tx, h
     "tx_apply",   # tx applied through ABCI: tx, h
+    # health watchdog transitions (utils/health.py).  All carry
+    # detector, prev (level name), detail, excused (True when the
+    # transition happened inside a declared fault window).
+    "health_warn",      # a detector escalated/settled to warn
+    "health_critical",  # a detector escalated to critical
+    "health_ok",        # a detector recovered to ok
 )
 
 # Rotation/pruning checks stat() files, so they are amortized — but on a

@@ -61,8 +61,8 @@ RULES: dict[str, str] = {
         "— defer to point of use or gate with try/except",
     "ungated-observability":
         "observability sink whose disabled-path contract is one caller "
-        "branch (STATS.record_flush, journal.log, lifecycle.stamp) "
-        "called without an `.enabled` guard",
+        "branch (STATS.record_flush, journal.log, lifecycle.stamp, "
+        "health.sample/record) called without an `.enabled` guard",
     "host-sync-in-jit":
         "host synchronization (.item/.tolist/np.asarray/jax.device_get/"
         ".block_until_ready) inside a jit-compiled function body",
@@ -87,7 +87,7 @@ JAX_ALLOWED_DIRS = {"ops", "parallel"}
 #: files that DEFINE the observability sinks: internal calls inside them
 #: are the implementation, not a call site
 OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
-                           "txlife.py"}
+                           "txlife.py", "health.py"}
 
 #: label names that explode series cardinality on a real network
 HIGH_CARDINALITY_LABELS = {"height", "hash", "tx_hash", "block_hash",
@@ -505,6 +505,19 @@ class _Walker:
                         node, "ungated-observability",
                         "lifecycle.stamp() without an `if ...enabled:` "
                         "guard — the disabled path must cost one branch")
+            elif func.attr in ("sample", "record") and not st.gated:
+                # health-watchdog sinks (utils/health.py): explicit
+                # sampling and out-of-band observation pushes cost one
+                # branch when TM_TPU_HEALTH=0 routes to the NOP monitor
+                recv = func.value
+                recv_name = recv.attr if isinstance(recv, ast.Attribute) \
+                    else (recv.id if isinstance(recv, ast.Name) else "")
+                if recv_name.endswith(("health", "HEALTH")):
+                    self._report(
+                        node, "ungated-observability",
+                        f"health.{func.attr}() without an "
+                        "`if ...enabled:` guard — the disabled path "
+                        "must cost one branch")
 
         # host-sync-in-jit
         if st.in_jit and isinstance(func, ast.Attribute):

@@ -310,6 +310,23 @@ class NodeMetrics:
             fn=lambda: float(_cm.peak_flops_per_s()),
         ))
 
+        # -- health watchdog (utils/health.py) --------------------------
+        # per-detector level + transition counts, read from the node's
+        # monitor at scrape time; empty (TYPE lines only) when the
+        # monitor is disabled (TM_TPU_HEALTH=0 → the NOP singleton).
+        self.health_status = reg.register(LabeledCallbackGauge(
+            "health_status",
+            "Per-detector watchdog level (0 ok / 1 warn / 2 critical)",
+            namespace=ns,
+            fn=lambda: node.health.status_samples(),
+        ))
+        self.health_transitions = reg.register(LabeledCallbackGauge(
+            "health_transitions_total",
+            "Watchdog detector level transitions since start",
+            namespace=ns, kind="counter",
+            fn=lambda: node.health.transition_samples(),
+        ))
+
         # -- latency histograms fed at their source ---------------------
         # Process-wide module singletons (the verify service, the FSM,
         # blocksync and RPC observe them where the timing happens); this

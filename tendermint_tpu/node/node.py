@@ -370,6 +370,34 @@ class Node:
             self.app_conns.snapshot(), self.router, state_provider, logger=self.logger
         )
 
+        # -- health watchdog (TM_TPU_HEALTH, default on; utils/health.py)
+        # samples consensus progress, verify-service depth, peer churn,
+        # process vitals and devmon compile counters on a daemon-thread
+        # cadence; flight-recorder bundles land under <home>/health/.
+        # One branch per call site when off (the NOP singleton).
+        from tendermint_tpu.utils import health as _health
+
+        def _consensus_probe():
+            return {"height": self.block_store.height(),
+                    "round": self.consensus.rs.round}
+
+        def _peer_probe():
+            r = self.router
+            depths = [d for _pid, _cid, d in r.send_queue_depths()]
+            return {"peers": len(r.peers),
+                    "peer_disconnects": r.peers_disconnected,
+                    "send_queue_max": max(depths, default=0)}
+
+        self.health = _health.from_env(
+            node=config.base.moniker or self.node_key.node_id[:8],
+            root=config.home,
+            probes={"consensus": _consensus_probe, "peers": _peer_probe},
+            journal=self.consensus.journal,
+            journal_path=getattr(self.consensus.journal, "path", ""),
+            expected_block_s=max(1.0,
+                                 config.consensus.timeout_commit_ms / 1e3),
+        )
+
         # -- RPC --------------------------------------------------------
         from tendermint_tpu.rpc.core import Environment
         from tendermint_tpu.rpc.server import RPCServer
@@ -393,6 +421,7 @@ class Node:
             node_id=self.node_key.node_id,
             moniker=config.base.moniker,
             txlife=self.txlife,
+            health=self.health,
         )
         self.grpc_server = None
         self.pprof_server = None
@@ -492,7 +521,8 @@ class Node:
         if self.config.rpc.pprof_laddr:
             from tendermint_tpu.node.pprof import PprofServer
 
-            self.pprof_server = PprofServer(logger=self.logger)
+            self.pprof_server = PprofServer(logger=self.logger,
+                                            health=self.health)
             host, port = _parse_laddr(self.config.rpc.pprof_laddr, default_port=6060)
             self.pprof_addr = await self.pprof_server.start(host, port)
         if isinstance(self.transport, TCPTransport):
@@ -537,6 +567,10 @@ class Node:
         await self.mempool_reactor.start()
         await self.evidence_reactor.start()
         await self.consensus_reactor.start()
+
+        # watchdog last: everything it samples exists and is serving
+        if self.health.enabled:
+            self.health.start()
 
         if self.config.base.fast_sync:
             await self.blocksync_reactor.start(sync=True)
@@ -642,6 +676,8 @@ class Node:
         if not self._started:
             return
         self._started = False
+        if self.health.enabled:
+            self.health.stop()
         if self._dialer_task is not None:
             self._dialer_task.cancel()
             try:

@@ -6,6 +6,10 @@ The Python equivalents of goroutine/heap profiles:
 
     GET /debug/pprof/          index
     GET /debug/pprof/goroutine all thread stacks + live asyncio tasks
+    GET /debug/pprof/stacks    all-thread Python stack dump (named
+                               threads, the flight-recorder formatter —
+                               the live-wedge counterpart to the
+                               crash-time bundle)
     GET /debug/pprof/heap      gc object counts by type (top 50)
     GET /debug/pprof/trace     recent span ring (utils.trace) as JSONL;
                                ?fmt=chrome returns the Perfetto-loadable
@@ -13,6 +17,9 @@ The Python equivalents of goroutine/heap profiles:
     GET /debug/pprof/device    device-layer accounting (utils.devmon):
                                jit compile events, batch occupancy and
                                padding waste, device memory
+    GET /debug/pprof/health    the health watchdog's per-detector
+                               status + recent transitions
+                               (utils.health)
 
 Plain text responses, stdlib only.
 """
@@ -89,10 +96,17 @@ class PprofServer:
     """Diagnostics listener on the shared TextHTTPServer (independent of
     the RPC server: must answer when the RPC stack is wedged)."""
 
-    def __init__(self, logger: Logger | None = None):
+    def __init__(self, logger: Logger | None = None, health=None):
         from tendermint_tpu.utils.httpserv import TextHTTPServer
 
         self.logger = logger or nop_logger()
+        # the node's HealthMonitor (utils/health.py); defaults to the
+        # NOP singleton so /debug/pprof/health always answers
+        if health is None:
+            from tendermint_tpu.utils import health as _health
+
+            health = _health.NOP
+        self.health = health
         self._http = TextHTTPServer(self._route)
 
     async def start(self, host: str, port: int) -> tuple[str, int]:
@@ -108,6 +122,15 @@ class PprofServer:
         route = parsed.path
         if route.startswith("/debug/pprof/goroutine"):
             body = _goroutine_dump()
+        elif route.startswith("/debug/pprof/stacks"):
+            # named all-thread stack dump via the flight recorder's
+            # formatter (utils/health) — what a wedged node looks like
+            # RIGHT NOW, without waiting for a detector to bundle it
+            from tendermint_tpu.utils.health import format_thread_stacks
+
+            body = format_thread_stacks()
+        elif route.startswith("/debug/pprof/health"):
+            body = self.health.render_text()
         elif route.startswith("/debug/pprof/heap"):
             # off the event loop: walking the gc heap can take seconds on
             # a loaded node, exactly when this endpoint gets scraped
@@ -125,9 +148,10 @@ class PprofServer:
             body = devmon.render_text()
         elif route.startswith("/debug/pprof"):
             body = ("pprof analog endpoints:\n"
-                    "/debug/pprof/goroutine\n/debug/pprof/heap\n"
+                    "/debug/pprof/goroutine\n/debug/pprof/stacks\n"
+                    "/debug/pprof/heap\n"
                     "/debug/pprof/trace[?fmt=chrome]\n"
-                    "/debug/pprof/device\n")
+                    "/debug/pprof/device\n/debug/pprof/health\n")
         else:
             return None
         return 200, "text/plain", body.encode()

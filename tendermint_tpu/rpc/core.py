@@ -16,6 +16,7 @@ import itertools
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import tmhash
 from tendermint_tpu.pubsub import SubscriptionCancelledError
+from tendermint_tpu.utils import health as _health
 from tendermint_tpu.utils import txlife as _txlife
 from tendermint_tpu.pubsub.query import parse as parse_query
 from tendermint_tpu.types import events as tmevents
@@ -49,6 +50,7 @@ class Environment:
         moniker: str = "tpu-node",
         version: str = "0.1.0",
         txlife=None,
+        health=None,
     ):
         self.config = config
         self.genesis = genesis
@@ -71,6 +73,9 @@ class Environment:
         # tx lifecycle store (utils/txlife.py): the broadcast_tx_* routes
         # stamp RPC ingress — the start of the time-to-finality clock
         self.txlife = txlife if txlife is not None else _txlife.NOP
+        # health watchdog (utils/health.py): `status` publishes its
+        # per-detector block so `tendermint-tpu health` needs one RPC
+        self.health = health if health is not None else _health.NOP
 
 
 def _latest_height(env: Environment) -> int:
@@ -162,6 +167,7 @@ def status(env: Environment) -> dict:
             "voting_power": enc.i64(power),
         },
         "verify_service": _verify_service_status(),
+        "health": env.health.status_block(),
     }
 
 

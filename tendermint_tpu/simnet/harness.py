@@ -51,6 +51,7 @@ from tendermint_tpu.store import BlockStore, MemDB
 from tendermint_tpu.types import GenesisDoc, GenesisValidator
 from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 from tendermint_tpu.utils import fail
+from tendermint_tpu.utils import health as tmhealth
 from tendermint_tpu.utils.log import Logger, nop_logger
 from tendermint_tpu.utils.txlife import TxLifecycle
 
@@ -158,6 +159,29 @@ class SimNode:
         self.router = Router(self.node_id,
                              network.create_transport(self.node_id),
                              logger=self.logger)
+        # health watchdog (TM_TPU_HEALTH, default on): each SimNode
+        # self-diagnoses like a real node, so the verdict can say which
+        # detector fired on which node first.  Fast cadence + a stall
+        # horizon scaled to the (50ms-class) test timeouts; bundles land
+        # under the node home, and the runner feeds fault windows in so
+        # in-window transitions read back as excused.
+        self.health = tmhealth.from_env(
+            node=self.name,
+            root=home,
+            probes={
+                "consensus": lambda: {"height": self.block_store.height(),
+                                      "round": self.cs.rs.round},
+                "peers": lambda: {
+                    "peers": len(self.router.peers),
+                    "peer_disconnects": self.router.peers_disconnected,
+                },
+            },
+            journal=self.cs.journal,
+            journal_path=self.journal_path,
+            expected_block_s=max(0.2,
+                                 4 * consensus_config.timeout_commit_ms / 1e3),
+            interval_s=0.25,
+        )
         self.reactor = ConsensusReactor(
             self.cs, self.router, self.block_store,
             gossip_sleep_ms=gossip_sleep_ms, maj23_sleep_ms=500,
@@ -192,9 +216,13 @@ class SimNode:
             await self.cs.start()   # runs catchup_replay first
         finally:
             fail.reset_scope(token)
+        if self.health.enabled:
+            self.health.start()
 
     async def stop(self) -> None:
         """Clean shutdown (end of run)."""
+        if self.health.enabled:
+            self.health.stop()
         await self.cs.stop()
         await self.reactor.stop()
         await self.mp_reactor.stop()
@@ -206,6 +234,8 @@ class SimNode:
         clean-shutdown work beyond releasing file handles (their content
         is already on disk — the WAL flushes per write)."""
         self.crashed = True
+        if self.health.enabled:
+            self.health.stop(timeout=0.2)
         fail.uninstall(self.name)
         self.cs._stopping = True
         self.cs.ticker.stop()
@@ -326,12 +356,24 @@ class SimnetRunner:
     def _window_open(self, key: str, kind: str, nodes: list[int]) -> None:
         self._open_windows[key] = {
             "kind": kind, "nodes": list(nodes), "t0_ns": time.time_ns()}
+        # every node's watchdog learns a fault window is open (the
+        # verdict's rule: ALL windows count — a partition stalls the
+        # majority via lost proposers too), so detector transitions
+        # inside it are recorded as excused rather than suppressed
+        for node in self.nodes:
+            if node is not None and not node.crashed \
+                    and node.health.enabled:
+                node.health.fault_begin()
 
     def _window_close(self, key: str) -> None:
         w = self._open_windows.pop(key, None)
         if w is not None:
             w["t1_ns"] = time.time_ns()
             self.fault_windows.append(w)
+            for node in self.nodes:
+                if node is not None and not node.crashed \
+                        and node.health.enabled:
+                    node.health.fault_end()
 
     def _close_all_windows(self) -> None:
         for key in list(self._open_windows):
@@ -415,8 +457,15 @@ class SimnetRunner:
                         1 for e in block.evidence
                         if isinstance(e, DuplicateVoteEvidence))
 
+        health_reports = {
+            node.name: (node.health.report() if node.health.enabled
+                        else {"enabled": False})
+            for node in self.nodes
+        }
+
         run_info = {
             "t_start_ns": t_start_ns,
+            "health": health_reports,
             "duration_s": duration_s,
             "timed_out": timed_out,
             "timeout_commit_ms": self._ccfg.timeout_commit_ms,
@@ -720,6 +769,12 @@ class SimnetRunner:
         self.restarts[index] = self.restarts.get(index, 0) + 1
         node = self._make_node(index)
         self.nodes[index] = node
+        if node.health.enabled:
+            # the new incarnation's watchdog inherits every still-open
+            # fault window (its own crash window included) so its
+            # resync-time transitions read back as excused
+            for _ in self._open_windows:
+                node.health.fault_begin()
         self.wal_replays.setdefault(index, []).append({
             "handshake_blocks": node.handshake_blocks,
             "wal_tail_records": node.wal_tail_records,

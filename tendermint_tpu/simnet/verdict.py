@@ -30,7 +30,12 @@ Beyond the invariants, the report carries the BENCH metrics (accepted
 tx/s, heights/min, rounds>0 streaks, recovery-after-heal) and — from the
 tx_* lifecycle journal lines — per-scenario time-to-finality percentiles
 with fault windows excluded (`finality`), so adversity runs report
-latency next to throughput.
+latency next to throughput.  From the runners' per-node HealthMonitor
+reports (utils/health.py) it also carries a `health` block — detector
+transitions split excused (inside a declared fault window) vs not, and
+`first_critical`, the first detector to go critical anywhere on the net
+— plus a `diagnosis` line when a violated run has one, so a failing
+scenario names which detector fired on which node first.
 
 Exit-code contract (cli/main.py simnet): verdict ok -> 0, any violation
 -> 1, with the violated invariant named in the JSON report.
@@ -159,6 +164,44 @@ def _recovery_after_heal(report: TimelineReport, run_info: dict) -> list[dict]:
     return out
 
 
+def _health_block(run_info: dict) -> dict:
+    """Per-node watchdog summary from the runners' HealthMonitor
+    reports (utils/health.py): transition counts, critical counts split
+    excused (inside a declared fault window) vs not, and the FIRST
+    critical transition anywhere on the net — so a failing scenario
+    names which detector fired on which node first, instead of only the
+    post-hoc timeline verdict."""
+    per_node: dict[str, dict] = {}
+    first_critical = None
+    for name, rep in sorted((run_info.get("health") or {}).items()):
+        if not rep.get("enabled"):
+            per_node[name] = {"enabled": False}
+            continue
+        transitions = rep.get("transitions", [])
+        crits = [tr for tr in transitions if tr.get("to") == 2]
+        per_node[name] = {
+            "enabled": True,
+            "level": rep.get("level", 0),
+            "transitions": len(transitions),
+            "criticals": len(crits),
+            "unexcused_criticals": sum(1 for tr in crits
+                                       if not tr.get("excused")),
+            "detectors": {dn: d.get("level", 0) for dn, d in
+                          (rep.get("detectors") or {}).items()},
+            "bundles": (rep.get("recorder") or {}).get("written", 0),
+        }
+        for tr in crits:
+            if first_critical is None or tr.get("w", 0) < first_critical["w"]:
+                first_critical = {
+                    "node": name,
+                    "detector": tr.get("detector"),
+                    "w": tr.get("w", 0),
+                    "excused": bool(tr.get("excused")),
+                    "detail": tr.get("detail", ""),
+                }
+    return {"per_node": per_node, "first_critical": first_critical}
+
+
 def evaluate(scenario: Scenario, report: TimelineReport,
              run_info: dict) -> dict:
     violations: list[dict] = []
@@ -254,9 +297,20 @@ def evaluate(scenario: Scenario, report: TimelineReport,
         else:
             streak = 0
 
+    health = _health_block(run_info)
+    diagnosis = None
+    if violations and health["first_critical"] is not None:
+        fc = health["first_critical"]
+        diagnosis = (f"first critical detector: {fc['detector']} on "
+                     f"{fc['node']}"
+                     + (" (inside a fault window)" if fc["excused"] else "")
+                     + (f" — {fc['detail']}" if fc["detail"] else ""))
+
     return {
         "ok": not violations,
         "violations": violations,
+        "diagnosis": diagnosis,
+        "health": health,
         "scenario": {
             "name": scenario.name,
             "seed": scenario.seed,

@@ -5,9 +5,10 @@ from tendermint_tpu.utils import devmon
 
 
 class Site:
-    def __init__(self, journal, lifecycle):
+    def __init__(self, journal, lifecycle, health):
         self.journal = journal
         self.lifecycle = lifecycle
+        self.health = health
         self.replay_mode = False
 
     def flush_ungated(self, n, rung):
@@ -22,6 +23,28 @@ class Site:
     def stamp_ungated_local(self, key):
         life = self.lifecycle
         life.stamp(key, "recv", peer="p")  # LINT: ungated-observability
+
+    def sample_ungated(self):
+        self.health.sample()  # LINT: ungated-observability
+
+    def record_ungated(self):
+        self.health.record("restart", 1)  # LINT: ungated-observability
+
+    def record_ungated_upper(self, HEALTH):
+        HEALTH.record("restart", 1)  # LINT: ungated-observability
+
+    def sample_gated(self):
+        if self.health.enabled:
+            self.health.sample()
+
+    def record_early_exit(self):
+        if not self.health.enabled:
+            return
+        self.health.record("restart", 1)
+
+    def sample_other_receiver(self, rng, population):
+        # random.sample is not a health sink: no finding
+        return rng.sample(population, 2)
 
     def stamp_gated(self, key):
         if self.lifecycle.enabled:

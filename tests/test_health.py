@@ -1,0 +1,663 @@
+"""Health watchdog (ISSUE 10, utils/health.py): per-detector units over
+synthetic sample streams, hysteresis, fault-window annotation, the
+flight recorder's bundle round-trip / rotation / rate-limit, env
+gating, the `health` CLI contract, a live node serving the new
+surfaces (/metrics types, status block, /debug/pprof/health + /stacks,
+exit-code path 0 -> 2 -> 0), and the simnet acceptance scenario: a >1/3
+partition makes the partitioned node's height-stall detector fire
+before the heal and clear after it, with exactly one forensic bundle.
+"""
+
+import asyncio
+import json
+import os
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.utils import health as hl
+from tendermint_tpu.utils.health import (
+    CRITICAL,
+    OK,
+    WARN,
+    CompileStormDetector,
+    FlightRecorder,
+    HealthMonitor,
+    HeightStallDetector,
+    MemoryGrowthDetector,
+    PeerFlapDetector,
+    QueueSaturationDetector,
+    RoundThrashDetector,
+)
+
+
+def feed(det, samples):
+    """Drive a detector over [(t, fields)] and return the level trace."""
+    levels = []
+    for t, fields in samples:
+        det.update({"t": float(t), **fields})
+        levels.append(det.level)
+    return levels
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+
+
+class TestHeightStall:
+    def test_progress_stays_ok(self):
+        det = HeightStallDetector(expected_interval_s=1.0)
+        levels = feed(det, [(t, {"height": t}) for t in range(20)])
+        assert set(levels) == {OK}
+
+    def test_stall_escalates_warn_then_critical_and_clears(self):
+        det = HeightStallDetector(expected_interval_s=1.0,
+                                  warn_factor=5.0, crit_factor=10.0)
+        # height 3 frozen from t=0
+        assert feed(det, [(0, {"height": 3}), (4, {"height": 3})]) == [OK, OK]
+        det.update({"t": 6.0, "height": 3})
+        assert det.level == WARN and "unchanged" in det.detail
+        det.update({"t": 11.0, "height": 3})
+        assert det.level == CRITICAL
+        assert "height 3" in det.detail
+        # a single commit clears immediately (clear_after=1)
+        det.update({"t": 11.5, "height": 4})
+        assert det.level == OK
+
+    def test_no_height_data_is_ok(self):
+        det = HeightStallDetector()
+        assert feed(det, [(0, {}), (100, {})]) == [OK, OK]
+
+
+class TestRoundThrash:
+    def test_high_round_fires_and_hysteresis_clears(self):
+        det = RoundThrashDetector(warn_round=2, crit_round=5, clear_after=2)
+        det.update({"t": 0.0, "round": 0})
+        assert det.level == OK
+        det.update({"t": 1.0, "round": 2})
+        assert det.level == WARN
+        det.update({"t": 2.0, "round": 6})
+        assert det.level == CRITICAL
+        # one good sample is NOT enough (clear_after=2)
+        det.update({"t": 3.0, "round": 0})
+        assert det.level == CRITICAL
+        det.update({"t": 4.0, "round": 0})
+        assert det.level == OK
+
+    def test_persistent_round_gt0_streak(self):
+        det = RoundThrashDetector(warn_streak=3, crit_streak=6,
+                                  warn_round=99, crit_round=99)
+        levels = feed(det, [(t, {"round": 1}) for t in range(7)])
+        assert levels[1] == OK and levels[2] == WARN and levels[-1] == CRITICAL
+
+
+class TestQueueSaturation:
+    def test_spike_does_not_fire_sustained_does(self):
+        det = QueueSaturationDetector(high_water=100, sustain=3,
+                                      crit_factor=4.0)
+        # one-sample spike: never fires
+        assert feed(det, [(0, {"verify_queue_depth": 5000}),
+                          (1, {"verify_queue_depth": 0}),
+                          (2, {"verify_queue_depth": 0}),
+                          (3, {"verify_queue_depth": 0}),
+                          (4, {"verify_queue_depth": 0})])[-1] == OK
+        det2 = QueueSaturationDetector(high_water=100, sustain=3,
+                                       crit_factor=4.0, clear_after=1)
+        levels = feed(det2, [(t, {"verify_queue_depth": 150})
+                             for t in range(3)])
+        assert levels == [OK, OK, WARN]
+        levels = feed(det2, [(t + 3, {"verify_queue_depth": 500})
+                             for t in range(3)])
+        assert levels[-1] == CRITICAL
+        levels = feed(det2, [(t + 6, {"verify_queue_depth": 0})
+                             for t in range(2)])
+        assert levels[-1] == OK
+
+
+class TestCompileStorm:
+    def test_grace_excuses_warm_compiles_then_growth_fires(self):
+        det = CompileStormDetector(grace_s=10.0, window_s=30.0,
+                                   warn_growth=1, crit_growth=3,
+                                   clear_after=1)
+        # cold compiles during warm-up: ok
+        assert feed(det, [(0, {"cold_compiles": 0}),
+                          (5, {"cold_compiles": 4})]) == [OK, OK]
+        # post-grace: flat count stays ok...
+        det.update({"t": 15.0, "cold_compiles": 4})
+        # window still contains the warm-up growth (4-0) at t=15 within
+        # 30s window -> that growth IS visible; use a fresh detector to
+        # pin the post-warm semantics precisely
+        det2 = CompileStormDetector(grace_s=1.0, window_s=10.0,
+                                    warn_growth=1, crit_growth=3,
+                                    clear_after=1)
+        feed(det2, [(0, {"cold_compiles": 4}), (5, {"cold_compiles": 4})])
+        assert det2.level == OK
+        det2.update({"t": 6.0, "cold_compiles": 5})
+        assert det2.level == WARN
+        det2.update({"t": 7.0, "cold_compiles": 8})
+        assert det2.level == CRITICAL and "cold compiles" in det2.detail
+        # storm rolls out of the window -> clears
+        det2.update({"t": 20.0, "cold_compiles": 8})
+        assert det2.level == OK
+
+
+class TestMemoryGrowth:
+    def test_slope_fires_and_flat_clears(self):
+        mib = 1024 * 1024
+        det = MemoryGrowthDetector(window_s=100.0, min_span_s=10.0,
+                                   warn_bps=1 * mib, crit_bps=10 * mib,
+                                   clear_after=1)
+        # 2 MiB/s growth over 20s -> warn
+        levels = feed(det, [(t, {"rss_bytes": 100 * mib + 2 * mib * t})
+                            for t in range(0, 21, 5)])
+        assert levels[-1] == WARN
+        det2 = MemoryGrowthDetector(window_s=100.0, min_span_s=10.0,
+                                    warn_bps=1 * mib, crit_bps=10 * mib,
+                                    clear_after=1)
+        levels = feed(det2, [(t, {"rss_bytes": 100 * mib + 20 * mib * t})
+                             for t in range(0, 21, 5)])
+        assert levels[-1] == CRITICAL and "MiB/min" in det2.detail
+        # flat RSS long enough to flush the window -> clears
+        levels = feed(det2, [(t, {"rss_bytes": 500 * mib})
+                             for t in range(120, 360, 20)])
+        assert levels[-1] == OK
+
+    def test_short_span_never_fires(self):
+        det = MemoryGrowthDetector(min_span_s=30.0, warn_bps=1)
+        levels = feed(det, [(t, {"rss_bytes": 10 ** 9 * (t + 1)})
+                            for t in range(0, 20, 5)])
+        assert set(levels) == {OK}
+
+
+class TestPeerFlap:
+    def test_flap_rate_fires_and_quiet_clears(self):
+        det = PeerFlapDetector(window_s=60.0, min_span_s=10.0,
+                               warn_per_min=6.0, crit_per_min=30.0,
+                               clear_after=1)
+        # 1 disconnect/s = 60/min -> critical once the span exists
+        levels = feed(det, [(t, {"peer_disconnects": t})
+                            for t in range(0, 21, 2)])
+        assert levels[-1] == CRITICAL and "disconnects/min" in det.detail
+        # quiet period: counter stops moving, window slides past
+        levels = feed(det, [(t, {"peer_disconnects": 20})
+                            for t in range(90, 200, 10)])
+        assert levels[-1] == OK
+
+
+# ---------------------------------------------------------------------------
+# monitor
+# ---------------------------------------------------------------------------
+
+
+class _ListJournal:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **fields):
+        self.events.append({"e": event, **fields})
+
+
+def _stall_monitor(journal=None, recorder=None, clock_box=None):
+    """Monitor with one controllable consensus probe + fast stall
+    detector on a synthetic clock."""
+    box = clock_box if clock_box is not None else {"t": 0.0, "h": 1}
+    mon = HealthMonitor(
+        node="t0",
+        probes={"consensus": lambda: {"height": box["h"], "round": 0}},
+        detectors=[HeightStallDetector(expected_interval_s=1.0,
+                                       warn_factor=2.0, crit_factor=4.0)],
+        journal=journal,
+        recorder=recorder,
+        clock=lambda: box["t"],
+    )
+    return mon, box
+
+
+def test_monitor_transitions_journal_and_counters():
+    jr = _ListJournal()
+    mon, box = _stall_monitor(journal=jr)
+    mon.sample()                    # anchor at t=0, height 1
+    box["t"] = 2.5
+    mon.sample()                    # warn (>= 2s)
+    box["t"] = 5.0
+    mon.sample()                    # critical (>= 4s)
+    assert mon.level() == CRITICAL
+    assert [e["e"] for e in jr.events] == ["health_warn", "health_critical"]
+    assert jr.events[1]["detector"] == "height_stall"
+    assert jr.events[1]["prev"] == "warn"
+    assert jr.events[1]["excused"] is False
+    # recovery: height advances -> ok + journaled recovery transition
+    box["h"] = 2
+    box["t"] = 5.5
+    mon.sample()
+    assert mon.level() == OK
+    assert jr.events[-1]["e"] == "health_ok"
+    # metrics-side samples
+    assert mon.status_samples() == [({"detector": "height_stall"}, 0.0)]
+    assert mon.transition_samples() == [({"detector": "height_stall"}, 3.0)]
+    blk = mon.status_block()
+    assert blk["enabled"] and blk["level"] == 0 and blk["critical"] == []
+    rep = mon.report()
+    assert [tr["to"] for tr in rep["transitions"]] == [WARN, CRITICAL, OK]
+    assert "height" in rep["last_sample"]
+
+
+def test_monitor_fault_window_marks_transitions_excused():
+    mon, box = _stall_monitor()
+    mon.sample()
+    mon.fault_begin()
+    box["t"] = 10.0
+    mon.sample()                    # critical inside the window
+    rep = mon.report()
+    assert rep["level"] == CRITICAL
+    assert rep["transitions"][-1]["excused"] is True
+    assert rep["in_fault_window"] is True
+    # after fault_end + grace, new transitions are NOT excused
+    mon.fault_end()
+    box["t"] = 10.1
+    box["h"] = 2
+    mon.sample()                    # recovery, still inside grace
+    assert mon.report()["transitions"][-1]["excused"] is True
+    box["t"] = 20.0                 # past grace
+    mon.sample()
+    box["t"] = 40.0
+    mon.sample()                    # stall again, unexcused
+    tr = mon.report()["transitions"][-1]
+    assert tr["to"] == CRITICAL and tr["excused"] is False
+
+
+def test_monitor_probe_error_contained():
+    def bad():
+        raise RuntimeError("probe died")
+
+    mon = HealthMonitor(node="t", probes={"bad": bad},
+                        detectors=[HeightStallDetector()],
+                        clock=lambda: 0.0)
+    s = mon.sample()
+    assert "bad" in s["probe_errors"]
+    assert mon.probe_errors == 1
+    assert mon.level() == OK        # no data reads as healthy, not dead
+
+
+def test_monitor_record_merges_into_next_sample():
+    mon, _box = _stall_monitor()
+    if mon.enabled:
+        mon.record("restart", 1)
+    s = mon.sample()
+    assert s["restart"] == 1
+    assert "restart" not in mon.sample()    # consumed
+
+
+def test_monitor_thread_start_stop():
+    mon = HealthMonitor(node="t", probes={"c": lambda: {"height": 1}},
+                        detectors=[HeightStallDetector()],
+                        interval_s=0.05)
+    mon.start()
+    mon.start()     # idempotent
+    deadline = 50
+    while mon.samples == 0 and deadline:
+        deadline -= 1
+        import time as _t
+
+        _t.sleep(0.02)
+    mon.stop()
+    assert mon.samples >= 1
+
+
+def test_env_gating(monkeypatch):
+    monkeypatch.setenv("TM_TPU_HEALTH", "0")
+    assert hl.from_env(node="x") is hl.NOP
+    monkeypatch.delenv("TM_TPU_HEALTH", raising=False)
+    monkeypatch.setenv("TM_TPU_HEALTH_INTERVAL_S", "0.7")
+    monkeypatch.setenv("TM_TPU_HEALTH_STALL_S", "3.5")
+    mon = hl.from_env(node="x")
+    assert isinstance(mon, HealthMonitor)
+    assert mon.interval_s == 0.7
+    stall = next(d for d in mon.detectors if d.name == "height_stall")
+    assert stall.expected_interval_s == 3.5
+    assert mon.recorder is None     # no root -> no bundles
+
+
+def test_nop_contract():
+    nop = hl.NOP
+    assert not nop.enabled
+    nop.sample()
+    nop.record("x", 1)
+    nop.start()
+    nop.stop()
+    nop.fault_begin()
+    nop.fault_end()
+    assert nop.level() == OK
+    assert nop.status_samples() == [] and nop.transition_samples() == []
+    assert nop.status_block() == {"enabled": False}
+    assert "disabled" in nop.render_text()
+
+
+def test_render_text_lists_detectors():
+    mon, box = _stall_monitor()
+    mon.sample()
+    box["t"] = 10.0
+    mon.sample()
+    text = mon.render_text()
+    assert "height_stall" in text and "CRITICAL".lower() in text.lower()
+    assert "transitions" in text
+
+
+def test_format_thread_stacks_names_this_thread():
+    import threading
+
+    text = hl.format_thread_stacks()
+    assert threading.current_thread().name in text
+    assert "test_format_thread_stacks_names_this_thread" in text
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_bundle_roundtrip(tmp_path):
+    jr_path = tmp_path / "journal.jsonl"
+    jr_path.write_text('{"e":"commit","h":1}\n{"e":"commit","h":2}\n')
+    rec = FlightRecorder(str(tmp_path), keep=5, min_interval_s=0.0,
+                         journal_path=str(jr_path))
+    mon, box = _stall_monitor(recorder=rec)
+    mon.sample()
+    box["t"] = 10.0
+    mon.sample()    # critical -> bundle
+    bundles = sorted(os.listdir(tmp_path / "health"))
+    assert len(bundles) == 1 and bundles[0].startswith("bundle-")
+    assert bundles[0].endswith("height_stall")
+    bdir = tmp_path / "health" / bundles[0]
+    names = set(os.listdir(bdir))
+    assert {"manifest.json", "stacks.txt", "health.json",
+            "service_stats.json", "device_stats.json", "trace.jsonl",
+            "journal_tail.jsonl"} <= names
+    manifest = json.loads((bdir / "manifest.json").read_text())
+    assert manifest["detector"] == "height_stall"
+    assert manifest["level"] == CRITICAL
+    assert manifest["errors"] == {}
+    health_doc = json.loads((bdir / "health.json").read_text())
+    assert health_doc["level"] == CRITICAL
+    assert json.loads((bdir / "service_stats.json").read_text())[
+        "submitted"] >= 0
+    assert '"e"' in (bdir / "journal_tail.jsonl").read_text()
+    # the transition in the report carries the bundle path
+    tr = mon.report()["transitions"][-1]
+    assert tr["bundle"] == str(bdir)
+    # atomic: no temp dirs left behind
+    assert not [n for n in os.listdir(tmp_path / "health")
+                if n.startswith(".")]
+
+
+def test_flight_recorder_rate_limit_and_rotation(tmp_path):
+    box = {"t": 0.0}
+    rec = FlightRecorder(str(tmp_path), keep=2, min_interval_s=30.0,
+                         clock=lambda: box["t"])
+    mon, _sbox = _stall_monitor()
+    det = mon.detectors[0]
+    assert rec.record(mon, det) is not None
+    # inside the rate limit: suppressed
+    box["t"] = 10.0
+    assert rec.record(mon, det) is None
+    assert rec.suppressed == 1
+    # past the limit, repeatedly: rotation keeps the newest `keep`
+    for i in range(3):
+        box["t"] += 31.0
+        assert rec.record(mon, det) is not None
+    bundles = sorted(os.listdir(tmp_path / "health"))
+    assert len(bundles) == 2
+    assert rec.written == 4
+    stats = rec.stats()
+    assert stats["written"] == 4 and stats["suppressed"] == 1
+
+
+def test_flight_recorder_journal_tail_capped(tmp_path):
+    jr_path = tmp_path / "journal.jsonl"
+    with open(jr_path, "w") as fh:
+        for i in range(5000):
+            fh.write(json.dumps({"e": "vote", "i": i}) + "\n")
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0,
+                         journal_path=str(jr_path), max_tail_bytes=4096)
+    tail = rec._journal_tail()
+    assert len(tail) <= 4096
+    lines = tail.decode().strip().splitlines()
+    # the torn first line was dropped; the LAST line survived intact
+    assert json.loads(lines[-1])["i"] == 4999
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_code_contract_units():
+    from tendermint_tpu.cli.health import exit_code, render_health
+
+    assert exit_code(None) == 3
+    assert exit_code({"enabled": False}) == 3
+    assert exit_code({"enabled": True, "level": 0}) == 0
+    assert exit_code({"enabled": True, "level": 1}) == 1
+    assert exit_code({"enabled": True, "level": 2}) == 2
+    block = {
+        "enabled": True, "node": "n0", "level": 2, "state": "critical",
+        "critical": ["height_stall"], "samples": 9, "transitions_total": 2,
+        "detectors": {
+            "height_stall": {"level": 2, "state": "critical",
+                             "detail": "height 4 unchanged for 9.0s",
+                             "since_s": 3.2},
+            "round_thrash": {"level": 0, "state": "ok", "detail": "",
+                             "since_s": None},
+        },
+    }
+    text = render_health(block)
+    assert "CRITICAL: height_stall" in text
+    assert "unchanged" in text and "round_thrash" in text
+
+
+def test_cli_unreachable_exits_3(capsys):
+    from tendermint_tpu.cli.main import main
+
+    rc = main(["health", "--rpc-laddr", "http://127.0.0.1:9",
+               "--once", "--json", "--timeout", "0.5"])
+    assert rc == 3
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["enabled"] is False
+
+
+# ---------------------------------------------------------------------------
+# live node: metrics types, status block, pprof, CLI 0 -> 2 -> 0, bundle
+# ---------------------------------------------------------------------------
+
+
+def test_live_node_health_surfaces(tmp_path, monkeypatch):
+    from tendermint_tpu.cli.health import run_health
+    from tendermint_tpu.config import test_config as make_test_config
+    from tendermint_tpu.crypto.batch import set_default_backend
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    set_default_backend("cpu")
+    monkeypatch.setenv("TM_TPU_HEALTH_INTERVAL_S", "0.1")
+
+    async def run():
+        key = priv_key_from_seed(b"\x77" * 32)
+        gen = GenesisDoc(
+            chain_id="health-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.base.fast_sync = False
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "tcp://127.0.0.1:0"
+        cfg.rpc.pprof_laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = key
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        try:
+            await node.wait_for_height(2, timeout=30)
+            assert node.health.enabled
+            mh, mp = node.metrics.addr
+            rpc = f"http://{node.rpc_addr[0]}:{node.rpc_addr[1]}"
+            ph, pp = node.pprof_addr
+
+            def get(url):
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    return r.read().decode()
+
+            # -- /metrics: TYPE lines + one row per detector, all 0
+            text = await asyncio.to_thread(
+                get, f"http://{mh}:{mp}/metrics")
+            assert "# TYPE tendermint_health_status gauge" in text
+            assert ("# TYPE tendermint_health_transitions_total counter"
+                    in text)
+            for det in ("height_stall", "round_thrash",
+                        "verify_queue_saturation", "compile_storm",
+                        "memory_growth", "peer_flap"):
+                assert (f'tendermint_health_status{{detector="{det}"}} 0'
+                        in text), det
+
+            # -- RPC status health block + healthy CLI exit 0
+            st = json.loads(await asyncio.to_thread(get, f"{rpc}/status"))
+            blk = st["result"]["health"]
+            assert blk["enabled"] and blk["level"] == 0
+            assert set(blk["detectors"]) >= {"height_stall", "peer_flap"}
+            rc = await asyncio.to_thread(
+                lambda: run_health(rpc, as_json=True))
+            assert rc == 0
+
+            # -- pprof surfaces
+            body = await asyncio.to_thread(
+                get, f"http://{ph}:{pp}/debug/pprof/health")
+            assert "height_stall" in body and "level=ok" in body
+            body = await asyncio.to_thread(
+                get, f"http://{ph}:{pp}/debug/pprof/stacks")
+            assert "-- thread" in body and "health-" in body
+
+            # -- force a stall: freeze the consensus probe and shrink
+            # the horizon; the daemon thread escalates to critical,
+            # writes exactly one rate-limited bundle, and the CLI
+            # names the detector with exit 2
+            stall = next(d for d in node.health.detectors
+                         if d.name == "height_stall")
+            stall.warn_s, stall.crit_s = 0.2, 0.4
+            node.health.probes["consensus"] = (
+                lambda: {"height": 1, "round": 0})
+
+            async def wait_level(want):
+                for _ in range(100):
+                    if node.health.level() == want:
+                        return True
+                    await asyncio.sleep(0.1)
+                return False
+
+            assert await wait_level(2), node.health.report()
+            rc = await asyncio.to_thread(
+                lambda: run_health(rpc, as_json=True))
+            assert rc == 2
+            st = json.loads(await asyncio.to_thread(get, f"{rpc}/status"))
+            assert st["result"]["health"]["critical"] == ["height_stall"]
+            text = await asyncio.to_thread(
+                get, f"http://{mh}:{mp}/metrics")
+            assert ('tendermint_health_status{detector="height_stall"} 2'
+                    in text)
+            bundles = os.listdir(tmp_path / "health")
+            assert len(bundles) == 1 and "height_stall" in bundles[0]
+
+            # -- recovery: real probe back, horizon restored -> 0
+            stall.warn_s, stall.crit_s = 5000.0, 10000.0
+            node.health.probes["consensus"] = (
+                lambda: {"height": node.block_store.height(),
+                         "round": node.consensus.rs.round})
+            assert await wait_level(0), node.health.report()
+            rc = await asyncio.to_thread(
+                lambda: run_health(rpc, as_json=True))
+            assert rc == 0
+            # still exactly one bundle (one critical episode)
+            assert len(os.listdir(tmp_path / "health")) == 1
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# simnet acceptance: partition -> height_stall critical -> heal -> clear
+# ---------------------------------------------------------------------------
+
+
+def test_simnet_partition_fires_height_stall(tmp_path):
+    """ISSUE 10 acceptance: on a 4-node net, partitioning one node
+    stalls its height -> its watchdog flips height_stall to critical
+    (excused: the runner declared the window), writes one bundle under
+    its node home, and journals the transition; after the heal it
+    catches up and the detector clears, with the recovery journaled.
+    The verdict's health block names the node and detector first."""
+    from tendermint_tpu.consensus.eventlog import read_events
+    from tendermint_tpu.simnet.harness import run_scenario
+    from tendermint_tpu.simnet.scenario import FaultOp, Scenario
+
+    sc = Scenario(
+        name="health-stall", seed=21, validators=4, target_height=8,
+        max_runtime_s=60.0,
+        faults=[
+            FaultOp(op="partition", at_height=2, nodes=[3]),
+            FaultOp(op="heal", at_s=6.0),
+        ],
+    )
+    rep = run_scenario(sc, str(tmp_path))
+    assert rep["ok"], rep["violations"]
+
+    health = rep["health"]
+    n3 = health["per_node"]["node3"]
+    assert n3["enabled"]
+    assert n3["criticals"] >= 1
+    # the partition window was declared, so the alarm is excused
+    assert n3["unexcused_criticals"] == 0
+    assert n3["bundles"] == 1
+    fc = health["first_critical"]
+    assert fc["node"] == "node3"
+    assert fc["detector"] == "height_stall"
+    assert fc["excused"] is True
+    # cleared after the heal: node3 caught up and its level settled
+    assert n3["level"] == 0, health
+    # a healthy run has no diagnosis line
+    assert rep["diagnosis"] is None
+
+    # exactly one forensic bundle on node3's disk, none elsewhere
+    bundles = os.listdir(tmp_path / "node3" / "health")
+    assert len(bundles) == 1 and "height_stall" in bundles[0]
+    for other in ("node0", "node1", "node2"):
+        assert not os.path.exists(tmp_path / other / "health"), other
+
+    # the transitions rode node3's journal: critical then recovery
+    events = [e for e in read_events(str(tmp_path / "node3" /
+                                         "journal.jsonl"))
+              if e["e"].startswith("health_")]
+    kinds = [e["e"] for e in events
+             if e.get("detector") == "height_stall"]
+    assert "health_critical" in kinds
+    assert kinds[-1] == "health_ok"
+
+
+def test_simnet_health_disabled_via_env(tmp_path, monkeypatch):
+    """TM_TPU_HEALTH=0 collapses every simnet hook to the NOP branch:
+    no threads, no bundles, and the verdict reports disabled nodes."""
+    from tendermint_tpu.simnet.harness import run_scenario
+    from tendermint_tpu.simnet.scenario import Scenario
+
+    monkeypatch.setenv("TM_TPU_HEALTH", "0")
+    sc = Scenario(name="health-off", seed=5, validators=4,
+                  target_height=3, max_runtime_s=60.0)
+    rep = run_scenario(sc, str(tmp_path))
+    assert rep["ok"], rep["violations"]
+    assert all(not v.get("enabled")
+               for v in rep["health"]["per_node"].values())
+    assert rep["health"]["first_critical"] is None
+    assert not os.path.exists(tmp_path / "node0" / "health")

@@ -342,6 +342,14 @@ def test_node_serves_prometheus(tmp_path):
                     "tendermint_consensus_quorum_wait_seconds_count")
             ]
             assert qw_counts and sum(qw_counts) >= 1
+            # health watchdog series (ISSUE 10, utils/health.py): typed
+            # on every scrape, one status row per detector, all 0 on
+            # this healthy single-validator node
+            assert "# TYPE tendermint_health_status gauge" in text
+            assert ("# TYPE tendermint_health_transitions_total counter"
+                    in text)
+            assert (lines['tendermint_health_status'
+                          '{detector="height_stall"}'] == "0")
             step_counts = [
                 float(v) for k, v in lines.items()
                 if k.startswith("tendermint_consensus_step_duration_seconds_count")
