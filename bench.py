@@ -1114,6 +1114,84 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["health_overhead_error"] = str(e)[-300:]
 
+        # Remediation controller overhead (round 11, ISSUE 11): the
+        # detector->action loop's cost contract — the DISABLED path is
+        # one attribute-load + branch against the NOP singleton per
+        # transition dispatch, and one ENABLED shed transition (mempool
+        # set_shed + bookkeeping + journal branch) stays under a stated
+        # budget.  Transitions are rare by construction (hysteresis), so
+        # the budget is per TRANSITION, never per tx or per sample.
+        _stage_set("remediation-overhead")
+        try:
+            from tendermint_tpu.mempool.mempool import (
+                Mempool as _Mp,
+                MempoolConfig as _MpCfg,
+            )
+            from tendermint_tpu.utils import remediate as _rm
+
+            N_EV = 20_000
+            nop = _rm.NOP
+            tr_warn = {"detector": "verify_queue_saturation",
+                       "from": 0, "to": 1, "detail": "", "excused": False}
+            t0 = time.perf_counter()
+            for _ in range(N_EV):
+                # measured exactly as the monitor's dispatch writes it
+                if nop.enabled:
+                    nop.act(tr_warn)
+            disabled_ns = (time.perf_counter() - t0) / N_EV * 1e9
+
+            class _ShedOnly:
+                """set_shed/shed_state surface only — no ABCI app."""
+
+                def set_shed(self, level, rpc_max_bytes=0,
+                             retry_after_ms=0):
+                    self.level = level
+
+                def shed_state(self):
+                    return {"level": getattr(self, "level", 0)}
+
+            ctl = _rm.RemediationController(
+                node="bench", mempool=_ShedOnly(),
+                rewarm=lambda reason: False)
+            N_TR = 5_000
+            t0 = time.perf_counter()
+            for k in range(N_TR):
+                # alternate warn/clear so every act() is a level CHANGE
+                # (the expensive arm: set_shed + note + history)
+                if ctl.enabled:
+                    ctl.act({"detector": "verify_queue_saturation",
+                             "from": k % 2, "to": (k + 1) % 2,
+                             "detail": "", "excused": False})
+            enabled_us = (time.perf_counter() - t0) / N_TR * 1e6
+            budget_us = 200.0  # per transition; transitions are rare
+            _partial.update({
+                "remediation_disabled_ns_per_event": round(disabled_ns, 1),
+                "remediation_enabled_us_per_transition": round(enabled_us, 2),
+                "remediation_budget_us_per_transition": budget_us,
+                "remediation_within_budget": bool(enabled_us <= budget_us),
+                "remediation_actions_total": sum(
+                    v for _l, v in ctl.action_samples()),
+            })
+            assert enabled_us <= budget_us, (
+                f"remediation {enabled_us:.1f}us/transition exceeds "
+                f"{budget_us}us")
+            # shed-path contract: a shedding mempool rejects a gossip tx
+            # in O(1) with the typed error (no app round-trip)
+            mp = _Mp(_MpCfg(), app_conn=None)
+            mp.set_shed(1, rpc_max_bytes=4096, retry_after_ms=500)
+            from tendermint_tpu.mempool.mempool import (
+                MempoolBackpressureError as _Bp,
+            )
+
+            try:
+                mp.check_tx(b"bench-tx", sender="peer1")
+                raise AssertionError("shedding mempool admitted gossip tx")
+            except _Bp as e:
+                assert e.retry_after_ms == 500
+            _partial["remediation_shed_path_ok"] = True
+        except Exception as e:  # noqa: BLE001
+            _partial["remediation_overhead_error"] = str(e)[-300:]
+
         # Device observability (round 9, ISSUE 4): the occupancy/padding
         # accounting rides EVERY device flush site, so its cost contract
         # mirrors the journal's — the DISABLED path is one branch per

@@ -68,7 +68,8 @@ _CLASS_RULES = (
     (re.compile(r"(_p50_ms|_ms)$"), "latency", "lower"),
     (re.compile(r"(_ns_per_event|_us_per_event|_ns_per_flush"
                 r"|_us_per_flush|_ns_per_stamp|_us_per_stamp"
-                r"|_ns_per_sample|_us_per_sample)$"),
+                r"|_ns_per_sample|_us_per_sample"
+                r"|_ns_per_transition|_us_per_transition)$"),
      "latency", "lower"),
     (re.compile(r"(_seconds|_s)$"), "timing", "lower"),
     (re.compile(r"(cold_compiles|recompiles|_findings|frames_dropped"

@@ -63,6 +63,15 @@ def render_health(block: dict) -> str:
     crit = block.get("critical") or []
     if crit:
         lines.append(f"CRITICAL: {', '.join(crit)}")
+    rem = block.get("remediation")
+    if isinstance(rem, dict) and rem.get("enabled"):
+        by = rem.get("by_action") or {}
+        acts = " ".join(f"{a}={c}" for a, c in sorted(by.items())) or "none"
+        lines.append(
+            f"remediation — shed {rem.get('shed_state', 'ok')}"
+            f"  actions {acts}"
+            + (f"  quarantined {','.join(rem['quarantined_peers'])}"
+               if rem.get("quarantined_peers") else ""))
     return "\n".join(lines) + "\n"
 
 

@@ -99,6 +99,8 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
         "costs": {},
         "txlife": {"finality": None, "residency": None, "quorum_wait": {}},
         "health": {"level": None, "detectors": {}},
+        "remediation": {"enabled": None, "shed_level": None,
+                        "by_action": {}, "quarantined": 0},
         "device_memory": [],
         "errors": [],
     }
@@ -120,6 +122,14 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
                 "detectors": {name: int(d.get("level", 0))
                               for name, d in
                               (hb.get("detectors") or {}).items()},
+            }
+        rb = hb.get("remediation") if isinstance(hb, dict) else None
+        if isinstance(rb, dict) and rb.get("enabled"):
+            snap["remediation"] = {
+                "enabled": True,
+                "shed_level": int(rb.get("shed_level", 0)),
+                "by_action": dict(rb.get("by_action") or {}),
+                "quarantined": len(rb.get("quarantined_peers") or []),
             }
         vs = st.get("verify_service", {})
         if vs:
@@ -289,6 +299,24 @@ def _fold_metrics(snap: dict, by_name: dict) -> None:
             hl["detectors"] = dets
             hl["level"] = max(dets.values())
 
+    # remediation controller: the active-state gauge is the metrics-side
+    # twin of status.health.remediation
+    rl = snap.setdefault("remediation", {"enabled": None, "shed_level": None,
+                                         "by_action": {}, "quarantined": 0})
+    if rl["enabled"] is None:
+        active = {labels.get("action", "?"): v for labels, v in
+                  by_name.get("tendermint_remediation_active", [])}
+        acts: dict[str, int] = {}
+        for labels, v in by_name.get("tendermint_remediation_actions_total",
+                                     []):
+            a = labels.get("action", "?")
+            acts[a] = acts.get(a, 0) + int(v)
+        if active or acts:
+            rl.update({"enabled": True,
+                       "shed_level": int(active.get("shed", 0)),
+                       "by_action": acts,
+                       "quarantined": int(active.get("evict", 0))})
+
     mem: dict[str, dict] = {}
     for labels, v in by_name.get("tendermint_crypto_device_memory_bytes", []):
         dev = labels.get("device", "?")
@@ -444,6 +472,15 @@ def render(snap: dict) -> str:
                            sorted(hl.get("detectors", {}).items()) if lvl)
         lines.append(f"health     {state}"
                      + (f"  [{firing}]" if firing else ""))
+    rl = snap.get("remediation") or {}
+    if rl.get("enabled"):
+        shed = int(rl.get("shed_level") or 0)
+        acts = "  ".join(f"{a}:{c}" for a, c in
+                         sorted((rl.get("by_action") or {}).items()))
+        lines.append(
+            f"remediate  shed {('ok', 'WARN', 'CRITICAL')[min(2, shed)]}"
+            f"  quarantined {rl.get('quarantined', 0)}"
+            + (f"  [{acts}]" if acts else ""))
     if snap["device_memory"]:
         for e in snap["device_memory"]:
             detail = "  ".join(

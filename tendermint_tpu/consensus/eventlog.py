@@ -87,6 +87,14 @@ EVENT_TYPES = (
     "health_warn",      # a detector escalated/settled to warn
     "health_critical",  # a detector escalated to critical
     "health_ok",        # a detector recovered to ok
+    # remediation actions (utils/remediate.py).  All carry trigger (the
+    # detector or cause), detail, excused (transition fired inside a
+    # declared fault window).
+    "remediation_shed",    # mempool admission level changed: level
+    "remediation_rewarm",  # background AOT re-warm requested: started
+    "remediation_retune",  # occupancy-fed shape-plan retune: rungs
+    "remediation_evict",   # flapping peer evicted + quarantined: peer
+    "remediation_pardon",  # quarantine expired, ladder reset: peer
 )
 
 # Rotation/pruning checks stat() files, so they are amortized — but on a

@@ -62,7 +62,8 @@ RULES: dict[str, str] = {
     "ungated-observability":
         "observability sink whose disabled-path contract is one caller "
         "branch (STATS.record_flush, journal.log, lifecycle.stamp, "
-        "health.sample/record) called without an `.enabled` guard",
+        "health.sample/record, remediate.act/record) called without an "
+        "`.enabled` guard",
     "host-sync-in-jit":
         "host synchronization (.item/.tolist/np.asarray/jax.device_get/"
         ".block_until_ready) inside a jit-compiled function body",
@@ -87,7 +88,7 @@ JAX_ALLOWED_DIRS = {"ops", "parallel"}
 #: files that DEFINE the observability sinks: internal calls inside them
 #: are the implementation, not a call site
 OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
-                           "txlife.py", "health.py"}
+                           "txlife.py", "health.py", "remediate.py"}
 
 #: label names that explode series cardinality on a real network
 HIGH_CARDINALITY_LABELS = {"height", "hash", "tx_hash", "block_hash",
@@ -505,17 +506,26 @@ class _Walker:
                         node, "ungated-observability",
                         "lifecycle.stamp() without an `if ...enabled:` "
                         "guard — the disabled path must cost one branch")
-            elif func.attr in ("sample", "record") and not st.gated:
-                # health-watchdog sinks (utils/health.py): explicit
-                # sampling and out-of-band observation pushes cost one
-                # branch when TM_TPU_HEALTH=0 routes to the NOP monitor
+            elif func.attr in ("sample", "record", "act") and not st.gated:
+                # health-watchdog sinks (utils/health.py) and
+                # remediation sinks (utils/remediate.py): explicit
+                # sampling, out-of-band observation pushes and
+                # transition dispatch cost one branch when the env gate
+                # routes to the NOP singleton
                 recv = func.value
                 recv_name = recv.attr if isinstance(recv, ast.Attribute) \
                     else (recv.id if isinstance(recv, ast.Name) else "")
-                if recv_name.endswith(("health", "HEALTH")):
+                if recv_name.endswith(("health", "HEALTH")) \
+                        and func.attr != "act":
                     self._report(
                         node, "ungated-observability",
                         f"health.{func.attr}() without an "
+                        "`if ...enabled:` guard — the disabled path "
+                        "must cost one branch")
+                elif recv_name.endswith(("remediate", "REMEDIATE")):
+                    self._report(
+                        node, "ungated-observability",
+                        f"remediate.{func.attr}() without an "
                         "`if ...enabled:` guard — the disabled path "
                         "must cost one branch")
 

@@ -39,6 +39,29 @@ class TxTooLargeError(Exception):
 class MempoolFullError(Exception):
     def __init__(self, num_txs: int, total_bytes: int):
         super().__init__(f"mempool full: {num_txs} txs, {total_bytes} bytes")
+        self.num_txs = num_txs
+        self.total_bytes = total_bytes
+
+
+class MempoolBackpressureError(MempoolFullError):
+    """Structural rejection by ADMISSION CONTROL, not capacity: the
+    remediation controller (utils/remediate.py) put the mempool into a
+    shedding mode and this tx's class is being shed.  Subclasses
+    MempoolFullError so every existing full-pool handler keeps working,
+    while RPC can surface a distinct backpressure error with a
+    retry-after hint instead of a generic internal fault."""
+
+    def __init__(self, num_txs: int, total_bytes: int, shed_level: int,
+                 tx_class: str, retry_after_ms: int):
+        Exception.__init__(
+            self,
+            f"mempool shedding load (level {shed_level}): {tx_class} tx "
+            f"rejected, retry after {retry_after_ms}ms")
+        self.num_txs = num_txs
+        self.total_bytes = total_bytes
+        self.shed_level = shed_level
+        self.tx_class = tx_class
+        self.retry_after_ms = retry_after_ms
 
 
 class PreCheckError(Exception):
@@ -109,6 +132,15 @@ class Mempool:
         # tx lifecycle store (utils/txlife.py): NOP unless the node wires
         # one; the admission/gossip hook sites pay one branch when off
         self.lifecycle = _txlife.NOP
+        # admission-control shedding (utils/remediate.py drives this on
+        # verify_queue_saturation transitions).  Level 0 = normal (the
+        # check_tx fast path pays one int compare); level 1 (warn) sheds
+        # the lowest tx class — gossip-received; level 2 (critical) also
+        # sheds RPC-submitted txs larger than _shed_rpc_max_bytes.
+        self._shed_level = 0
+        self._shed_rpc_max_bytes = 0
+        self._shed_retry_after_ms = 0
+        self.shed_counts: dict[str, int] = {"gossip": 0, "rpc": 0}
         # optional raw-tx WAL (reference clist_mempool.go InitWAL: recovery
         # aid only — replayed manually by operators, never by the node)
         self._wal = None
@@ -145,6 +177,38 @@ class Mempool:
         ):
             raise MempoolFullError(len(self._txs), self._total_bytes)
 
+    # -- admission control (shedding) ------------------------------------
+    def set_shed(self, level: int, rpc_max_bytes: int = 0,
+                 retry_after_ms: int = 1000) -> None:
+        """Enter/leave shedding mode (remediation controller only).
+        Level clamps to 0..2; 0 restores normal admission."""
+        self._shed_level = max(0, min(2, int(level)))
+        self._shed_rpc_max_bytes = int(rpc_max_bytes)
+        self._shed_retry_after_ms = int(retry_after_ms)
+
+    def shed_state(self) -> dict:
+        return {
+            "level": self._shed_level,
+            "rpc_max_bytes": self._shed_rpc_max_bytes,
+            "retry_after_ms": self._shed_retry_after_ms,
+            "shed_counts": dict(self.shed_counts),
+        }
+
+    def _shed_check(self, tx: bytes, tx_class: str) -> None:
+        """Prioritized-class shedding, lowest class first: level 1 sheds
+        gossip-received txs; level 2 additionally sheds RPC-submitted
+        txs over the size cutoff (small RPC txs stay admitted so the
+        node keeps serving its own clients longest)."""
+        lvl = self._shed_level
+        shed = tx_class == "gossip" or (
+            lvl >= 2 and self._shed_rpc_max_bytes > 0
+            and len(tx) > self._shed_rpc_max_bytes)
+        if shed:
+            self.shed_counts[tx_class] = self.shed_counts.get(tx_class, 0) + 1
+            raise MempoolBackpressureError(
+                len(self._txs), self._total_bytes, lvl, tx_class,
+                self._shed_retry_after_ms)
+
     # -- lock (held by BlockExecutor.Commit) -----------------------------
     # No-ops today: check_tx/update run synchronously on one event loop,
     # so Commit+Update cannot interleave with CheckTx.  These are the
@@ -171,6 +235,11 @@ class Mempool:
             raise TxTooLargeError(self.config.max_tx_bytes, len(tx))
         if self.pre_check is not None:
             self.pre_check(tx)
+        if self._shed_level:
+            # structural rejection BEFORE the cache: a shed tx never
+            # enters the dedup cache, so it can re-enter once admission
+            # recovers (the retry-after contract)
+            self._shed_check(tx, "gossip" if sender else "rpc")
 
         if not self.cache.push(tx):
             # record the new sender for an existing tx (gossip dedup)

@@ -327,6 +327,27 @@ class NodeMetrics:
             fn=lambda: node.health.transition_samples(),
         ))
 
+        # -- remediation controller (utils/remediate.py) ----------------
+        # actions executed per (action, triggering detector), and the
+        # currently-active state per action (shed = admission level,
+        # evict = quarantined peers, rewarm = rate-limit window open);
+        # empty (TYPE lines only) when TM_TPU_REMEDIATE=0 (NOP).
+        self.remediation_actions = reg.register(LabeledCallbackGauge(
+            "remediation_actions_total",
+            "Remediation actions executed, by action and trigger "
+            "(shed | rewarm | retune | evict | pardon)",
+            namespace=ns, kind="counter",
+            fn=lambda: node.remediate.action_samples(),
+        ))
+        self.remediation_active = reg.register(LabeledCallbackGauge(
+            "remediation_active",
+            "Currently-active remediation state per action (shed = "
+            "admission level 0-2, evict = quarantined peers, rewarm = "
+            "1 while the rewarm rate-limit window is open)",
+            namespace=ns,
+            fn=lambda: node.remediate.active_samples(),
+        ))
+
         # -- latency histograms fed at their source ---------------------
         # Process-wide module singletons (the verify service, the FSM,
         # blocksync and RPC observe them where the timing happens); this

@@ -398,6 +398,32 @@ class Node:
                                  config.consensus.timeout_commit_ms / 1e3),
         )
 
+        # -- remediation controller (TM_TPU_REMEDIATE, default on;
+        # utils/remediate.py): detector transitions from the watchdog
+        # drive admission control (mempool shedding), compile-storm
+        # re-warm/retune, and peer eviction/quarantine.  The dialer
+        # consults `quarantined()` before every redial; eviction severs
+        # through the router from the watchdog's thread via the loop.
+        from tendermint_tpu.utils import remediate as _remediate
+
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+        def _evict_peer(pid: str) -> None:
+            loop = self._loop
+            if loop is not None and loop.is_running():
+                asyncio.run_coroutine_threadsafe(
+                    self.router.disconnect(pid), loop)
+
+        self.remediate = _remediate.from_env(
+            node=config.base.moniker or self.node_key.node_id[:8],
+            mempool=self.mempool,
+            backoff=self._dial_backoff,
+            evict_peer=_evict_peer,
+            journal=self.consensus.journal,
+        )
+        if self.health.enabled and self.remediate.enabled:
+            self.health.remediate = self.remediate
+
         # -- RPC --------------------------------------------------------
         from tendermint_tpu.rpc.core import Environment
         from tendermint_tpu.rpc.server import RPCServer
@@ -422,6 +448,7 @@ class Node:
             moniker=config.base.moniker,
             txlife=self.txlife,
             health=self.health,
+            remediate=self.remediate,
         )
         self.grpc_server = None
         self.pprof_server = None
@@ -451,6 +478,7 @@ class Node:
         if self._started:
             raise RuntimeError("node already started")
         self._started = True
+        self._loop = asyncio.get_running_loop()
         # prime the batch verifier (native host-prep build/load) off the
         # event loop, and log its dispatch configuration.  The RTT
         # measurement itself is LAZY (first ≥64-sig batch) — node start
@@ -632,6 +660,12 @@ class Node:
                     connected.discard(pid)
                     backoff.note_disconnected(pid, now)
                     next_try[pid] = now + backoff.next_delay(pid)
+                    continue
+                # remediation quarantine (utils/remediate.py): an
+                # evicted flapper sits out its window — the dial-flap-
+                # dial loop ends here; pardon resets the ladder to
+                # rung 0 inside quarantined()
+                if self.remediate.enabled and self.remediate.quarantined(pid):
                     continue
                 if now >= next_try[pid]:
                     due.append(pid)

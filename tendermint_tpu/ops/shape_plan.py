@@ -597,6 +597,7 @@ def warm_plan(plan: ShapePlan, *, kinds=None, impls=None,
 
 _BG_LOCK = threading.Lock()
 _BG_STARTED = False
+_BG_INFLIGHT = False
 
 
 def aot_enabled() -> bool:
@@ -604,7 +605,7 @@ def aot_enabled() -> bool:
     return os.environ.get("TM_TPU_AOT", "1") != "0"
 
 
-def start_background_warm(reason: str = "") -> bool:
+def start_background_warm(reason: str = "", force: bool = False) -> bool:
     """Warm the SAVED plan on a daemon thread (idempotent per process).
 
     Strict opt-in: no saved plan (the operator never ran
@@ -613,8 +614,13 @@ def start_background_warm(reason: str = "") -> bool:
     untouched.  With a saved plan, artifacts deserialize in well under a
     second each and missing entries compile against the (warm)
     persistent cache; either way the first real flush finds its program
-    ready instead of paying the ~100 s relay inline."""
-    global _BG_STARTED
+    ready instead of paying the ~100 s relay inline.
+
+    `force=True` bypasses the once-per-process latch — the remediation
+    controller's compile-storm self-heal re-warms a LIVE node whose
+    cache went stale mid-run.  Overlap is still excluded (one warm
+    worker at a time); the controller provides the rate limit."""
+    global _BG_STARTED, _BG_INFLIGHT
     if not aot_enabled():
         return False
     try:
@@ -624,11 +630,13 @@ def start_background_warm(reason: str = "") -> bool:
     if not os.path.exists(path):
         return False
     with _BG_LOCK:
-        if _BG_STARTED:
+        if _BG_INFLIGHT or (_BG_STARTED and not force):
             return False
         _BG_STARTED = True
+        _BG_INFLIGHT = True
 
     def _bg() -> None:
+        global _BG_INFLIGHT
         try:
             plan = load_plan(path)
             rep = warm_plan(plan, serialize=False, save=False)
@@ -639,6 +647,9 @@ def start_background_warm(reason: str = "") -> bool:
         except Exception as e:  # noqa: BLE001 — warm is best-effort
             _log.warning("background AOT warm (%s) failed: %s",
                          reason or "start", e)
+        finally:
+            with _BG_LOCK:
+                _BG_INFLIGHT = False
 
     threading.Thread(target=_bg, daemon=True, name="tm-aot-warm").start()
     return True

@@ -48,6 +48,11 @@ class DialBackoff:
         self._rng = rng
         self._attempts: dict[str, int] = {}
         self._connected_at: dict[str, float] = {}
+        # flap counter: connections that died before proving stable
+        # (uptime < min_uptime_s).  The remediation layer's per-peer
+        # score — a chronic flapper accumulates these while a peer that
+        # eventually sticks gets wiped by the ladder reset.
+        self._flaps: dict[str, int] = {}
 
     def next_delay(self, peer_id: str) -> float:
         """Delay before the next dial attempt; advances the ladder."""
@@ -65,14 +70,47 @@ class DialBackoff:
         """Reset the ladder only after a PROVEN-stable connection: a
         peer that dies within min_uptime_s keeps climbing, so a flapping
         peer converges to cap_s-spaced dials instead of busy-looping at
-        the floor."""
+        the floor.  An early death also counts a flap — the remediation
+        layer's eviction score."""
         connected_at = self._connected_at.pop(peer_id, None)
-        if connected_at is not None and now - connected_at >= self.min_uptime_s:
+        if connected_at is None:
+            return
+        if now - connected_at >= self.min_uptime_s:
             self._attempts.pop(peer_id, None)
+            self._flaps.pop(peer_id, None)
+        else:
+            self._flaps[peer_id] = self._flaps.get(peer_id, 0) + 1
 
     def attempts(self, peer_id: str) -> int:
         return self._attempts.get(peer_id, 0)
 
-    def forget(self, peer_id: str) -> None:
+    def flaps(self, peer_id: str) -> int:
+        return self._flaps.get(peer_id, 0)
+
+    def reset(self, peer_id: str) -> None:
+        """Hard ladder reset: the peer's next dial starts from rung 0
+        with a clean flap score.  The remediation layer calls this when
+        a quarantined peer is pardoned — without it, a pardoned peer
+        would inherit its stale (usually capped) rung and the clean
+        reconnect it earned would still wait cap_s."""
         self._attempts.pop(peer_id, None)
         self._connected_at.pop(peer_id, None)
+        self._flaps.pop(peer_id, None)
+
+    def forget(self, peer_id: str) -> None:
+        self.reset(peer_id)
+
+    def peer_state(self, peer_id: str) -> dict:
+        """One peer's ladder view for the scoring layer."""
+        return {
+            "attempts": self._attempts.get(peer_id, 0),
+            "flaps": self._flaps.get(peer_id, 0),
+            "connected": peer_id in self._connected_at,
+        }
+
+    def peer_states(self) -> dict[str, dict]:
+        """Every peer the ladder has seen -> its state snapshot (the
+        remediation controller's eviction-scoring input)."""
+        peers = (set(self._attempts) | set(self._connected_at)
+                 | set(self._flaps))
+        return {pid: self.peer_state(pid) for pid in sorted(peers)}

@@ -22,7 +22,7 @@ from tendermint_tpu.pubsub.query import parse as parse_query
 from tendermint_tpu.types import events as tmevents
 
 from . import encoding as enc
-from .jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, RPCError
+from .jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, MEMPOOL_FULL, RPCError
 
 
 class Environment:
@@ -51,6 +51,7 @@ class Environment:
         version: str = "0.1.0",
         txlife=None,
         health=None,
+        remediate=None,
     ):
         self.config = config
         self.genesis = genesis
@@ -76,6 +77,12 @@ class Environment:
         # health watchdog (utils/health.py): `status` publishes its
         # per-detector block so `tendermint-tpu health` needs one RPC
         self.health = health if health is not None else _health.NOP
+        # remediation controller (utils/remediate.py): `status` embeds
+        # its block under health.remediation — the explicit backpressure
+        # signal (shed level + quarantines) clients poll before retrying
+        from tendermint_tpu.utils import remediate as _remediate
+
+        self.remediate = remediate if remediate is not None else _remediate.NOP
 
 
 def _latest_height(env: Environment) -> int:
@@ -129,6 +136,17 @@ def _verify_service_status() -> dict:
     }
 
 
+def _health_status_block(env: Environment) -> dict:
+    """The status.health block, with the remediation controller's state
+    (admission/shed level, quarantined peers, action counts — the
+    backpressure signal) embedded when remediation is on."""
+    block = env.health.status_block()
+    if env.remediate.enabled:
+        block = dict(block)
+        block["remediation"] = env.remediate.status_block()
+    return block
+
+
 def status(env: Environment) -> dict:
     latest = _latest_height(env)
     meta = env.block_store.load_block_meta(latest) if latest else None
@@ -167,7 +185,7 @@ def status(env: Environment) -> dict:
             "voting_power": enc.i64(power),
         },
         "verify_service": _verify_service_status(),
-        "health": env.health.status_block(),
+        "health": _health_status_block(env),
     }
 
 
@@ -377,23 +395,53 @@ def _bytes_param(v) -> bytes:
 _tx_commit_seq = itertools.count(1)
 
 
+def _mempool_full_rpc_error(e) -> RPCError:
+    """Map a MempoolFullError (capacity) or MempoolBackpressureError
+    (admission-control shedding) to the structured MEMPOOL_FULL
+    JSON-RPC error — clients distinguish backpressure (retry after the
+    hint) from faults by code, not by parsing a message string."""
+    data = {
+        "code": "mempool_full",
+        "num_txs": getattr(e, "num_txs", 0),
+        "total_bytes": getattr(e, "total_bytes", 0),
+        "retry_after_ms": getattr(e, "retry_after_ms", 0),
+    }
+    shed_level = getattr(e, "shed_level", 0)
+    if shed_level:
+        data["code"] = "backpressure"
+        data["shed_level"] = shed_level
+        data["tx_class"] = getattr(e, "tx_class", "")
+    return RPCError(MEMPOOL_FULL, str(e), data=data)
+
+
 def broadcast_tx_async(env: Environment, tx=None) -> dict:
+    from tendermint_tpu.mempool.mempool import MempoolFullError
+
     data = _bytes_param(tx)
     tx_hash = tmhash.sum_sha256(data)
     if env.txlife.enabled:
         env.txlife.stamp(tx_hash, "rpc")
-    # fire-and-forget (reference mempool.go:22-36): CheckTx result ignored
-    env.mempool.check_tx(data)
+    # fire-and-forget (reference mempool.go:22-36): CheckTx result is
+    # ignored, but a structural rejection still surfaces as the typed
+    # error so async submitters see backpressure too
+    try:
+        env.mempool.check_tx(data)
+    except MempoolFullError as e:
+        raise _mempool_full_rpc_error(e) from e
     return {"code": 0, "data": "", "log": "", "hash": enc.hexu(tx_hash)}
 
 
 def broadcast_tx_sync(env: Environment, tx=None) -> dict:
+    from tendermint_tpu.mempool.mempool import MempoolFullError
+
     data = _bytes_param(tx)
     tx_hash = tmhash.sum_sha256(data)
     if env.txlife.enabled:
         env.txlife.stamp(tx_hash, "rpc")
     try:
         res = env.mempool.check_tx(data)
+    except MempoolFullError as e:
+        raise _mempool_full_rpc_error(e) from e
     except Exception as e:
         raise RPCError(INTERNAL_ERROR, str(e)) from e
     return {
@@ -408,6 +456,8 @@ def broadcast_tx_sync(env: Environment, tx=None) -> dict:
 async def broadcast_tx_commit(env: Environment, tx=None) -> dict:
     """CheckTx, then wait for the tx to be committed (reference
     rpc/core/mempool.go:55-136, 10s timeout)."""
+    from tendermint_tpu.mempool.mempool import MempoolFullError
+
     data = _bytes_param(tx)
     tx_hash = tmhash.sum_sha256(data)
     if env.txlife.enabled:
@@ -423,7 +473,10 @@ async def broadcast_tx_commit(env: Environment, tx=None) -> dict:
     except ValueError as e:
         raise RPCError(INTERNAL_ERROR, str(e)) from e
     try:
-        check = env.mempool.check_tx(data)
+        try:
+            check = env.mempool.check_tx(data)
+        except MempoolFullError as e:
+            raise _mempool_full_rpc_error(e) from e
         if check.code != 0:
             return {
                 "check_tx": enc.deliver_tx_json(check),

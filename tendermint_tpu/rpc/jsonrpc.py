@@ -14,10 +14,15 @@ INVALID_REQUEST = -32600
 METHOD_NOT_FOUND = -32601
 INVALID_PARAMS = -32602
 INTERNAL_ERROR = -32603
+# server-defined range (-32000..-32099): mempool rejected the tx
+# structurally — full pool or admission-control shedding.  `data`
+# carries {code, num_txs, total_bytes, retry_after_ms} so clients can
+# distinguish backpressure (retry later) from faults (give up).
+MEMPOOL_FULL = -32001
 
 
 class RPCError(Exception):
-    def __init__(self, code: int, message: str, data: str = ""):
+    def __init__(self, code: int, message: str, data: "str | dict" = ""):
         super().__init__(message)
         self.code = code
         self.message = message

@@ -241,6 +241,19 @@ class FaultyNetwork(MemoryNetwork):
         return self._partition is not None
 
     # -- churn -----------------------------------------------------------
+    async def churn_node(self, node_id: NodeID) -> None:
+        """Sever a node's CONNECTIONS but keep its transport registered
+        — a flapping NIC/route, not a process death: peers observe the
+        close, redial, and the next churn severs them again.  The flap
+        fault op drives this to exercise the dial ladder's flap
+        detection and the remediation layer's eviction."""
+        t = self.nodes.get(node_id)
+        if t is None:
+            return
+        for conn in list(t.conns):
+            await conn.close()
+        t.conns.clear()
+
     async def drop_node(self, node_id: NodeID) -> None:
         """Sever a node from the net the way a process death would:
         every one of its connections closes (both sides learn), and its

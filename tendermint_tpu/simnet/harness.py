@@ -28,6 +28,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import os
+import random
 import time
 
 from tendermint_tpu.abci import AppConns
@@ -52,6 +53,7 @@ from tendermint_tpu.types import GenesisDoc, GenesisValidator
 from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 from tendermint_tpu.utils import fail
 from tendermint_tpu.utils import health as tmhealth
+from tendermint_tpu.utils import remediate as tmremediate
 from tendermint_tpu.utils.log import Logger, nop_logger
 from tendermint_tpu.utils.txlife import TxLifecycle
 
@@ -93,6 +95,7 @@ class SimNode:
                  consensus_config: ConsensusConfig,
                  misbehaviors: dict[int, str] | None = None,
                  gossip_sleep_ms: int = 10,
+                 detector_overrides: dict | None = None,
                  logger: Logger | None = None):
         self.index = index
         self.name = f"node{index}"
@@ -165,6 +168,11 @@ class SimNode:
         # horizon scaled to the (50ms-class) test timeouts; bundles land
         # under the node home, and the runner feeds fault windows in so
         # in-window transitions read back as excused.
+        # fault-injection overrides merged into every health sample
+        # (LAST, so an injected verify_queue_depth/cold_compiles beats
+        # the real probes) — the runner's flood/compile_storm ops write
+        # here and the detectors react exactly as they would live
+        self.fault_inject: dict = {}
         self.health = tmhealth.from_env(
             node=self.name,
             root=home,
@@ -175,13 +183,60 @@ class SimNode:
                     "peers": len(self.router.peers),
                     "peer_disconnects": self.router.peers_disconnected,
                 },
+                "inject": lambda: dict(self.fault_inject),
             },
             journal=self.cs.journal,
             journal_path=self.journal_path,
             expected_block_s=max(0.2,
                                  4 * consensus_config.timeout_commit_ms / 1e3),
             interval_s=0.25,
+            # detector-window overrides: the RUNNER passes test-scale
+            # compile-storm grace / peer-flap spans ONLY for scenarios
+            # that inject those triggers (compile_storm/flap ops) — a
+            # blanket min-span cut would make one partition disconnect
+            # read as a high per-minute rate over a tiny span and flap
+            # peer_flap in scenarios that never touch the links
+            **(detector_overrides or {}),
         )
+        # per-node dial ladder: the runner's mesh keeper climbs it for
+        # every peer THIS node dials, so its flap counters are the
+        # remediation controller's eviction score (same policy as the
+        # real node's persistent-peer dialer)
+        self.dial_backoff = DialBackoff(base_s=0.1, cap_s=2.0,
+                                        min_uptime_s=2.0, rng=network.rng)
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+        def _evict_peer(pid: str) -> None:
+            loop = self._loop
+            if loop is not None and loop.is_running():
+                asyncio.run_coroutine_threadsafe(
+                    self.router.disconnect(pid), loop)
+
+        # remediation controller (TM_TPU_REMEDIATE, default on): wired
+        # like the real node's, with test-scale quarantine windows and
+        # a recording-only rewarm — simnet nodes share one process, so
+        # a REAL background warm would compile in-process; the action
+        # (and its journal row) is what scenarios assert.
+        self.remediate = tmremediate.NOP
+        if tmremediate.env_enabled():
+            self.remediate = tmremediate.RemediationController(
+                node=self.name,
+                mempool=self.mempool,
+                backoff=self.dial_backoff,
+                evict_peer=_evict_peer,
+                rewarm=lambda reason: False,
+                journal=self.cs.journal,
+                rewarm_min_s=30.0,
+                # test scale: a flap op churns every ~0.4s, so two
+                # early deaths already prove the pattern; production
+                # keeps the env-tuned threshold of 3
+                flap_threshold=2,
+                quarantine_s=2.0,
+                quarantine_cap_s=8.0,
+                rng=random.Random(f"remediate-{genesis.chain_id}-{index}"),
+            )
+        if self.health.enabled and self.remediate.enabled:
+            self.health.remediate = self.remediate
         self.reactor = ConsensusReactor(
             self.cs, self.router, self.block_store,
             gossip_sleep_ms=gossip_sleep_ms, maj23_sleep_ms=500,
@@ -206,6 +261,7 @@ class SimNode:
             gossip_sleep_ms=max(50, 5 * gossip_sleep_ms))
 
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
         # bind every task this node creates to its fail-point scope
         token = fail.set_scope(self.name)
         try:
@@ -291,6 +347,16 @@ class SimnetRunner:
         self._ccfg = self._consensus_config()
         self._byzantine = scenario.byzantine_nodes()
         self._maverick_map = scenario.maverick_map()
+        # test-scale detector windows, only where the schedule injects
+        # the matching trigger (production defaults otherwise)
+        ops = {op.op for op in scenario.faults}
+        self._detector_overrides: dict = {}
+        if "compile_storm" in ops:
+            self._detector_overrides.update(
+                compile_grace_s=1.5, compile_window_s=10.0)
+        if "flap" in ops:
+            self._detector_overrides.update(
+                flap_window_s=12.0, flap_min_span_s=3.0)
         # bookkeeping for the verdict
         self.accepted_tx = 0
         self.offered_tx = 0
@@ -303,6 +369,9 @@ class SimnetRunner:
         self._mesh: list[tuple[int, int]] = []
         self._aux: list[asyncio.Task] = []
         self._applying = False
+        # flood-op load spike: the driver multiplies its offered rate
+        # by this for the duration of the injection window
+        self._load_factor = 1.0
 
     # -- construction ----------------------------------------------------
     def _consensus_config(self) -> ConsensusConfig:
@@ -348,6 +417,7 @@ class SimnetRunner:
             consensus_config=self._ccfg,
             misbehaviors=self._maverick_map.get(index),
             gossip_sleep_ms=self.scenario.gossip_sleep_ms,
+            detector_overrides=self._detector_overrides,
             logger=self.logger,
         )
         return node
@@ -462,10 +532,16 @@ class SimnetRunner:
                         else {"enabled": False})
             for node in self.nodes
         }
+        remediation_reports = {
+            node.name: (node.remediate.report() if node.remediate.enabled
+                        else {"enabled": False})
+            for node in self.nodes
+        }
 
         run_info = {
             "t_start_ns": t_start_ns,
             "health": health_reports,
+            "remediation": remediation_reports,
             "duration_s": duration_s,
             "timed_out": timed_out,
             "timeout_commit_ms": self._ccfg.timeout_commit_ms,
@@ -539,26 +615,45 @@ class SimnetRunner:
 
     async def _mesh_keeper(self) -> None:
         """Keep the mesh dialed through churn: a restarted node or a
-        healed partition is redialed on the DialBackoff ladder — the
-        same policy the real node's persistent-peer dialer runs."""
-        backoff = DialBackoff(base_s=0.1, cap_s=2.0, min_uptime_s=2.0,
-                              rng=self.network.rng)
+        healed partition is redialed on the DIALING node's DialBackoff
+        ladder — the same policy the real node's persistent-peer dialer
+        runs.  Disconnects are noted against the ladder so a flapping
+        target accumulates flap score, the remediation controller can
+        evict + quarantine it (the keeper honors the quarantine), and a
+        pardoned peer restarts from rung 0."""
         next_try: dict[tuple[int, int], float] = {}
+        connected: set[tuple[int, int]] = set()
         loop = asyncio.get_running_loop()
         while True:
             now = loop.time()
             for i, j in self._mesh:
                 a, b = self.nodes[i], self.nodes[j]
-                if a.crashed or b.crashed or b.node_id in a.router.peers:
-                    continue
                 key = (i, j)
+                if a.crashed or b.crashed:
+                    connected.discard(key)
+                    continue
+                if b.node_id in a.router.peers:
+                    if key not in connected:
+                        a.dial_backoff.note_connected(b.node_id, now)
+                        connected.add(key)
+                    continue
+                if key in connected:
+                    # the link just died: flap-or-reset is the ladder's
+                    # call (survived min_uptime or not)
+                    connected.discard(key)
+                    a.dial_backoff.note_disconnected(b.node_id, now)
+                    next_try[key] = now + a.dial_backoff.next_delay(b.node_id)
+                    continue
+                if a.remediate.enabled and a.remediate.quarantined(b.node_id):
+                    continue
                 if now < next_try.get(key, 0.0):
                     continue
                 try:
                     await a.router.dial(b.node_id)
-                    backoff.note_connected(f"{i}-{j}", now)
+                    a.dial_backoff.note_connected(b.node_id, now)
+                    connected.add(key)
                 except (ConnectionError, OSError):
-                    next_try[key] = now + backoff.next_delay(f"{i}-{j}")
+                    next_try[key] = now + a.dial_backoff.next_delay(b.node_id)
             await asyncio.sleep(0.1)
 
     # -- load ------------------------------------------------------------
@@ -567,8 +662,8 @@ class SimnetRunner:
         (they gossip from there — reference test/e2e/runner/load.go)."""
         sc = self.scenario
         i = 0
-        interval = 1.0 / sc.load_rate
         while sc.load_total <= 0 or self.offered_tx < sc.load_total:
+            interval = 1.0 / (sc.load_rate * self._load_factor)
             targets = [n for n in self.nodes
                        if not n.crashed and n.index not in self._byzantine]
             if targets:
@@ -697,6 +792,77 @@ class SimnetRunner:
             await self._crash_op(op)
         elif op.op == "restart":
             await self._restart(int(op.nodes[0]))
+        elif op.op == "flood":
+            await self._flood_op(op)
+        elif op.op == "compile_storm":
+            await self._compile_storm_op(op)
+        elif op.op == "flap":
+            await self._flap_op(op)
+
+    # -- remediation-trigger injections ----------------------------------
+    def _inject_targets(self, op) -> list[SimNode]:
+        if op.nodes:
+            return [self.nodes[int(i)] for i in op.nodes]
+        return [n for n in self.nodes
+                if not n.crashed and n.index not in self._byzantine]
+
+    async def _flood_op(self, op) -> None:
+        """Overload: saturate the targets' verify-queue signal while the
+        load driver spikes real offered traffic — the detector escalates,
+        the controller sheds, and admission must recover after."""
+        targets = self._inject_targets(op)
+        duration = op.duration_s or 3.0
+        depth = op.queue_depth or 4096
+        self._window_open("flood", "flood",
+                          [n.index for n in targets])
+        self._load_factor = op.load_multiplier or 5.0
+        for n in targets:
+            n.fault_inject["verify_queue_depth"] = depth
+        try:
+            await asyncio.sleep(duration)
+        finally:
+            for n in targets:
+                n.fault_inject.pop("verify_queue_depth", None)
+            self._load_factor = 1.0
+            self._window_close("flood")
+
+    async def _compile_storm_op(self, op) -> None:
+        """Cache-wipe signal: inject cold-compile growth so the
+        compile_storm detector escalates and the controller's
+        rate-limited re-warm fires."""
+        targets = self._inject_targets(op)
+        duration = op.duration_s or 3.0
+        growth = op.cold_compiles or 5
+        self._window_open("compile_storm", "compile_storm",
+                          [n.index for n in targets])
+        for n in targets:
+            n.fault_inject["cold_compiles"] = growth
+        try:
+            await asyncio.sleep(duration)
+        finally:
+            for n in targets:
+                n.fault_inject.pop("cold_compiles", None)
+            self._window_close("compile_storm")
+
+    async def _flap_op(self, op) -> None:
+        """Link churn: sever the victim's connections every period so
+        its peers' dial ladders accumulate flaps, the peer_flap detector
+        escalates, and the controller evicts + quarantines — ending the
+        dial-flap-dial loop the keeper would otherwise run forever."""
+        index = int(op.nodes[0])
+        victim = self.nodes[index]
+        duration = op.duration_s or 4.0
+        period = op.period_s or 0.4
+        self._window_open(f"flap-{index}", "flap", [index])
+        loop = asyncio.get_running_loop()
+        t_end = loop.time() + duration
+        try:
+            while loop.time() < t_end:
+                if not victim.crashed:
+                    await self.network.churn_node(victim.node_id)
+                await asyncio.sleep(period)
+        finally:
+            self._window_close(f"flap-{index}")
 
     async def _crash_op(self, op) -> None:
         index = int(op.nodes[0])

@@ -22,6 +22,17 @@ Fault ops (docs/simnet.md has the full menu):
               dies mid-commit-sequence; restart_after_s relaunches it
               with WAL replay (negative = stay down)
   restart     restart a previously crashed node explicitly
+  flood       overload injection (remediation trigger): saturate the
+              verify queue signal of `nodes` (all honest when empty) at
+              `queue_depth` rows for `duration_s`, while the load
+              driver multiplies its offered rate by `load_multiplier`
+              — drives verify_queue_saturation -> mempool shedding
+  compile_storm  inject `cold_compiles` post-grace cold-compile growth
+              into `nodes` for `duration_s` (the cache-wipe signal) —
+              drives compile_storm -> rate-limited background re-warm
+  flap        churn one node's links: drop_node every `period_s` for
+              `duration_s` — drives peer_flap -> eviction + quarantine
+              on the peers dialing it
 
 Triggers: `at_height` fires when any honest live node commits that
 height; `at_s` is a wall offset from run start.  Ops apply in schedule
@@ -46,7 +57,11 @@ COMMIT_FAIL_LABELS = (
 )
 
 FAULT_OPS = ("partition", "heal", "slow", "clear", "isolate", "rejoin",
-             "crash", "restart")
+             "crash", "restart", "flood", "compile_storm", "flap")
+
+# remediation actions a scenario may expect (utils/remediate.ACTIONS;
+# kept literal here so the scenario schema stays import-light)
+REMEDIATION_ACTIONS = ("shed", "rewarm", "retune", "evict", "pardon")
 
 MISBEHAVIORS = (
     "double-prevote",
@@ -72,6 +87,12 @@ class FaultOp:
     fail_label: str = ""          # crash: target a labeled fail point
     fail_index: int = 0           # crash: index among matching calls
     restart_after_s: float = 1.0  # crash: relaunch delay (< 0 = stay down)
+    # remediation-trigger injections (flood / compile_storm / flap)
+    duration_s: float = 0.0       # how long the injection holds (0 = default)
+    queue_depth: int = 0          # flood: injected verify-queue rows
+    load_multiplier: float = 0.0  # flood: offered-load factor (0 = default 5x)
+    cold_compiles: int = 0        # compile_storm: injected cold-compile growth
+    period_s: float = 0.0         # flap: seconds between drops (0 = default)
 
     def validate(self, n_nodes: int) -> None:
         if self.op not in FAULT_OPS:
@@ -83,7 +104,7 @@ class FaultOp:
                 raise ValueError(f"fault op {self.op!r}: node {i} out of range")
         if self.op == "partition" and not self.nodes:
             raise ValueError("partition needs a minority node list")
-        if self.op in ("crash", "restart", "isolate", "rejoin") and \
+        if self.op in ("crash", "restart", "isolate", "rejoin", "flap") and \
                 len(self.nodes) != 1:
             raise ValueError(f"{self.op} targets exactly one node")
         if self.fail_label and self.fail_label not in COMMIT_FAIL_LABELS \
@@ -119,6 +140,12 @@ class Scenario:
                                   # ring + seeded chords (big nets flood
                                   # O(n^2) links all-to-all — real nets
                                   # don't run full mesh either)
+    # remediation actions the verdict must see fired at least once
+    # somewhere on the net (utils/remediate.py action names), plus the
+    # recovered-admission check: every node's shed level must be back
+    # at 0 by run end.  With TM_TPU_REMEDIATE=0 the same seeded
+    # scenario fails this block — the controller is load-bearing.
+    expect_remediation: list = field(default_factory=list)
 
     # -- derived ---------------------------------------------------------
     def total_slots(self) -> int:
@@ -170,6 +197,10 @@ class Scenario:
             for h, m in per_height.items():
                 if m not in MISBEHAVIORS:
                     raise ValueError(f"unknown misbehavior {m!r} at {h}")
+        for a in self.expect_remediation:
+            if a not in REMEDIATION_ACTIONS:
+                raise ValueError(f"unknown remediation action {a!r} "
+                                 f"(known: {REMEDIATION_ACTIONS})")
         for op in self.faults:
             op.validate(self.validators)
 

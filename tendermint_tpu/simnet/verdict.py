@@ -25,6 +25,12 @@ Invariants (each names itself in `violations` on failure):
                block, or the timeline's equivocation detector firing.
                Conversely, equivocation with NO maverick configured is a
                violation on its own (someone forged votes).
+  remediation  when the scenario sets `expect_remediation`, every named
+               action (shed/rewarm/retune/evict/pardon) fired at least
+               once somewhere on the net AND admission control is back
+               to normal by run end — the shed-and-survive contract.
+               Disabled controllers (TM_TPU_REMEDIATE=0) fail this
+               block outright.
 
 Beyond the invariants, the report carries the BENCH metrics (accepted
 tx/s, heights/min, rounds>0 streaks, recovery-after-heal) and — from the
@@ -164,6 +170,75 @@ def _recovery_after_heal(report: TimelineReport, run_info: dict) -> list[dict]:
     return out
 
 
+def _remediation_block(run_info: dict) -> dict:
+    """Per-node remediation summary from the runners' controller
+    reports (utils/remediate.py): action counts by kind, final shed
+    level (0 = admission recovered), and live quarantines — the
+    shed-and-survive evidence the overload scenarios assert."""
+    per_node: dict[str, dict] = {}
+    by_action: dict[str, int] = {}
+    enabled_any = False
+    recovered = True
+    for name, rep in sorted((run_info.get("remediation") or {}).items()):
+        if not rep.get("enabled"):
+            per_node[name] = {"enabled": False}
+            continue
+        enabled_any = True
+        per_node[name] = {
+            "enabled": True,
+            "actions": rep.get("actions_total", 0),
+            "by_action": rep.get("by_action", {}),
+            "shed_level": rep.get("shed_level", 0),
+            "quarantined_peers": rep.get("quarantined_peers", []),
+        }
+        for a, c in (rep.get("by_action") or {}).items():
+            by_action[a] = by_action.get(a, 0) + c
+        if rep.get("shed_level", 0) != 0:
+            recovered = False
+    return {
+        "enabled": enabled_any,
+        "per_node": per_node,
+        "by_action": dict(sorted(by_action.items())),
+        "actions_total": sum(by_action.values()),
+        "recovered_admission": recovered,
+    }
+
+
+def _check_remediation(scenario: Scenario, block: dict,
+                       violations: list[dict]) -> None:
+    """`expect_remediation` contract: every named action fired at least
+    once somewhere on the net, and admission recovered to normal by run
+    end.  With TM_TPU_REMEDIATE=0 the controllers report disabled and
+    the same seeded scenario fails here — proving the loop is
+    load-bearing, not decorative."""
+    expected = list(scenario.expect_remediation)
+    if not expected:
+        return
+    if not block["enabled"]:
+        violations.append({
+            "invariant": "remediation",
+            "detail": ("scenario expects remediation actions "
+                       f"{expected} but every controller is disabled "
+                       "(TM_TPU_REMEDIATE=0)"),
+        })
+        return
+    missing = [a for a in expected if block["by_action"].get(a, 0) == 0]
+    if missing:
+        violations.append({
+            "invariant": "remediation",
+            "detail": f"expected remediation action(s) never fired: "
+                      f"{missing} (saw {block['by_action']})",
+        })
+    if "shed" in expected and not block["recovered_admission"]:
+        stuck = [n for n, rep in block["per_node"].items()
+                 if rep.get("shed_level", 0)]
+        violations.append({
+            "invariant": "remediation",
+            "detail": f"admission control never recovered to normal on "
+                      f"{stuck} (shed level still set at run end)",
+        })
+
+
 def _health_block(run_info: dict) -> dict:
     """Per-node watchdog summary from the runners' HealthMonitor
     reports (utils/health.py): transition counts, critical counts split
@@ -297,6 +372,9 @@ def evaluate(scenario: Scenario, report: TimelineReport,
         else:
             streak = 0
 
+    remediation = _remediation_block(run_info)
+    _check_remediation(scenario, remediation, violations)
+
     health = _health_block(run_info)
     diagnosis = None
     if violations and health["first_critical"] is not None:
@@ -311,6 +389,7 @@ def evaluate(scenario: Scenario, report: TimelineReport,
         "violations": violations,
         "diagnosis": diagnosis,
         "health": health,
+        "remediation": remediation,
         "scenario": {
             "name": scenario.name,
             "seed": scenario.seed,

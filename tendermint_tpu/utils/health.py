@@ -426,14 +426,32 @@ class PeerFlapDetector(Detector):
 
 
 def default_detectors(expected_block_s: float = 1.0,
-                      queue_high_water: int = 512) -> list[Detector]:
+                      queue_high_water: int = 512,
+                      compile_grace_s: float | None = None,
+                      compile_window_s: float | None = None,
+                      flap_window_s: float | None = None,
+                      flap_min_span_s: float | None = None) -> list[Detector]:
+    """The six standard detectors.  The optional window overrides exist
+    for fast-cadence monitors (simnet's 0.25s sampling): the production
+    compile-storm grace (180s) and peer-flap minimum span (30s) would
+    otherwise mask any fault a test-scale run can inject."""
+    storm_kw = {}
+    if compile_grace_s is not None:
+        storm_kw["grace_s"] = compile_grace_s
+    if compile_window_s is not None:
+        storm_kw["window_s"] = compile_window_s
+    flap_kw = {}
+    if flap_window_s is not None:
+        flap_kw["window_s"] = flap_window_s
+    if flap_min_span_s is not None:
+        flap_kw["min_span_s"] = flap_min_span_s
     return [
         HeightStallDetector(expected_interval_s=expected_block_s),
         RoundThrashDetector(),
         QueueSaturationDetector(high_water=queue_high_water),
-        CompileStormDetector(),
+        CompileStormDetector(**storm_kw),
         MemoryGrowthDetector(),
-        PeerFlapDetector(),
+        PeerFlapDetector(**flap_kw),
     ]
 
 
@@ -590,6 +608,21 @@ class _NopJournal:
 _NOP_JOURNAL = _NopJournal()
 
 
+class _NopRemediate:
+    """Default transition sink: disabled.  The node/SimNode assigns a
+    real `utils/remediate.RemediationController` (defined there, not
+    here, so health carries no remediation imports); the monitor pays
+    one branch per TRANSITION when off."""
+
+    enabled = False
+
+    def act(self, tr: dict) -> None:
+        pass
+
+
+_NOP_REMEDIATE = _NopRemediate()
+
+
 class HealthMonitor:
     """One node's watchdog.  `enabled` is True so the one-branch guard
     at call sites passes; `NOP` is the disabled twin.
@@ -619,6 +652,10 @@ class HealthMonitor:
         self.interval_s = max(0.05, interval_s)
         self.journal = journal if journal is not None else _NOP_JOURNAL
         self.recorder = recorder
+        # remediation sink (utils/remediate.py): the node assigns its
+        # RemediationController after construction; transitions flow
+        # through `.act()` under the one-branch guard below
+        self.remediate = _NOP_REMEDIATE
         self.fault_grace_s = fault_grace_s
         self._clock = clock
         self._lock = threading.Lock()
@@ -704,8 +741,20 @@ class HealthMonitor:
             self.samples += 1
             self._history.append({k: v for k, v in s.items()
                                   if k != "probe_errors"})
-        # journal + forensics OUTSIDE the lock: the recorder snapshots
-        # report() (which takes the lock), and journal writes are I/O
+            # steady re-delivery while unhealthy: a detector that STAYS
+            # at warn/critical produces no transition, but remediations
+            # are reconcilers (idempotent shed, rate-limited rewarm,
+            # quarantine-deduped evict) — the controller must keep
+            # seeing the live level so e.g. a flap score that crosses
+            # its threshold AFTER the escalation still gets acted on
+            steady: list[tuple[str, int]] = []
+            if self.remediate.enabled:
+                fired_names = {d.name for d, _tr in fired}
+                steady = [(d.name, d.level) for d in self.detectors
+                          if d.level > OK and d.name not in fired_names]
+        # journal + remediation + forensics OUTSIDE the lock: the
+        # recorder snapshots report() (which takes the lock), journal
+        # writes are I/O, and remediations call into other subsystems
         for d, tr in fired:
             if self.journal.enabled:
                 ev = ("health_critical" if tr["to"] == CRITICAL
@@ -715,9 +764,24 @@ class HealthMonitor:
                                  prev=LEVEL_NAMES[tr["from"]],
                                  detail=tr["detail"],
                                  excused=tr["excused"])
+            if self.remediate.enabled:
+                try:
+                    self.remediate.act(tr)
+                except Exception as e:  # noqa: BLE001 — watchdog survives
+                    _log.warning("remediation act failed: %r", e)
             if (tr["to"] == CRITICAL and tr["from"] < CRITICAL
                     and self.recorder is not None):
                 tr["bundle"] = self.recorder.record(self, d, transition=tr)
+        if self.remediate.enabled:
+            for name, level in steady:
+                try:
+                    self.remediate.act({
+                        "detector": name, "from": level, "to": level,
+                        "detail": "", "excused": s["in_fault_window"],
+                        "steady": True,
+                    })
+                except Exception as e:  # noqa: BLE001 — watchdog survives
+                    _log.warning("remediation act failed: %r", e)
         return s
 
     # -- lifecycle ------------------------------------------------------
@@ -888,7 +952,12 @@ NOP = _NopMonitor()
 def from_env(node: str = "", root: str = "", probes: dict | None = None,
              journal=None, journal_path: str = "",
              expected_block_s: float = 1.0,
-             interval_s: float | None = None) -> "HealthMonitor | _NopMonitor":
+             interval_s: float | None = None,
+             compile_grace_s: float | None = None,
+             compile_window_s: float | None = None,
+             flap_window_s: float | None = None,
+             flap_min_span_s: float | None = None,
+             ) -> "HealthMonitor | _NopMonitor":
     """Build a monitor per TM_TPU_HEALTH (default ON), or return the NOP
     singleton when disabled.  `root` hosts the flight-recorder bundles
     (`<root>/health/`); no root = no recorder (pure in-memory monitor)."""
@@ -933,7 +1002,11 @@ def from_env(node: str = "", root: str = "", probes: dict | None = None,
         node=node,
         probes=all_probes,
         detectors=default_detectors(expected_block_s=expected,
-                                    queue_high_water=queue_hw),
+                                    queue_high_water=queue_hw,
+                                    compile_grace_s=compile_grace_s,
+                                    compile_window_s=compile_window_s,
+                                    flap_window_s=flap_window_s,
+                                    flap_min_span_s=flap_min_span_s),
         interval_s=interval,
         journal=journal,
         recorder=recorder,

@@ -5,10 +5,11 @@ from tendermint_tpu.utils import devmon
 
 
 class Site:
-    def __init__(self, journal, lifecycle, health):
+    def __init__(self, journal, lifecycle, health, remediate):
         self.journal = journal
         self.lifecycle = lifecycle
         self.health = health
+        self.remediate = remediate
         self.replay_mode = False
 
     def flush_ungated(self, n, rung):
@@ -32,6 +33,28 @@ class Site:
 
     def record_ungated_upper(self, HEALTH):
         HEALTH.record("restart", 1)  # LINT: ungated-observability
+
+    def act_ungated(self, tr):
+        self.remediate.act(tr)  # LINT: ungated-observability
+
+    def remediate_record_ungated(self):
+        self.remediate.record("shed", 1)  # LINT: ungated-observability
+
+    def act_ungated_upper(self, REMEDIATE, tr):
+        REMEDIATE.act(tr)  # LINT: ungated-observability
+
+    def act_gated(self, tr):
+        if self.remediate.enabled:
+            self.remediate.act(tr)
+
+    def remediate_record_early_exit(self):
+        if not self.remediate.enabled:
+            return
+        self.remediate.record("shed", 1)
+
+    def act_other_receiver(self, parser, tr):
+        # parser.act is not a remediation sink: no finding
+        return parser.act(tr)
 
     def sample_gated(self):
         if self.health.enabled:
