@@ -45,6 +45,10 @@ DEFAULT_THRESHOLDS = {
     "timing": 0.25,
     "count": 0.25,
     "boolean": 0.0,
+    # per-row HLO resource costs (round 9): deterministic functions of
+    # the compiled representation, so even a small rise means the
+    # program's shape actually regressed — tighter than latency
+    "resource": 0.05,
 }
 
 # Keys that describe the run rather than measure it.
@@ -54,7 +58,7 @@ META_KEYS = {
     "tail", "note", "warmstart_rung", "async_streams",
     "async_stream_rounds", "simnet_nodes", "simnet_validator_slots",
     "benchdiff_base", "benchdiff_regressions", "benchdiff_missing",
-    "benchdiff_ok",
+    "benchdiff_ok", "shootout_rung", "shootout_n", "shootout_runs",
 }
 
 # Ordered (pattern, class, direction) — first match wins.  direction
@@ -66,6 +70,7 @@ _CLASS_RULES = (
     (re.compile(r"(_ok|_within_budget|_warmed|plan_warmed)$"),
      "boolean", "higher"),
     (re.compile(r"(_p50_ms|_ms)$"), "latency", "lower"),
+    (re.compile(r"(_bytes_per_row|_flops_per_row)$"), "resource", "lower"),
     (re.compile(r"(_ns_per_event|_us_per_event|_ns_per_flush"
                 r"|_us_per_flush|_ns_per_stamp|_us_per_stamp"
                 r"|_ns_per_sample|_us_per_sample"

@@ -25,8 +25,13 @@ by:
 
 Selection flags (`--rungs/--impls/--kinds`) mirror `tendermint-tpu
 warm`; the default is the ACTIVE shape plan, so a consolidated-plan
-deployment profiles exactly the programs it runs.  Exit codes follow
-the house contract: 0 = every entry reported, 1 = some entries errored,
+deployment profiles exactly the programs it runs.  With 2+ impls
+selected (`--impls int64,packed,f32`) the output ends with a
+side-by-side **impl comparison table** — per (kind, rung): HLO
+bytes/row, FLOPs, wall p50 and sigs/s per impl plus ratios against the
+first impl — so a representation round (ISSUE 12) steers from one
+profile invocation instead of a bench re-run.  Exit codes follow the
+house contract: 0 = every entry reported, 1 = some entries errored,
 2 = usage error.
 """
 
@@ -273,12 +278,14 @@ def run_profile(*, rungs: str = "", impls: str = "", kinds: str = "",
             if peak:
                 row["flops_utilization"] = achieved / peak
 
+    comparison = impl_comparison(rows)
     report = {
         "plan": plan.to_dict(),
         "peak_flops_per_s": peak,
         "budget_s": budget,
         "cost_only": not run_windows,
         "entries": rows,
+        "impl_comparison": comparison,
         "errors": errors,
     }
     report.update(backend_info())
@@ -310,9 +317,82 @@ def run_profile(*, rungs: str = "", impls: str = "", kinds: str = "",
             f"{_fmt(r.get('sigs_per_sec'), '{:.0f}'):>10} "
             f"{_fmt(r.get('flops_utilization'), '{:.2%}'):>7} "
             f"{_fmt(r.get('occupancy'), '{:.2f}'):>6}")
+    for line in render_impl_comparison(comparison):
+        print(line)
     for e in errors:
         print(f"! {e}", file=sys.stderr)
     return 1 if failed else 0
+
+
+def impl_comparison(rows: list) -> list:
+    """Side-by-side per-(kind, rung) impl comparison — present only when
+    2+ impls produced rows for the same program shape.  The baseline is
+    the first impl in selection order; every other impl carries
+    bytes/FLOPs ratios and a sigs/s speedup against it, so a round can
+    steer the representation (ISSUE 12) from one `profile --impls`
+    invocation instead of re-running bench."""
+    by: dict = {}
+    order: list = []
+    for r in rows:
+        if r.get("error"):
+            continue
+        by.setdefault((r["kind"], r["rung"]), {})[r["impl"]] = r
+        if r["impl"] not in order:
+            order.append(r["impl"])
+    if len(order) < 2:
+        return []
+    out = []
+    for (kind, rung), impls in sorted(by.items()):
+        if len(impls) < 2:
+            continue
+        base = impls.get(order[0])
+        row = {"kind": kind, "rung": rung, "baseline": order[0], "impls": {}}
+        for impl in order:
+            r = impls.get(impl)
+            if r is None:
+                continue
+            cell = {
+                "hlo_bytes_per_row": r.get("hlo_bytes_per_row"),
+                "flops": r.get("flops"),
+                "wall_p50_ms": r.get("wall_p50_ms"),
+                "sigs_per_sec": r.get("sigs_per_sec"),
+            }
+            if base is not None and impl != order[0]:
+                b, v = base.get("hlo_bytes_per_row"), cell["hlo_bytes_per_row"]
+                if b and v:
+                    cell["bytes_ratio"] = round(v / b, 3)
+                b, v = base.get("flops"), cell["flops"]
+                if b and v:
+                    cell["flops_ratio"] = round(v / b, 3)
+                b, v = base.get("sigs_per_sec"), cell["sigs_per_sec"]
+                if b and v:
+                    cell["speedup"] = round(v / b, 3)
+            row["impls"][impl] = cell
+        out.append(row)
+    return out
+
+
+def render_impl_comparison(comparison: list) -> list[str]:
+    """Text table for the side-by-side block (one line per impl per
+    program shape; ratio columns are vs the baseline impl)."""
+    if not comparison:
+        return []
+    base = comparison[0]["baseline"]
+    lines = [f"impl comparison (baseline {base}):",
+             (f"{'kind':>8} {'rung':>6} {'impl':>6} {'B/row':>9} "
+              f"{'flops':>10} {'wall p50':>10} {'sigs/s':>10} "
+              f"{'B/row x':>8} {'sigs/s x':>9}")]
+    for row in comparison:
+        for impl, cell in row["impls"].items():
+            lines.append(
+                f"{row['kind']:>8} {row['rung']:>6} {impl:>6} "
+                f"{_fmt(cell.get('hlo_bytes_per_row')):>9} "
+                f"{_fmt(cell.get('flops')):>10} "
+                f"{_fmt(cell.get('wall_p50_ms'), '{:.2f}ms'):>10} "
+                f"{_fmt(cell.get('sigs_per_sec'), '{:.0f}'):>10} "
+                f"{_fmt(cell.get('bytes_ratio'), '{:.2f}x'):>8} "
+                f"{_fmt(cell.get('speedup'), '{:.2f}x'):>9}")
+    return lines
 
 
 def _live_occupancy() -> dict:

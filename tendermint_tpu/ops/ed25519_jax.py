@@ -23,12 +23,24 @@ Note: -[k]A is computed as [k](-A), never as [L-k]A — the latter is wrong for
 points with a torsion component (L·A ≠ O), exactly the inputs ZIP-215 admits.
 
 Field backends (TM_TPU_FIELD_IMPL, or the `impl=` argument):
-  * "int64" — 15 limbs × 17 bits in int64 lanes (fe25519.py).  Numerically
-    densest, but TPU VPUs emulate int64; ideal on XLA-CPU.
-  * "f32"   — 51 limbs × 5 bits in f32 lanes (fe25519_f32.py).  Every op is
-    a native float multiply/add/floor — the round-3 TPU datapath redesign.
-The curve/scalar pipeline below is field-agnostic; both backends share it and
-both are differentially tested against the pure ZIP-215 reference.
+  * "int64"  — 15 limbs × 17 bits in int64 lanes (fe25519.py).  The
+    historical default; ideal bit-density for a 64-bit integer machine
+    but ~47 dead bits per lane of HLO traffic.
+  * "packed" — 10 limbs at the mixed radix 25.5 in int64 lanes
+    (fe25519_packed.py, round 9).  Same integer datapath, 33% fewer
+    bytes per limb tensor and ~2.2x fewer limb products — the
+    representation attack on the PR 8 roofline (AI ≈ 0.03 FLOP/B:
+    the limb encoding IS the traffic).
+  * "f32"    — 51 limbs × 5 bits in f32 lanes (fe25519_f32.py).  Every op
+    is a native float multiply/add/floor — the round-3 TPU datapath
+    redesign; with TM_TPU_FE_MXU its fe_mul contracts on the MXU.
+TM_TPU_FIELD_IMPL also accepts "auto" (the default since round 9):
+XLA-CPU resolves to "int64" with no golden run (tier-1 warm cache keys
+stay bit-identical); TPU/GPU backends run the golden differential check
+once at startup and promote the fastest impl that validates — f32 with
+MXU where the MXU is exact, else packed, else int64 (see default_impl).
+The curve/scalar pipeline below is field-agnostic; all backends share it
+and all are differentially tested against the pure ZIP-215 reference.
 
 Static batch sizes: inputs are padded to a bucket ladder — the ACTIVE
 shape plan (ops/shape_plan.py; default: the formula ladder of powers of
@@ -65,17 +77,53 @@ SCALAR_BITS = 253  # s, k < L < 2^253
 
 NWINDOWS = 64  # 253-bit scalars as 64 little-endian radix-16 digits
 
-IMPLS = ("int64", "f32")
+IMPLS = ("int64", "f32", "packed")
+
+# TM_TPU_FIELD_IMPL=auto resolution, memoized per process (the
+# TM_TPU_DONATE=auto idiom): None = not yet resolved.  Resolved lazily at
+# the first dispatch, never at import (tmlint import-time-env), and only
+# on non-cpu backends does resolution run golden checks / compiles —
+# XLA-CPU short-circuits to "int64" so tier-1 runs trace the exact same
+# programs (bit-identical warm cache keys) as before the auto default.
+_AUTO_IMPL: str | None = None
 
 
 def default_impl() -> str:
-    impl = os.environ.get("TM_TPU_FIELD_IMPL", "int64")
-    return impl if impl in IMPLS else "int64"
+    impl = os.environ.get("TM_TPU_FIELD_IMPL", "auto")
+    if impl in IMPLS:
+        return impl
+    global _AUTO_IMPL
+    if _AUTO_IMPL is None:
+        _AUTO_IMPL = _resolve_auto_impl()
+    return _AUTO_IMPL
+
+
+def _resolve_auto_impl() -> str:
+    """The "auto" field impl for this process's backend.  cpu: int64,
+    immediately (no golden run, no new compiles — the tier-1 contract).
+    TPU/GPU: the fastest representation that reproduces the golden
+    verdicts on THIS device — f32 with its MXU fe_mul where the matmul
+    is exact (hardware-refuted on the r04 TPU, so never trusted without
+    the check), else the packed int64 layout, else the historical int64
+    layout as the unconditional fallback."""
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no usable backend: stay safe
+        backend = "cpu"
+    if backend == "cpu":
+        return "int64"
+    if _field("f32")._use_mxu() and _optin_safe("fe_mxu", "f32"):
+        return "f32"
+    if _optin_safe("impl", "packed"):
+        return "packed"
+    return "int64"
 
 
 def _field(impl: str):
     if impl == "f32":
         from . import fe25519_f32 as m
+    elif impl == "packed":
+        from . import fe25519_packed as m
     else:
         from . import fe25519 as m
     return m
@@ -133,7 +181,12 @@ class _Core:
 
     def __init__(self, fe):
         self.fe = fe
-        self._limb_weights = (1 << np.arange(fe.LIMB_BITS, dtype=np.int64))
+        # mixed-radix backends (packed) provide their own bits→limbs map;
+        # uniform-width backends keep the reshape path below unchanged
+        # (same traced ops, same persistent-cache keys)
+        self._limbs_of_bits = getattr(fe, "limbs_of_bits", None)
+        if self._limbs_of_bits is None:
+            self._limb_weights = (1 << np.arange(fe.LIMB_BITS, dtype=np.int64))
 
     # -- unpacking -----------------------------------------------------------
 
@@ -153,6 +206,8 @@ class _Core:
     def _limbs_of(self, bits255: jnp.ndarray) -> jnp.ndarray:
         """[..., 255] bits → [..., NLIMBS] limbs, on device."""
         fe = self.fe
+        if self._limbs_of_bits is not None:
+            return self._limbs_of_bits(bits255)
         shaped = bits255.reshape(bits255.shape[:-1] + (fe.NLIMBS, fe.LIMB_BITS))
         w = jnp.asarray(self._limb_weights, dtype=jnp.asarray(fe.ONE).dtype)
         return (shaped.astype(w.dtype) * w).sum(-1)
@@ -256,8 +311,10 @@ class _Core:
     def _fixed_base_tables256(self) -> np.ndarray:
         """The w=8 comb table as ONE [32, 256, 4*NLIMBS] float32 tensor
         (limb values in this backend's radix; int64-backend limbs < 2^18
-        and f32-backend limbs < 2^5 are both f32-exact).  numpy, not
-        jnp — converted per-trace like _fixed_base_tables."""
+        and f32-backend limbs < 2^5 are both f32-exact — the packed
+        backend's 26-bit limbs are NOT, which is why _resolve_optin
+        never routes base_mxu to it).  numpy, not jnp — converted
+        per-trace like _fixed_base_tables."""
         fe = self.fe
         out = np.zeros((32, 256, 4 * fe.NLIMBS), dtype=np.float32)
         for i, row in enumerate(_base_point_table256()):
@@ -547,10 +604,14 @@ def donate_rows() -> bool:
 
 
 def reload_env() -> None:
-    """Drop lazily-resolved env state (TM_TPU_DONATE) so the next call
-    re-reads the environment — same contract as crypto.batch.reload_env."""
-    global _DONATE
+    """Drop lazily-resolved env state (TM_TPU_DONATE, the
+    TM_TPU_FIELD_IMPL=auto resolution) so the next call re-reads the
+    environment — same contract as crypto.batch.reload_env.  Does NOT
+    clear _OPTIN_STATE: golden verdicts are per-process facts about the
+    backend, not configuration (tests reset them via monkeypatch)."""
+    global _DONATE, _AUTO_IMPL
     _DONATE = None
+    _AUTO_IMPL = None
 
 
 def _jit_for(kind: str, impl: str, *, base_mxu: bool = False,
@@ -842,7 +903,10 @@ def _golden_batch():
 def _optin_safe(flag: str, impl: str) -> bool:
     """True iff the opt-in kernel `flag` reproduces the golden verdicts
     for `impl` on the current backend.  Memoized per process; a mismatch
-    warns and pins False (the caller falls back to the standard path)."""
+    warns and pins False (the caller falls back to the standard path).
+    flag "impl" gates a whole field backend (the auto-promotion path:
+    the golden batch runs through the candidate impl's standard
+    program), "base_mxu"/"fe_mxu" gate the opt-in kernels within one."""
     key = (flag, impl)
     if key in _OPTIN_STATE:
         return _OPTIN_STATE[key]
@@ -852,7 +916,8 @@ def _optin_safe(flag: str, impl: str) -> bool:
         inputs, want = _golden_batch()
         if flag == "base_mxu":
             got = _compiled(8, impl, True)(*inputs)
-        else:  # fe_mxu — the flag lives inside the f32 field backend
+        else:  # fe_mxu lives inside the f32 backend; "impl" is the
+            # candidate backend's own standard program
             got = _compiled(8, impl)(*inputs)
         ok = [bool(v) for v in np.asarray(got)] == want
     except Exception as e:  # noqa: BLE001 — a crash is also a refusal
@@ -887,7 +952,10 @@ def _resolve_optin(impl: str) -> bool:
     """Gate the opt-in kernel flags for a production dispatch; returns
     the base_mxu trace flag to compile with."""
     base_mxu = False
-    if _base_mxu_requested():
+    if _base_mxu_requested() and impl != "packed":
+        # packed limbs (< 2^26) exceed the f32-exact ceiling the one-hot
+        # comb's float table depends on — structurally wrong, not merely
+        # unvalidated, so the golden gate is never even consulted
         base_mxu = _optin_safe("base_mxu", impl)
     if impl == "f32" and _field("f32")._use_mxu():
         _optin_safe("fe_mxu", impl)  # flips the module flag on mismatch

@@ -143,16 +143,32 @@ def _mul_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 # None = not yet resolved: TM_TPU_FE_MXU is read lazily at the first
 # fe_mul (not at import — tmlint import-time-env), so tests/operators
-# can still flip it after this module loads.  ed25519_jax's golden
-# self-check pins it False on a backend that miscomputes; tests pin it
-# with monkeypatch.setattr.
+# can still flip it after this module loads.  Round 9 promoted the flag
+# from opt-in to "auto" (the TM_TPU_DONATE=auto idiom): "1" forces on,
+# "0" forces off, and the default "auto" turns the MXU formulation on
+# wherever a real accelerator backend is driving — EXCEPT that
+# production dispatches still run ed25519_jax's golden self-check once
+# per process and pin the flag False on any backend whose
+# Precision.HIGHEST matmul is not exact (measured wrong on the r04
+# TPU), so auto-on is always auto-validated before a verdict ships.
+# XLA-CPU resolves auto to False: tier-1 traces (and their persistent
+# compile-cache keys) are bit-identical to the pre-auto default.
 _USE_MXU: bool | None = None
 
 
 def _use_mxu() -> bool:
     global _USE_MXU
     if _USE_MXU is None:
-        _USE_MXU = os.environ.get("TM_TPU_FE_MXU", "0") == "1"
+        mode = os.environ.get("TM_TPU_FE_MXU", "auto")
+        if mode == "1":
+            _USE_MXU = True
+        elif mode == "0":
+            _USE_MXU = False
+        else:
+            try:
+                _USE_MXU = jax.default_backend() != "cpu"
+            except Exception:  # noqa: BLE001 — no backend: nothing to gain
+                _USE_MXU = False
     return _USE_MXU
 
 

@@ -309,8 +309,25 @@ def plan_for_warm(device_stats: dict | None = None) -> ShapePlan:
     """The plan `tendermint-tpu warm` compiles when none is named: an
     explicit env/saved plan wins (warm refreshes its artifacts);
     otherwise the consolidated ladder — warming is the opt-in moment
-    where the fewer-larger-rungs tradeoff is taken."""
-    return _resolve_explicit_plan() or consolidated_plan(device_stats)
+    where the fewer-larger-rungs tradeoff is taken.
+
+    Round 9: warming is also where the auto-promoted field impl
+    (TM_TPU_FIELD_IMPL=auto — f32+MXU / packed where the golden check
+    validates them) becomes operational, so the resolved default impl is
+    folded into the implicit plan and the AOT sweep compiles exactly the
+    programs production dispatch will run.  XLA-CPU resolves to int64:
+    the warm grid there is unchanged."""
+    explicit = _resolve_explicit_plan()
+    if explicit is not None:
+        return explicit
+    plan = consolidated_plan(device_stats)
+    from tendermint_tpu.ops import ed25519_jax as dev
+
+    impl = dev.default_impl()
+    if impl not in plan.impls:
+        plan = ShapePlan(plan.rungs, impls=(impl,) + plan.impls,
+                         kinds=plan.kinds, name=plan.name)
+    return plan
 
 
 # ---------------------------------------------------------------------------

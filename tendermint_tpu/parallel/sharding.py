@@ -46,7 +46,11 @@ def sharded_verify_fn(mesh: Mesh):
     per input shape under it."""
     batch = NamedSharding(mesh, P("batch"))
     batch2 = NamedSharding(mesh, P("batch", None))
-    # (pub_rows, r_rows, s_rows, k_rows, valid) — packed [N,32] u8 + bool[N]
+    # (pub_rows, r_rows, s_rows, k_rows, valid) — packed [N,32] u8 + bool[N].
+    # The field impl inside _verify_core resolves per trace via
+    # default_impl() — TM_TPU_FIELD_IMPL=auto (round 9) lands the
+    # golden-validated impl (f32+MXU / packed / int64) here too, and the
+    # devmon label below records which one this mesh program traced.
     in_sh = (batch2, batch2, batch2, batch2, batch)
     # donated row buffers, same policy as the single-chip entry points
     # (ops.ed25519_jax.donate_rows — off on XLA-CPU so cache keys and

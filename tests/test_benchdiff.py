@@ -63,12 +63,17 @@ def test_normalize_results_list_shape():
 def test_normalize_checked_in_artifacts_all_shapes():
     # every checked-in round (and the baseline) normalizes without error
     for name in ("BENCH_BASELINE.json", "BENCH_r01.json", "BENCH_r02.json",
-                 "BENCH_r03.json", "BENCH_r04.json", "BENCH_r05.json"):
+                 "BENCH_r03.json", "BENCH_r04.json", "BENCH_r05.json",
+                 "BENCH_r06.json"):
         metrics, _meta = normalize(_artifact(name))
         assert isinstance(metrics, dict), name
     # r01 crashed pre-emit; r02+ carry a headline value
     assert normalize(_artifact("BENCH_r01.json"))[0] == {}
     assert normalize(_artifact("BENCH_r05.json"))[0]["value"] == 36877.4
+    # r06 (the round-9 representation round) carries the shootout keys
+    r06 = normalize(_artifact("BENCH_r06.json"))[0]
+    assert r06["shootout_packed_hlo_bytes_per_row"] < \
+        r06["shootout_int64_hlo_bytes_per_row"]
 
 
 # ---------------------------------------------------------------------------
@@ -103,9 +108,38 @@ def test_normalize_checked_in_artifacts_all_shapes():
     ("devstats_within_budget", "boolean", "higher"),
     ("simnet_max_round", None, None),          # informational
     ("commit10k_chunk_plan", None, None),
+    # impl-shootout stage (ISSUE 12): per-impl sigs/s land in the 3%
+    # throughput gate; per-row HLO resource costs are the 5% resource
+    # class — a representation regression in ANY impl is flagged
+    ("shootout_packed_sigs_per_sec", "throughput", "higher"),
+    ("shootout_int64_sigs_per_sec", "throughput", "higher"),
+    ("shootout_f32_sigs_per_sec", "throughput", "higher"),
+    ("shootout_packed_hlo_bytes_per_row", "resource", "lower"),
+    ("shootout_int64_flops_per_row", "resource", "lower"),
+    ("shootout_packed_wall_p50_ms", "latency", "lower"),
 ])
 def test_classify_matrix(key, cls, direction):
     assert classify(key) == (cls, direction)
+
+
+def test_resource_class_threshold_is_tight():
+    """A 6% bytes/row rise is a regression (5% resource gate); 4% is ok;
+    a drop is an improvement."""
+    a = {"shootout_packed_hlo_bytes_per_row": 1000.0}
+    rep = diff(a, {"shootout_packed_hlo_bytes_per_row": 1060.0})
+    assert rep["regressions"] == ["shootout_packed_hlo_bytes_per_row"]
+    rep = diff(a, {"shootout_packed_hlo_bytes_per_row": 1040.0})
+    assert rep["ok"] and rep["rows"][0]["status"] == "ok"
+    rep = diff(a, {"shootout_packed_hlo_bytes_per_row": 660.0})
+    assert rep["rows"][0]["status"] == "improvement"
+
+
+def test_shootout_meta_keys_not_tracked():
+    rep = diff({"shootout_rung": 1024, "shootout_n": 1024,
+                "shootout_runs": 3},
+               {"shootout_rung": 2048, "shootout_n": 2048,
+                "shootout_runs": 2})
+    assert rep["rows"] == [] and rep["ok"]
 
 
 # ---------------------------------------------------------------------------
@@ -274,5 +308,5 @@ def test_latest_artifact_picks_highest_round(tmp_path):
         (tmp_path / name).write_text("{}")
     assert latest_artifact(str(tmp_path)).endswith("BENCH_r10.json")
     assert latest_artifact(str(tmp_path / "missing-dir")) is None
-    # the real repo: r05 is the newest checked-in round
-    assert latest_artifact(REPO).endswith("BENCH_r05.json")
+    # the real repo: r06 is the newest checked-in round
+    assert latest_artifact(REPO).endswith("BENCH_r06.json")

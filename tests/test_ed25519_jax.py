@@ -252,6 +252,12 @@ def test_scalarmul_base_mxu_matches_tree_and_reference():
         np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in svals
     ])
     for impl in dev.IMPLS:
+        if impl == "packed":
+            # the comb's f32 constant table cannot hold 26-bit packed
+            # limbs exactly — structurally incompatible, and
+            # _resolve_optin never routes base_mxu to it (pinned in
+            # test_optin_golden.test_base_mxu_never_consulted_for_packed)
+            continue
         core = dev._Core(dev._field(impl))
         f = core.fe
         s_rows = jnp.asarray(s_rows_np)

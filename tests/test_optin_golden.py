@@ -145,3 +145,143 @@ def test_golden_standard_program_tier1():
     inputs, want = dev._golden_batch()
     got = [bool(v) for v in np.asarray(dev._compiled(8, "int64")(*inputs))]
     assert got == want
+
+
+def test_golden_packed_program_tier1():
+    """Round-9 twin of the check above for the PACKED limb layout
+    (ISSUE 12): golden parity on the warm n=8 floor rung — the program
+    the auto-promotion golden gate runs, persistent-cached, so tier-1
+    pays no novel-HLO relay compile."""
+    inputs, want = dev._golden_batch()
+    got = [bool(v) for v in np.asarray(dev._compiled(8, "packed")(*inputs))]
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# TM_TPU_FIELD_IMPL=auto resolution (round 9: MXU/packed promotion)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def clean_auto(monkeypatch):
+    monkeypatch.setattr(dev, "_AUTO_IMPL", None)
+    monkeypatch.setattr(dev, "_OPTIN_STATE", {})
+    monkeypatch.delenv("TM_TPU_FIELD_IMPL", raising=False)
+    yield
+
+
+def test_auto_impl_is_int64_on_cpu_without_golden_run(clean_auto):
+    """The tier-1 contract: on XLA-CPU the auto default short-circuits
+    to int64 with NO golden run (no compiles, no _OPTIN_STATE entries),
+    so warm cache keys are bit-identical to the pre-auto default."""
+    assert dev.default_impl() == "int64"
+    assert dev._OPTIN_STATE == {}
+
+
+def test_explicit_impl_bypasses_auto(clean_auto, monkeypatch):
+    monkeypatch.setenv("TM_TPU_FIELD_IMPL", "packed")
+    assert dev.default_impl() == "packed"
+    monkeypatch.setenv("TM_TPU_FIELD_IMPL", "f32")
+    assert dev.default_impl() == "f32"
+    # unknown values fall into the auto path, not a crash
+    monkeypatch.setenv("TM_TPU_FIELD_IMPL", "bogus")
+    assert dev.default_impl() == "int64"
+
+
+def test_auto_impl_promotion_order_on_device(clean_auto, monkeypatch):
+    """On a non-cpu backend auto prefers f32+MXU where the golden check
+    validates it, else packed where IT validates, else int64 — with the
+    golden gate stubbed so no device program compiles here."""
+    import jax as _jax
+
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    fe32 = dev._field("f32")
+    monkeypatch.setattr(fe32, "_USE_MXU", True)
+
+    monkeypatch.setattr(dev, "_optin_safe", lambda flag, impl: True)
+    assert dev.default_impl() == "f32"
+
+    monkeypatch.setattr(dev, "_AUTO_IMPL", None)
+    monkeypatch.setattr(dev, "_optin_safe",
+                        lambda flag, impl: impl == "packed")
+    assert dev.default_impl() == "packed"
+
+    monkeypatch.setattr(dev, "_AUTO_IMPL", None)
+    monkeypatch.setattr(dev, "_optin_safe", lambda flag, impl: False)
+    assert dev.default_impl() == "int64"
+
+    # MXU off (TM_TPU_FE_MXU=0 on device): f32 is not auto-chosen even
+    # when every golden check would pass
+    monkeypatch.setattr(dev, "_AUTO_IMPL", None)
+    monkeypatch.setattr(fe32, "_USE_MXU", False)
+    monkeypatch.setattr(dev, "_optin_safe", lambda flag, impl: True)
+    assert dev.default_impl() == "packed"
+
+
+def test_auto_impl_memoized_and_reload_env_clears(clean_auto, monkeypatch):
+    import jax as _jax
+
+    calls = []
+
+    def fake_backend():
+        calls.append(1)
+        return "cpu"
+
+    monkeypatch.setattr(_jax, "default_backend", fake_backend)
+    assert dev.default_impl() == "int64"
+    assert dev.default_impl() == "int64"
+    assert len(calls) == 1  # memoized after the first resolution
+    dev.reload_env()
+    assert dev.default_impl() == "int64"
+    assert len(calls) == 2  # reload_env dropped the memo
+
+
+def test_fe_mxu_auto_resolves_off_on_cpu(monkeypatch):
+    """TM_TPU_FE_MXU's new default 'auto' must resolve False on XLA-CPU
+    (bit-identical tier-1 traces) and re-resolve after reload_env."""
+    fe32 = dev._field("f32")
+    monkeypatch.delenv("TM_TPU_FE_MXU", raising=False)
+    monkeypatch.setattr(fe32, "_USE_MXU", None)
+    assert fe32._use_mxu() is False
+    monkeypatch.setenv("TM_TPU_FE_MXU", "1")
+    assert fe32._use_mxu() is False  # cached until reload_env
+    fe32.reload_env()
+    assert fe32._use_mxu() is True
+    monkeypatch.setenv("TM_TPU_FE_MXU", "auto")
+    fe32.reload_env()
+    import jax as _jax
+
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    assert fe32._use_mxu() is True  # auto turns on off-cpu (golden-gated
+    fe32.reload_env()              # downstream by _resolve_optin)
+
+
+def test_base_mxu_never_consulted_for_packed(clean_auto, monkeypatch):
+    """The one-hot comb's f32 table cannot hold 26-bit packed limbs
+    exactly: _resolve_optin must skip the base_mxu gate entirely for the
+    packed impl (structurally wrong, not merely unvalidated)."""
+    monkeypatch.setenv("TM_TPU_BASE_MXU", "1")
+    assert dev._resolve_optin("packed") is False
+    assert ("base_mxu", "packed") not in dev._OPTIN_STATE
+
+
+def test_plan_for_warm_folds_auto_impl(monkeypatch, tmp_path):
+    """The warm story carries the promotion: plan_for_warm's implicit
+    consolidated plan includes the resolved default impl (int64 on cpu —
+    unchanged; a promoted impl is prepended off-cpu)."""
+    from tendermint_tpu.ops import shape_plan
+
+    monkeypatch.setenv("TM_BENCH_CACHE", str(tmp_path))  # no saved plan
+    monkeypatch.delenv("TM_TPU_RUNGS", raising=False)
+    monkeypatch.delenv("TM_TPU_SHAPE_PLAN", raising=False)
+    assert plan_impls_with(monkeypatch, shape_plan, "int64") == ("int64",)
+    assert plan_impls_with(monkeypatch, shape_plan, "packed") == (
+        "packed", "int64")
+
+
+def plan_impls_with(monkeypatch, shape_plan, impl: str):
+    monkeypatch.setattr(dev, "default_impl", lambda: impl)
+    return plan_impls(shape_plan)
+
+
+def plan_impls(shape_plan):
+    return shape_plan.plan_for_warm().impls

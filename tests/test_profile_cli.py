@@ -156,6 +156,74 @@ def test_profile_usage_error_on_malformed_rungs(capsys):
     capsys.readouterr()
 
 
+def test_profile_impl_comparison_json(stubbed, capsys, monkeypatch):
+    """--impls with 2+ backends produces the side-by-side block (ISSUE
+    12 satellite): per (kind, rung) one cell per impl, ratio columns vs
+    the first impl in selection order."""
+    def fake_harvest(kind, rung, impl):
+        scale = {"int64": 1.0, "packed": 0.5}[impl]
+        return {"kind": kind, "rung": rung, "impl": impl,
+                "flops": 1000.0 * rung * scale,
+                "bytes_accessed": 4000.0 * rung * scale,
+                "source": "lowered"}
+
+    def fake_timed(kind, rung, impl, *, runs, deadline):
+        wall = 0.002 if impl == "int64" else 0.001
+        return {"warm_s": 0.01, "runs": runs, "wall_p50_ms": wall * 1e3,
+                "sigs_per_sec": rung / wall}
+
+    monkeypatch.setattr(profile_mod, "harvest_entry", fake_harvest)
+    monkeypatch.setattr(profile_mod, "timed_window", fake_timed)
+    rc, rep = _run_json(capsys, "--rungs", "8,64",
+                        "--impls", "int64,packed")
+    assert rc == 0
+    comp = rep["impl_comparison"]
+    assert [c["rung"] for c in comp] == [8, 64]
+    for c in comp:
+        assert c["baseline"] == "int64"
+        cell = c["impls"]["packed"]
+        assert cell["flops_ratio"] == pytest.approx(0.5)
+        assert cell["speedup"] == pytest.approx(2.0)
+        assert "flops_ratio" not in c["impls"]["int64"]  # baseline: none
+    # a single impl produces no comparison block
+    rc, rep = _run_json(capsys, "--rungs", "8", "--impls", "int64")
+    assert rep["impl_comparison"] == []
+
+
+def test_profile_impl_comparison_text_table(stubbed, capsys, monkeypatch):
+    monkeypatch.setattr(
+        profile_mod, "timed_window",
+        lambda kind, rung, impl, *, runs, deadline: {
+            "warm_s": 0.0, "runs": runs, "wall_p50_ms": 1.0,
+            "sigs_per_sec": rung / 0.001})
+    rc = cli_main(["profile", "--rungs", "8", "--impls", "int64,packed"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "impl comparison (baseline int64):" in out
+    assert "packed" in out and "1.00x" in out
+
+
+def test_render_impl_comparison_unit():
+    comp = profile_mod.impl_comparison([
+        {"kind": "verify", "rung": 8, "impl": "int64",
+         "hlo_bytes_per_row": 1200.0, "flops": 100.0,
+         "sigs_per_sec": 10.0, "wall_p50_ms": 1.0},
+        {"kind": "verify", "rung": 8, "impl": "packed",
+         "hlo_bytes_per_row": 800.0, "flops": 50.0,
+         "sigs_per_sec": 20.0, "wall_p50_ms": 0.5},
+    ])
+    assert len(comp) == 1
+    cell = comp[0]["impls"]["packed"]
+    assert cell["bytes_ratio"] == pytest.approx(800.0 / 1200.0, abs=1e-3)
+    assert cell["speedup"] == pytest.approx(2.0)
+    lines = profile_mod.render_impl_comparison(comp)
+    assert lines[0].startswith("impl comparison")
+    assert any("packed" in ln and "0.67x" in ln for ln in lines)
+    # errored rows are excluded; single-impl groups render nothing
+    assert profile_mod.impl_comparison(
+        [{"kind": "verify", "rung": 8, "impl": "int64"}]) == []
+
+
 def test_synth_rows_match_abstract_shapes():
     from tendermint_tpu.ops import shape_plan
 
