@@ -59,6 +59,7 @@ META_KEYS = {
     "async_stream_rounds", "simnet_nodes", "simnet_validator_slots",
     "benchdiff_base", "benchdiff_regressions", "benchdiff_missing",
     "benchdiff_ok", "shootout_rung", "shootout_n", "shootout_runs",
+    "gateway_clients",
 }
 
 # Ordered (pattern, class, direction) — first match wins.  direction
@@ -66,6 +67,9 @@ META_KEYS = {
 _CLASS_RULES = (
     (re.compile(r"(_sigs_per_sec|_per_sec|_per_s|_per_min|_blocks_per_s"
                 r"|_speedup|heights_per_min)$"), "throughput", "higher"),
+    # efficiency ratios where higher is better: the gateway's
+    # cross-client verify dedup and cache hit ratios, batch occupancy
+    (re.compile(r"_ratio$"), "ratio", "higher"),
     (re.compile(r"^(value|vs_baseline)$"), "throughput", "higher"),
     (re.compile(r"(_ok|_within_budget|_warmed|plan_warmed)$"),
      "boolean", "higher"),

@@ -724,6 +724,35 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_gateway(args) -> int:
+    """Run a standalone light-client gateway front end against a
+    primary node (docs/gateway.md): the read endpoints light clients
+    hammer are forwarded with a height-keyed response cache (immutable
+    below the tip, invalidated on height advance), so N clients cost
+    the primary ~1 client.  Node-embedded mode is TM_TPU_GATEWAY=1 on
+    `start` instead."""
+    from tendermint_tpu.gateway.frontend import GatewayProxy
+    from tendermint_tpu.utils.log import new_logger
+
+    logger = new_logger(level=args.log_level or "info")
+    proxy = GatewayProxy(args.primary, logger=logger, timeout=args.timeout)
+    host, _, port = args.laddr.split("://")[-1].rpartition(":")
+
+    async def run():
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop_ev.set)
+        addr = await proxy.start(host or "127.0.0.1", int(port or 8889))
+        logger.info("gateway serving", addr=f"{addr[0]}:{addr[1]}",
+                    primary=args.primary)
+        await stop_ev.wait()
+        await proxy.stop()
+
+    asyncio.run(run())
+    return 0
+
+
 def _load_journals(args, wal: bool = False) -> "dict | None":
     """Shared journal loading for the timeline/txtrace subcommands:
     name resolution (testnet node-home directories), journal or WAL
@@ -1302,6 +1331,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sp.add_argument("--log-level", dest="log_level", default="info")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser(
+        "gateway",
+        help="run a caching/coalescing read-path gateway front end "
+             "against a primary node (docs/gateway.md)")
+    sp.add_argument("--primary", required=True, help="primary node RPC URL")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8889")
+    sp.add_argument("--timeout", type=float, default=10.0,
+                    help="per-request upstream HTTP timeout")
+    sp.add_argument("--log-level", dest="log_level", default="info")
+    sp.set_defaults(fn=cmd_gateway)
 
     sp = sub.add_parser("signer-harness",
                         help="conformance-test a remote signer")

@@ -101,6 +101,9 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
         "health": {"level": None, "detectors": {}},
         "remediation": {"enabled": None, "shed_level": None,
                         "by_action": {}, "quarantined": 0},
+        "gateway": {"enabled": None, "clients": None,
+                    "cache_hit_ratio": None, "dedup_ratio": None,
+                    "shed_total": None, "shed_level": None},
         "device_memory": [],
         "errors": [],
     }
@@ -130,6 +133,16 @@ def collect(rpc_base: str, metrics_base: str, timeout: float = 5.0) -> dict:
                 "shed_level": int(rb.get("shed_level", 0)),
                 "by_action": dict(rb.get("by_action") or {}),
                 "quarantined": len(rb.get("quarantined_peers") or []),
+            }
+        gb = st.get("gateway")
+        if isinstance(gb, dict) and gb.get("enabled"):
+            snap["gateway"] = {
+                "enabled": True,
+                "clients": int(gb.get("clients", 0)),
+                "cache_hit_ratio": gb.get("cache_hit_ratio"),
+                "dedup_ratio": gb.get("verify_dedup_ratio"),
+                "shed_total": int(gb.get("shed_total", 0)),
+                "shed_level": int(gb.get("shed_level", 0)),
             }
         vs = st.get("verify_service", {})
         if vs:
@@ -317,6 +330,32 @@ def _fold_metrics(snap: dict, by_name: dict) -> None:
                        "by_action": acts,
                        "quarantined": int(active.get("evict", 0))})
 
+    # gateway: the metrics-side twin of status.gateway.  The series are
+    # registered typed-but-zero when no gateway is active, so only a
+    # non-zero signal (clients, jobs or cache traffic) fills the panel.
+    gl = snap.setdefault("gateway", {"enabled": None})
+    if gl.get("enabled") is None:
+        g_clients = _scalar(by_name, "tendermint_gateway_clients")
+        g_jobs = _scalar(by_name, "tendermint_gateway_verify_jobs_total", 0)
+        g_hits = _scalar(by_name, "tendermint_gateway_cache_hits_total", 0)
+        g_miss = _scalar(by_name, "tendermint_gateway_cache_misses_total", 0)
+        if (g_clients or 0) or (g_jobs or 0) or (g_hits or 0) + (g_miss or 0):
+            coal = _scalar(by_name,
+                           "tendermint_gateway_verify_coalesced_total", 0)
+            lookups = (g_hits or 0) + (g_miss or 0)
+            flushed = (g_jobs or 0) - (coal or 0)
+            gl.update({
+                "enabled": True,
+                "clients": int(g_clients or 0),
+                "cache_hit_ratio": round((g_hits or 0) / lookups, 4)
+                if lookups else 0.0,
+                "dedup_ratio": round((g_jobs or 0) / flushed, 2)
+                if flushed > 0 else 0.0,
+                "shed_total": int(_scalar(
+                    by_name, "tendermint_gateway_shed_total", 0) or 0),
+                "shed_level": None,
+            })
+
     mem: dict[str, dict] = {}
     for labels, v in by_name.get("tendermint_crypto_device_memory_bytes", []):
         dev = labels.get("device", "?")
@@ -481,6 +520,18 @@ def render(snap: dict) -> str:
             f"remediate  shed {('ok', 'WARN', 'CRITICAL')[min(2, shed)]}"
             f"  quarantined {rl.get('quarantined', 0)}"
             + (f"  [{acts}]" if acts else ""))
+    gl = snap.get("gateway") or {}
+    if gl.get("enabled"):
+        hit = gl.get("cache_hit_ratio")
+        dedup = gl.get("dedup_ratio")
+        shed_lvl = gl.get("shed_level")
+        lines.append(
+            f"gateway    clients {_v(gl.get('clients'))}"
+            f"  cache-hit {_v(hit if hit is None else round(100 * hit, 1), '{}%')}"
+            f"  dedup {_v(dedup, '{}x')}"
+            f"  shed {_v(gl.get('shed_total'))}"
+            + (f" ({('ok', 'WARN', 'CRITICAL')[min(2, shed_lvl)]})"
+               if shed_lvl else ""))
     if snap["device_memory"]:
         for e in snap["device_memory"]:
             detail = "  ".join(

@@ -1,0 +1,91 @@
+"""Light-client gateway: serve the read path at scale.
+
+The consensus write path batches (PR 1's async verify service, the
+blocksync/commit windows); this package is the READ-path counterpart —
+one node terminating a fan-out of light clients, shaped like an
+inference frontend in front of a batched accelerator kernel:
+
+  request coalescing   `coalescer.VerifyCoalescer` — cross-CLIENT
+                       single-flight dedup + linger batching of commit
+                       verify jobs into shared batch_verify_commits
+                       flushes (device flushes scale with distinct
+                       heights, not clients x blocks)
+  cache hierarchy      `cache.ResponseCache` — height-keyed responses
+                       for commit/validators/block/abci_query, immutable
+                       below the tip, invalidated by height advance;
+                       fronted (one level down) by the verified-sig LRU
+  admission control    `errors.GatewayBackpressureError` — read-path
+                       verify work sheds first when consensus saturates
+                       the verify queue, with a structured retry hint
+
+`service.Gateway` bundles the three; `routes` mounts the cached routes
+on a node's RPC server (TM_TPU_GATEWAY=1), `frontend.GatewayProxy` is
+the standalone `tendermint-tpu gateway` daemon, and
+`client.LightGatewayClient` drives N concurrent in-process syncing
+clients (tests/bench).  This module stays import-light: only the
+metrics accessor and the active-gateway registry live here (the PR 2
+NOP idiom — `gateway_stats()` returns typed zeros when no gateway is
+active, so node metrics register the series unconditionally and a
+scrape never instantiates anything).
+"""
+
+from __future__ import annotations
+
+from .errors import GatewayBackpressureError, GatewayError
+
+__all__ = [
+    "GatewayBackpressureError",
+    "GatewayError",
+    "gateway_stats",
+    "set_active",
+    "clear_active",
+    "active_gateway",
+]
+
+#: stats keys with their off-state zeros — the metrics contract
+ZERO_STATS = {
+    "clients": 0,
+    "verify_jobs": 0,
+    "verify_coalesced": 0,
+    "verify_flushed_jobs": 0,
+    "verify_flushes": 0,
+    "verify_dedup_ratio": 0.0,
+    "shed": 0,
+    "shed_level": 0,
+    "queue_depth": 0,
+    "cache_hits": 0,
+    "cache_misses": 0,
+    "cache_invalidations": 0,
+    "cache_entries": 0,
+    "cache_bytes": 0,
+    "cache_hit_ratio": 0.0,
+}
+
+_ACTIVE = None
+
+
+def set_active(gw) -> None:
+    """Register the process's serving gateway (node-embedded mode or
+    the standalone front end) so metrics/status scrapes find it."""
+    global _ACTIVE
+    _ACTIVE = gw
+
+
+def clear_active() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active_gateway():
+    return _ACTIVE
+
+
+def gateway_stats() -> dict:
+    """Counters for the tendermint_gateway_* series; typed zeros when
+    no gateway is active (the scrape must not build one)."""
+    gw = _ACTIVE
+    if gw is None:
+        return dict(ZERO_STATS)
+    out = dict(ZERO_STATS)
+    out.update(gw.stats())
+    return out

@@ -1,0 +1,141 @@
+"""Height-keyed RPC response cache for the read endpoints light clients
+hammer (`commit`, `validators`, `block`, `abci_query` at fixed height).
+
+Invalidation model — the property that makes a blockchain read path
+cacheable at all:
+
+  * **Pinned entries** (explicit height strictly below the chain tip at
+    store time) are IMMUTABLE: a canonical commit/validator set/block
+    below the tip can never change, so these entries live until LRU
+    eviction, never by invalidation.  (A request at the tip itself is
+    NOT pinned: the tip's `commit` is the mutable seen-commit until
+    height+1 lands.)
+  * **Latest-tagged entries** (no height / height 0 / height == tip)
+    are valid only while the chain tip the caller observes equals the
+    tip at store time — height advance invalidates them naturally on
+    the next lookup.  An optional TTL bounds staleness for front ends
+    whose tip watermark is itself fed from cached traffic.
+
+Thread-safe, LRU-bounded by entries AND bytes, with hit/miss/bytes
+counters (the `tendermint_gateway_cache_*` series).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+
+DEFAULT_MAX_ENTRIES = 4096
+DEFAULT_MAX_BYTES = 64 << 20
+
+
+class _CEntry:
+    __slots__ = ("doc", "nbytes", "tag_height", "pinned", "stored_at")
+
+    def __init__(self, doc, nbytes: int, tag_height: int, pinned: bool,
+                 stored_at: float):
+        self.doc = doc
+        self.nbytes = nbytes
+        self.tag_height = tag_height
+        self.pinned = pinned
+        self.stored_at = stored_at
+
+
+def cache_key(method: str, params: dict) -> tuple:
+    """Canonical key: method + sorted scalar params (URI and JSON-RPC
+    callers hit the same entry regardless of param order)."""
+    return (method, tuple(sorted((str(k), str(v))
+                                 for k, v in params.items())))
+
+
+class ResponseCache:
+    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 latest_ttl_s: float | None = None,
+                 clock=time.monotonic):
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self.latest_ttl_s = latest_ttl_s
+        self._clock = clock
+        self._d: OrderedDict[tuple, _CEntry] = OrderedDict()
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    # -- lookup/store ----------------------------------------------------
+
+    def lookup(self, method: str, params: dict, latest_height: int):
+        """Cached response doc, or None.  `latest_height` is the chain
+        tip the caller currently believes in — the invalidation input."""
+        key = cache_key(method, params)
+        with self._lock:
+            e = self._d.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            if not e.pinned:
+                stale = e.tag_height != latest_height or (
+                    self.latest_ttl_s is not None
+                    and self._clock() - e.stored_at > self.latest_ttl_s)
+                if stale:
+                    self._evict_locked(key, e)
+                    self.invalidations += 1
+                    self.misses += 1
+                    return None
+            self._d.move_to_end(key)
+            self.hits += 1
+            return e.doc
+
+    def store(self, method: str, params: dict, doc, *,
+              latest_height: int, pinned: bool,
+              nbytes: int | None = None) -> None:
+        """`nbytes` lets a caller holding a non-JSON doc (the in-process
+        provider path caches domain objects) supply its own size
+        estimate instead of paying a serialization just for
+        accounting."""
+        key = cache_key(method, params)
+        if nbytes is None:
+            try:
+                nbytes = len(json.dumps(doc, separators=(",", ":"),
+                                        default=str))
+            except (TypeError, ValueError):
+                return   # unserializable result: not worth caching
+        if nbytes > self.max_bytes:
+            return
+        with self._lock:
+            old = self._d.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._d[key] = _CEntry(doc, nbytes, latest_height, pinned,
+                                   self._clock())
+            self._bytes += nbytes
+            while (len(self._d) > self.max_entries
+                   or self._bytes > self.max_bytes):
+                k, e = next(iter(self._d.items()))
+                self._evict_locked(k, e)
+
+    def _evict_locked(self, key: tuple, e: _CEntry) -> None:
+        self._d.pop(key, None)
+        self._bytes -= e.nbytes
+
+    # -- views -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "cache_hits": self.hits,
+                "cache_misses": self.misses,
+                "cache_invalidations": self.invalidations,
+                "cache_entries": len(self._d),
+                "cache_bytes": self._bytes,
+                "cache_hit_ratio": (round(self.hits / lookups, 6)
+                                    if lookups else 0.0),
+            }

@@ -1,0 +1,132 @@
+"""`LightGatewayClient` — drive N concurrent in-process light clients
+through one gateway's coalesced verify stream.
+
+The driver is the test/bench harness for the "millions of users"
+surface: each client is a REAL `light.Client` (own trusted store, own
+provider, full header-chain checks) whose `commit_verifier` seam points
+at the gateway's coalescer, so N clients syncing the same chain produce
+verify flushes proportional to distinct heights.  Backpressure is
+honored: a client that receives `GatewayBackpressureError` sleeps the
+structured `retry_after_ms` hint and retries (bounded), which is
+exactly the protocol a remote client of the RPC surface would follow.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.light.client import Client, SEQUENTIAL, TrustOptions
+
+from .errors import GatewayBackpressureError
+from .service import Gateway
+
+
+class LightGatewayClient:
+    """Run `n_clients` concurrent syncing light clients against one
+    gateway.
+
+    provider_factory   callable(i) -> Provider for client i (each client
+                       gets its own, like real clients would)
+    trust_options      shared root of trust (all clients start equal)
+    """
+
+    def __init__(self, gateway: Gateway, chain_id: str,
+                 trust_options: TrustOptions, provider_factory, *,
+                 n_clients: int = 8, mode: str = SEQUENTIAL,
+                 backpressure_retries: int = 0,
+                 now_fn=None):
+        self.gateway = gateway
+        self.chain_id = chain_id
+        self.trust_options = trust_options
+        self.provider_factory = provider_factory
+        self.n_clients = n_clients
+        self.mode = mode
+        self.backpressure_retries = backpressure_retries
+        self.now_fn = now_fn
+
+    def _build_client(self, i: int) -> Client:
+        kwargs = {}
+        if self.now_fn is not None:
+            kwargs["now_fn"] = self.now_fn
+        provider = self.provider_factory(i)
+        return Client(
+            chain_id=self.chain_id,
+            trust_options=self.trust_options,
+            primary=provider,
+            witnesses=[],
+            mode=self.mode,
+            commit_verifier=self.gateway.verify_commits,
+            **kwargs,
+        )
+
+    def _sync_one(self, i: int, target_height: int, out: dict) -> None:
+        self.gateway.client_started()
+        t0 = time.perf_counter()
+        try:
+            lc = self._build_client(i)
+            attempts = 0
+            while True:
+                try:
+                    if target_height > 0:
+                        lc.verify_light_block_at_height(target_height)
+                    else:
+                        lc.update()
+                    break
+                except GatewayBackpressureError as e:
+                    attempts += 1
+                    if attempts > self.backpressure_retries:
+                        raise
+                    time.sleep(e.retry_after_ms / 1e3)
+            out[i] = {
+                "ok": True,
+                "trusted_height": lc.last_trusted_height(),
+                "seconds": round(time.perf_counter() - t0, 4),
+                "backpressure_retries": attempts,
+            }
+        except Exception as e:  # noqa: BLE001 — per-client verdict
+            out[i] = {
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}",
+                "seconds": round(time.perf_counter() - t0, 4),
+            }
+        finally:
+            self.gateway.client_finished()
+
+    def sync_all(self, target_height: int = 0,
+                 timeout_s: float = 120.0) -> dict:
+        """Start every client at once, wait for all, report per-client
+        verdicts + the gateway's sharing stats."""
+        results: dict[int, dict] = {}
+        start = threading.Barrier(self.n_clients + 1)
+
+        def run(i: int) -> None:
+            try:
+                start.wait(timeout=timeout_s)
+            except threading.BrokenBarrierError:
+                results[i] = {"ok": False, "error": "start barrier broke"}
+                return
+            self._sync_one(i, target_height, results)
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True,
+                                    name=f"gw-client-{i}")
+                   for i in range(self.n_clients)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        start.wait(timeout=timeout_s)
+        for t in threads:
+            t.join(timeout=max(0.0, timeout_s - (time.perf_counter() - t0)))
+        wall = time.perf_counter() - t0
+        clients = [results.get(i, {"ok": False, "error": "timed out"})
+                   for i in range(self.n_clients)]
+        ok = sum(1 for c in clients if c.get("ok"))
+        return {
+            "clients": clients,
+            "n_clients": self.n_clients,
+            "n_ok": ok,
+            "all_ok": ok == self.n_clients,
+            "wall_s": round(wall, 4),
+            "clients_synced_per_s": round(ok / wall, 4) if wall > 0 else 0.0,
+            "gateway": self.gateway.stats(),
+        }

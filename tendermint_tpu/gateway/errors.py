@@ -1,0 +1,49 @@
+"""Gateway error types: the read-path twin of the mempool's
+admission-control backpressure (PR 11's `MempoolBackpressureError`).
+
+`GatewayBackpressureError` deliberately subclasses neither
+`LightClientError` nor `ValueError`: the light client's recovery
+machinery (`_verify_sequential`'s per-block fallback + primary
+replacement, `_verify_skipping`'s witness retry) catches those and
+would turn a deliberate load-shed into an expensive provider-rotation
+hunt.  Backpressure must surface to the DRIVER of the sync — the
+entity that can honor `retry_after_ms` — untouched.
+"""
+
+from __future__ import annotations
+
+
+class GatewayError(Exception):
+    pass
+
+
+class GatewayBackpressureError(GatewayError):
+    """The gateway is shedding read-path verify work (the node's verify
+    queue is saturated with consensus-priority traffic).  Carries the
+    same structured hints as the mempool's backpressure error so one
+    client-side retry policy covers both surfaces."""
+
+    def __init__(self, shed_level: int, retry_after_ms: int):
+        super().__init__(
+            f"gateway shedding read-path verify work (level {shed_level}); "
+            f"retry after {retry_after_ms}ms")
+        self.shed_level = int(shed_level)
+        self.retry_after_ms = int(retry_after_ms)
+
+    def to_data(self) -> dict:
+        """The JSON-RPC `error.data` payload (same shape family as
+        rpc/core's `_mempool_full_rpc_error`): clients distinguish
+        backpressure from faults by code, not message parsing."""
+        return {
+            "code": "backpressure",
+            "source": "gateway",
+            "shed_level": self.shed_level,
+            "retry_after_ms": self.retry_after_ms,
+        }
+
+    def rpc_error(self):
+        """Map to the structured JSON-RPC error (lazy import: the
+        gateway core must not drag the RPC layer into every user)."""
+        from tendermint_tpu.rpc.jsonrpc import GATEWAY_BACKPRESSURE, RPCError
+
+        return RPCError(GATEWAY_BACKPRESSURE, str(self), data=self.to_data())

@@ -1,0 +1,188 @@
+"""Standalone gateway front end: `tendermint-tpu gateway`.
+
+A daemon that terminates many light clients' READ traffic against one
+primary node: the hammered endpoints (`commit`, `validators`, `block`,
+`abci_query`, `block_results`, `consensus_params`) are forwarded and
+cached height-keyed (immutable below the tip, invalidated on height
+advance — with a TTL bound on latest-tagged entries because the front
+end's tip watermark is itself fed from passing traffic), while
+`status`/`health`/`broadcast_tx_*` forward uncached.  Clients verify
+headers THEMSELVES (unlike the light proxy, which verifies server-side
+— and therefore cannot be shared by mutually-distrusting clients); the
+gateway's job is to make N clients cost the primary ~1 client.
+
+The same process exposes `gateway.verify_commits` for IN-process light
+clients (`client.LightGatewayClient`), so a colocated sync fleet also
+shares one coalesced verify stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.parse
+import urllib.request
+
+from tendermint_tpu.rpc.jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, RPCError
+from tendermint_tpu.rpc.server import RPCServer
+from tendermint_tpu.utils.log import Logger, nop_logger
+
+from . import set_active, clear_active
+from .routes import wrap_cached_routes
+from .service import Gateway
+
+#: bound on how stale a latest-tagged cache entry may get when the tip
+#: watermark is fed only by passing traffic (seconds)
+DEFAULT_LATEST_TTL_S = 1.0
+
+
+class ForwardEnv:
+    """Stands in for rpc.core.Environment: carries the primary's RPC
+    address and the gateway handle (duck-typed; forwarded routes only)."""
+
+    def __init__(self, gateway: Gateway, primary_url: str,
+                 timeout: float = 10.0):
+        self.gateway = gateway
+        self.primary_url = primary_url.rstrip("/")
+        self.timeout = timeout
+        self.config = None
+        self.event_bus = None
+
+    def forward(self, path: str) -> dict:
+        try:
+            with urllib.request.urlopen(self.primary_url + path,
+                                        timeout=self.timeout) as r:
+                doc = json.loads(r.read())
+        except (OSError, json.JSONDecodeError) as e:
+            raise RPCError(INTERNAL_ERROR,
+                           f"primary unreachable: {e}") from None
+        if "error" in doc:
+            raise RPCError(doc["error"].get("code", INTERNAL_ERROR),
+                           doc["error"].get("message", ""),
+                           doc["error"].get("data", ""))
+        return doc["result"]
+
+
+def _qs(**params) -> str:
+    pairs = [f"{k}={urllib.parse.quote(str(v))}"
+             for k, v in params.items() if v not in (None, "")]
+    return ("?" + "&".join(pairs)) if pairs else ""
+
+
+def _note_header_height(env: ForwardEnv, doc: dict) -> None:
+    """Feed the tip watermark from a signed-header-shaped response."""
+    try:
+        h = int(doc["signed_header"]["header"]["height"])
+    except (KeyError, TypeError, ValueError):
+        return
+    env.gateway.note_height(h)
+
+
+async def commit(env: ForwardEnv, height=None) -> dict:
+    doc = await asyncio.to_thread(env.forward, "/commit" + _qs(height=height))
+    _note_header_height(env, doc)
+    return doc
+
+
+async def validators(env: ForwardEnv, height=None, page=None,
+                     per_page=None) -> dict:
+    return await asyncio.to_thread(
+        env.forward,
+        "/validators" + _qs(height=height, page=page, per_page=per_page))
+
+
+async def block(env: ForwardEnv, height=None) -> dict:
+    doc = await asyncio.to_thread(env.forward, "/block" + _qs(height=height))
+    try:
+        env.gateway.note_height(int(doc["block"]["header"]["height"]))
+    except (KeyError, TypeError, ValueError):
+        pass
+    return doc
+
+
+async def block_results(env: ForwardEnv, height=None) -> dict:
+    return await asyncio.to_thread(env.forward,
+                                   "/block_results" + _qs(height=height))
+
+
+async def consensus_params(env: ForwardEnv, height=None) -> dict:
+    return await asyncio.to_thread(env.forward,
+                                   "/consensus_params" + _qs(height=height))
+
+
+async def abci_query(env: ForwardEnv, path=None, data=None, height=None,
+                     prove=None) -> dict:
+    return await asyncio.to_thread(
+        env.forward,
+        "/abci_query" + _qs(path=path, data=data, height=height,
+                            prove=prove))
+
+
+async def status(env: ForwardEnv) -> dict:
+    doc = await asyncio.to_thread(env.forward, "/status")
+    try:
+        env.gateway.note_height(
+            int(doc["sync_info"]["latest_block_height"]))
+    except (KeyError, TypeError, ValueError):
+        pass
+    # overlay this front end's serving state — the one block a client
+    # polls to see cache/coalescer/shed health
+    doc["gateway"] = env.gateway.status_block()
+    return doc
+
+
+def health(env: ForwardEnv) -> dict:
+    return {}
+
+
+async def broadcast_tx_sync(env: ForwardEnv, tx=None) -> dict:
+    if not tx:
+        raise RPCError(INVALID_PARAMS, "tx is required")
+    return await asyncio.to_thread(env.forward,
+                                   "/broadcast_tx_sync" + _qs(tx=tx))
+
+
+async def broadcast_tx_async(env: ForwardEnv, tx=None) -> dict:
+    if not tx:
+        raise RPCError(INVALID_PARAMS, "tx is required")
+    return await asyncio.to_thread(env.forward,
+                                   "/broadcast_tx_async" + _qs(tx=tx))
+
+
+GATEWAY_ROUTES = {
+    "health": health,
+    "status": status,
+    "commit": commit,
+    "validators": validators,
+    "block": block,
+    "block_results": block_results,
+    "consensus_params": consensus_params,
+    "abci_query": abci_query,
+    "broadcast_tx_sync": broadcast_tx_sync,
+    "broadcast_tx_async": broadcast_tx_async,
+}
+
+
+class GatewayProxy:
+    """The daemon: gateway (cache + coalescer) + forwarding RPC server."""
+
+    def __init__(self, primary_url: str, *, gateway: Gateway | None = None,
+                 logger: Logger | None = None, timeout: float = 10.0):
+        self.logger = logger or nop_logger()
+        self.gateway = gateway if gateway is not None else \
+            Gateway.from_env(latest_ttl_s=DEFAULT_LATEST_TTL_S)
+        self.env = ForwardEnv(self.gateway, primary_url, timeout=timeout)
+        routes = wrap_cached_routes(GATEWAY_ROUTES, self.gateway)
+        self.server = RPCServer(self.env, logger=self.logger, routes=routes)
+        self.addr: tuple[str, int] | None = None
+
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> tuple[str, int]:
+        self.addr = await self.server.start(host, port)
+        set_active(self.gateway)
+        return self.addr
+
+    async def stop(self) -> None:
+        await self.server.stop()
+        self.gateway.close()
+        clear_active()

@@ -1,0 +1,62 @@
+"""Mount the gateway's height-keyed response cache on an RPC route
+table (the node-embedded TM_TPU_GATEWAY=1 mode).
+
+Only the read endpoints light clients hammer are wrapped; every other
+route passes through untouched.  Wrappers preserve the original
+handler's signature via functools.wraps (`__wrapped__`), so the RPC
+server's signature-based param validation keeps rejecting unknown
+params BEFORE the handler (and before the cache) runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+
+#: the endpoints whose responses are height-determined
+CACHEABLE_ROUTES = ("commit", "validators", "block", "abci_query",
+                    "block_results", "consensus_params")
+
+
+def _requested_height(kwargs: dict) -> int:
+    try:
+        h = kwargs.get("height")
+        return int(h) if h else 0
+    except (TypeError, ValueError):
+        return 0
+
+
+def cached_route(name: str, fn, gateway):
+    """One cached handler: lookup by (method, params) against the
+    current tip; on miss, call through and store — pinned (immutable)
+    when the request names a height strictly below the tip, tip-tagged
+    (invalidated by height advance) otherwise."""
+    is_coro = asyncio.iscoroutinefunction(fn)
+
+    @functools.wraps(fn)
+    async def handler(env, **kwargs):
+        doc = gateway.cache.lookup(name, kwargs, gateway.latest_height())
+        if doc is not None:
+            return doc
+        result = await fn(env, **kwargs) if is_coro else fn(env, **kwargs)
+        # tag/pin against the tip AFTER the call: on the front end the
+        # forwarded response itself is what advances the watermark (a
+        # pre-call read would tag against a stale tip and the very next
+        # lookup would invalidate the entry it just stored)
+        latest = gateway.latest_height()
+        h = _requested_height(kwargs)
+        gateway.cache.store(name, kwargs, result,
+                            latest_height=latest, pinned=0 < h < latest)
+        return result
+
+    return handler
+
+
+def wrap_cached_routes(routes: dict, gateway) -> dict:
+    """A copy of `routes` with the cacheable read endpoints wrapped."""
+    out = dict(routes)
+    for name in CACHEABLE_ROUTES:
+        fn = out.get(name)
+        if fn is not None:
+            out[name] = cached_route(name, fn, gateway)
+    return out

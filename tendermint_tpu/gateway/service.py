@@ -1,0 +1,141 @@
+"""The Gateway object: one node's (or one front end's) read-path serving
+state — the verify coalescer, the height-keyed response cache, the
+client registry, and the degradation wiring — behind a single handle
+that status/metrics/top all read.
+
+Construction is cheap and device-free; the coalescer's worker thread
+spins up lazily at the first verify submission (same contract as the
+async verify service it feeds).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .cache import ResponseCache
+from .coalescer import VerifyCoalescer
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class Gateway:
+    """Bundles the read-path serving machinery.
+
+    Collaborators (all injectable, none imported at construction):
+      shed_fn            () -> int admission level; non-zero sheds
+                         read-path verify work (wire to the remediation
+                         controller's shed_level)
+      remediate          the node's RemediationController (or NOP); the
+                         coalescer journals sheds through its `record`
+                         seam
+      latest_height_fn   () -> int chain tip for cache invalidation
+                         (node-embedded: block_store.height; front end:
+                         the observed watermark, TTL-bounded)
+    """
+
+    def __init__(self, *, coalescer: VerifyCoalescer | None = None,
+                 cache: ResponseCache | None = None,
+                 shed_fn=None, remediate=None,
+                 latest_height_fn=None,
+                 latest_ttl_s: float | None = None,
+                 retry_after_ms: int = 1000):
+        self.coalescer = coalescer if coalescer is not None else \
+            VerifyCoalescer(shed_fn=shed_fn, remediate=remediate,
+                            retry_after_ms=retry_after_ms)
+        self.cache = cache if cache is not None else \
+            ResponseCache(latest_ttl_s=latest_ttl_s)
+        self._latest_height_fn = latest_height_fn
+        self._height_watermark = 0
+        self._clients = 0
+
+    @classmethod
+    def from_env(cls, **kwargs) -> "Gateway":
+        """Env-tuned construction (resolved per call, never at import):
+          TM_TPU_GATEWAY_LINGER_MS        coalescer linger (default 2.0)
+          TM_TPU_GATEWAY_CACHE_ENTRIES    response-cache entries (4096)
+          TM_TPU_GATEWAY_CACHE_BYTES      response-cache bytes (64 MiB)
+          TM_TPU_GATEWAY_RETRY_AFTER_MS   backpressure retry hint (1000)
+        """
+        retry = _env_int("TM_TPU_GATEWAY_RETRY_AFTER_MS", 1000)
+        cache = ResponseCache(
+            max_entries=_env_int("TM_TPU_GATEWAY_CACHE_ENTRIES", 4096),
+            max_bytes=_env_int("TM_TPU_GATEWAY_CACHE_BYTES", 64 << 20),
+            latest_ttl_s=kwargs.pop("latest_ttl_s", None))
+        return cls(cache=cache, retry_after_ms=retry, **kwargs)
+
+    # -- verify funnel ----------------------------------------------------
+
+    def verify_commits(self, jobs) -> None:
+        """batch_verify_commits-compatible; the callable every
+        gateway-driven light client's commit_verifier seam points at."""
+        self.coalescer.verify_jobs(jobs)
+
+    # -- height watermark -------------------------------------------------
+
+    def latest_height(self) -> int:
+        if self._latest_height_fn is not None:
+            try:
+                return int(self._latest_height_fn())
+            except Exception:  # noqa: BLE001 — a broken probe: watermark
+                pass
+        return self._height_watermark
+
+    def note_height(self, h: int) -> None:
+        """Front-end watermark feed: responses passing through reveal
+        the chain tip (a forwarded /commit or /status)."""
+        if h > self._height_watermark:
+            self._height_watermark = h
+
+    # -- client registry --------------------------------------------------
+
+    def client_started(self) -> None:
+        self._clients += 1
+
+    def client_finished(self) -> None:
+        self._clients = max(0, self._clients - 1)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        self.coalescer.close()
+
+    # -- views ------------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = self.coalescer.stats_snapshot()
+        out.update(self.cache.stats_snapshot())
+        out["clients"] = self._clients
+        out["verify_dedup_ratio"] = self.coalescer.dedup_ratio()
+        out["shed_level"] = self.coalescer.shed_level()
+        return out
+
+    def status_block(self) -> dict:
+        """Compact block for RPC `status.gateway` / `top`."""
+        st = self.stats()
+        return {
+            "enabled": True,
+            "clients": st["clients"],
+            "shed_level": st["shed_level"],
+            "shed_total": st["shed"],
+            "verify_jobs": st["verify_jobs"],
+            "verify_coalesced": st["verify_coalesced"],
+            "verify_flushes": st["verify_flushes"],
+            "verify_dedup_ratio": st["verify_dedup_ratio"],
+            "cache_hits": st["cache_hits"],
+            "cache_misses": st["cache_misses"],
+            "cache_hit_ratio": st["cache_hit_ratio"],
+            "cache_entries": st["cache_entries"],
+            "cache_bytes": st["cache_bytes"],
+        }

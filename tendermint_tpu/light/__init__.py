@@ -1,6 +1,15 @@
 """Light client (reference light/): header verification at light-node
 trust, with sequential + skipping modes, witness cross-checks, and
-batched commit verification on device."""
+batched commit verification on device.
+
+The batched READ-path serving surface sits one package over, in
+`tendermint_tpu.gateway`: a node (TM_TPU_GATEWAY=1) or the standalone
+`tendermint-tpu gateway` front end terminates many concurrent light
+clients, coalescing their `verify_adjacent_range` / skipping-verify
+commit jobs — via the `commit_verifier` seam on `Client` and the
+`verify_fn` seam on `verify_adjacent_range` — into shared
+batch_verify_commits flushes, fronted by a height-keyed RPC response
+cache (docs/gateway.md)."""
 
 from .client import (
     Client,

@@ -73,6 +73,7 @@ class Client:
         pruning_size: int = DEFAULT_PRUNING_SIZE,
         now_fn=_now_ns,
         logger=None,
+        commit_verifier=None,
     ):
         verifier.validate_trust_level(trust_level)
         trust_options.validate_basic()
@@ -89,6 +90,10 @@ class Client:
         self.store = trusted_store if trusted_store is not None else LightBlockStore()
         self.now_fn = now_fn
         self.logger = logger
+        # commit-batch sink override (contract of batch_verify_commits):
+        # a gateway-driven client points this at the cross-client verify
+        # coalescer so N clients syncing one chain share device flushes
+        self.commit_verifier = commit_verifier
         self.latest_trusted: LightBlock | None = self.store.latest_light_block()
         self._initialize(trust_options)
 
@@ -202,6 +207,7 @@ class Client:
                 verifier.verify_adjacent_range(
                     trusted, blocks, self.trusting_period_ns, now,
                     self.max_clock_drift_ns,
+                    verify_fn=self.commit_verifier,
                 )
             except ErrOldHeaderExpired:
                 raise
@@ -292,6 +298,7 @@ class Client:
                     now,
                     self.max_clock_drift_ns,
                     self.trust_level,
+                    commit_verifier=self.commit_verifier,
                 )
             except ErrNewValSetCantBeTrusted:
                 if depth == len(cache) - 1:

@@ -7,12 +7,25 @@ hashes upper-hex, blobs base64, RFC3339 nanosecond timestamps).
 
 Synchronous urllib I/O: the light client and statesync state provider
 drive providers synchronously; run them in a thread from async code.
+
+Transient-failure policy (the gateway satellite): every request carries
+a configurable timeout, and transport-level failures (socket errors,
+malformed bodies) retry up to `retries` times on a capped-exponential
+ladder with the DialBackoff jitter idiom — delay in [0.5x, 1.0x] of
+min(cap, base * 2^attempt), seeded per instance (TM_TPU_DIAL_SEED pins
+it) so a fleet of gateway-driven syncs doesn't hammer a recovering
+upstream in lock-step.  RPC-LEVEL errors (the upstream answered with an
+error document) never retry: the upstream is alive and the answer would
+not change.
 """
 
 from __future__ import annotations
 
 import base64
 import json
+import os
+import random
+import time
 import urllib.parse
 import urllib.request
 
@@ -99,10 +112,22 @@ class HTTPProvider:
     """Assembles LightBlocks from a node's RPC (reference
     light/provider/http/http.go)."""
 
-    def __init__(self, chain_id: str, base_url: str, timeout: float = 10.0):
+    def __init__(self, chain_id: str, base_url: str, timeout: float = 10.0,
+                 retries: int = 2, backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 0.5,
+                 rng: random.Random | None = None, sleep=time.sleep):
         self._chain_id = chain_id
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = max(0, int(retries))
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        if rng is None:
+            seed = os.environ.get("TM_TPU_DIAL_SEED")
+            rng = random.Random(
+                int(seed) if seed else hash((os.getpid(), id(self))))
+        self._rng = rng
+        self._sleep = sleep
 
     def __repr__(self) -> str:
         return f"HTTPProvider({self.base_url})"
@@ -110,12 +135,28 @@ class HTTPProvider:
     def chain_id(self) -> str:
         return self._chain_id
 
+    def _retry_delay(self, attempt: int) -> float:
+        """Capped-exponential with jitter in [0.5x, 1.0x] — the
+        DialBackoff ladder, applied to one request's retry loop."""
+        raw = min(self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt))
+        return raw * (0.5 + 0.5 * self._rng.random())
+
+    def _fetch(self, path: str) -> dict:
+        with urllib.request.urlopen(self.base_url + path,
+                                    timeout=self.timeout) as r:
+            return json.loads(r.read())
+
     def _get(self, path: str) -> dict:
-        try:
-            with urllib.request.urlopen(self.base_url + path, timeout=self.timeout) as r:
-                doc = json.loads(r.read())
-        except (OSError, json.JSONDecodeError) as e:
-            raise ErrNoResponse(f"{self.base_url}{path}: {e}") from None
+        for attempt in range(self.retries + 1):
+            try:
+                doc = self._fetch(path)
+                break
+            except (OSError, json.JSONDecodeError) as e:
+                if attempt >= self.retries:
+                    raise ErrNoResponse(
+                        f"{self.base_url}{path}: {e} "
+                        f"(after {attempt + 1} attempts)") from None
+                self._sleep(self._retry_delay(attempt))
         if "error" in doc:
             msg = doc["error"].get("message", "") + " " + str(doc["error"].get("data", ""))
             if "ahead of the chain" in msg or "not found" in msg:

@@ -84,6 +84,36 @@ def _verify_new_header_and_vals(
         )
 
 
+def _verify_commit_light(
+    untrusted_vals: ValidatorSet,
+    chain_id: str,
+    untrusted_header: SignedHeader,
+    commit_verifier,
+) -> None:
+    """The new set's own +2/3 check, routed through `commit_verifier`
+    when given (a batch_verify_commits-compatible callable — the
+    gateway's cross-client coalescer) and straight to the validator-set
+    surface otherwise."""
+    if commit_verifier is None:
+        untrusted_vals.verify_commit_light(
+            chain_id,
+            untrusted_header.commit.block_id,
+            untrusted_header.height,
+            untrusted_header.commit,
+        )
+    else:
+        commit_verifier([
+            CommitVerifyJob(
+                val_set=untrusted_vals,
+                chain_id=chain_id,
+                block_id=untrusted_header.commit.block_id,
+                height=untrusted_header.height,
+                commit=untrusted_header.commit,
+                mode="light",
+            )
+        ])
+
+
 def verify_non_adjacent(
     trusted_header: SignedHeader,
     trusted_vals: ValidatorSet,
@@ -93,6 +123,8 @@ def verify_non_adjacent(
     now_ns: int,
     max_clock_drift_ns: int,
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    *,
+    commit_verifier=None,
 ) -> None:
     """Skipping verification across a height gap (reference verifier.go:33-99).
 
@@ -119,12 +151,8 @@ def verify_non_adjacent(
         raise ErrNewValSetCantBeTrusted(str(e)) from e
 
     try:
-        untrusted_vals.verify_commit_light(
-            chain_id,
-            untrusted_header.commit.block_id,
-            untrusted_header.height,
-            untrusted_header.commit,
-        )
+        _verify_commit_light(untrusted_vals, chain_id, untrusted_header,
+                             commit_verifier)
     except ValueError as e:
         raise ErrInvalidHeader(str(e)) from e
 
@@ -136,6 +164,8 @@ def verify_adjacent(
     trusting_period_ns: int,
     now_ns: int,
     max_clock_drift_ns: int,
+    *,
+    commit_verifier=None,
 ) -> None:
     """Sequential (height+1) verification (reference verifier.go:102-145)."""
     if untrusted_header.height != trusted_header.height + 1:
@@ -157,12 +187,8 @@ def verify_adjacent(
             f"from new header ({untrusted_header.header.validators_hash.hex()})"
         )
     try:
-        untrusted_vals.verify_commit_light(
-            trusted_header.header.chain_id,
-            untrusted_header.commit.block_id,
-            untrusted_header.height,
-            untrusted_header.commit,
-        )
+        _verify_commit_light(untrusted_vals, trusted_header.header.chain_id,
+                             untrusted_header, commit_verifier)
     except ValueError as e:
         raise ErrInvalidHeader(str(e)) from e
 
@@ -176,6 +202,8 @@ def verify(
     now_ns: int,
     max_clock_drift_ns: int,
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    *,
+    commit_verifier=None,
 ) -> None:
     """Dispatch adjacent vs non-adjacent (reference verifier.go:147-160)."""
     if untrusted_header.height != trusted_header.height + 1:
@@ -188,6 +216,7 @@ def verify(
             now_ns,
             max_clock_drift_ns,
             trust_level,
+            commit_verifier=commit_verifier,
         )
     else:
         verify_adjacent(
@@ -197,6 +226,7 @@ def verify(
             trusting_period_ns,
             now_ns,
             max_clock_drift_ns,
+            commit_verifier=commit_verifier,
         )
 
 
@@ -206,6 +236,8 @@ def verify_adjacent_range(
     trusting_period_ns: int,
     now_ns: int,
     max_clock_drift_ns: int,
+    *,
+    verify_fn=None,
 ) -> None:
     """Verify a whole window of consecutive light blocks at once.
 
@@ -215,6 +247,10 @@ def verify_adjacent_range(
     — N blocks × M signatures in a single XLA call, instead of the
     reference's per-header, per-signature loop (light/verifier.go:102-145
     called once per height from light/client.go:583+).
+
+    `verify_fn` overrides the commit-batch sink (contract of
+    batch_verify_commits) — the gateway routes it into its cross-client
+    coalescer so concurrent clients share flushes.
 
     Raises the same errors verify_adjacent would raise for the first
     offending block.
@@ -255,6 +291,6 @@ def verify_adjacent_range(
         )
         prev = lb
     try:
-        batch_verify_commits(jobs)
+        (verify_fn if verify_fn is not None else batch_verify_commits)(jobs)
     except ValueError as e:
         raise ErrInvalidHeader(str(e)) from e

@@ -86,9 +86,14 @@ OPTIONAL_TOP_PACKAGES = {"cryptography", "tomllib", "tomli", "hypothesis",
 JAX_ALLOWED_DIRS = {"ops", "parallel"}
 
 #: files that DEFINE the observability sinks: internal calls inside them
-#: are the implementation, not a call site
+#: are the implementation, not a call site.  Entries are bare filenames,
+#: or "dir/filename" when the bare name would collide with an unrelated
+#: module (gateway/cache.py vs mempool/cache.py — only the gateway
+#: files define sinks, the mempool cache is a plain call site).
 OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
-                           "txlife.py", "health.py", "remediate.py"}
+                           "txlife.py", "health.py", "remediate.py",
+                           "gateway/coalescer.py", "gateway/cache.py",
+                           "gateway/service.py"}
 
 #: label names that explode series cardinality on a real network
 HIGH_CARDINALITY_LABELS = {"height", "hash", "tx_hash", "block_hash",
@@ -134,7 +139,9 @@ class FileContext:
         parts = Path(display).parts
         self.in_consensus = "consensus" in parts
         self.jax_allowed = bool(JAX_ALLOWED_DIRS.intersection(parts))
-        self.obs_definition = path.name in OBSERVABILITY_DEF_FILES
+        self.obs_definition = (
+            path.name in OBSERVABILITY_DEF_FILES
+            or f"{path.parent.name}/{path.name}" in OBSERVABILITY_DEF_FILES)
         self._line_suppressions: dict[int, set[str]] = {}
         self._file_suppressions: set[str] = set()
         self._scan_suppressions(source)

@@ -348,6 +348,66 @@ class NodeMetrics:
             fn=lambda: node.remediate.active_samples(),
         ))
 
+        # -- light-client gateway (tendermint_tpu/gateway) --------------
+        # read-path serving counters scraped from the module-level
+        # gateway_stats() accessor: typed zeros until a gateway is
+        # active (TM_TPU_GATEWAY=1 or the standalone front end), and the
+        # scrape itself never builds one — the PR 2 NOP idiom.
+        from tendermint_tpu.gateway import gateway_stats as _gw_stats
+
+        def _gws(key: str):
+            return lambda: _gw_stats()[key]
+
+        self.gateway_clients = reg.register(Gauge(
+            "clients", "Light clients currently syncing through the gateway",
+            namespace=ns, subsystem="gateway", fn=_gws("clients"),
+        ))
+        self.gateway_verify_jobs = reg.register(CallbackCounter(
+            "verify_jobs_total",
+            "Commit-verify jobs submitted to the gateway coalescer",
+            namespace=ns, subsystem="gateway", fn=_gws("verify_jobs"),
+        ))
+        self.gateway_verify_coalesced = reg.register(CallbackCounter(
+            "verify_coalesced_total",
+            "Verify jobs that joined another client's in-flight twin "
+            "(cross-client sharing)",
+            namespace=ns, subsystem="gateway", fn=_gws("verify_coalesced"),
+        ))
+        self.gateway_verify_flushes = reg.register(CallbackCounter(
+            "verify_flushes_total",
+            "Coalesced batch_verify_commits flushes issued by the gateway",
+            namespace=ns, subsystem="gateway", fn=_gws("verify_flushes"),
+        ))
+        self.gateway_shed = reg.register(CallbackCounter(
+            "shed_total",
+            "Read-path verify jobs shed under verify-queue saturation",
+            namespace=ns, subsystem="gateway", fn=_gws("shed"),
+        ))
+        self.gateway_cache_hits = reg.register(CallbackCounter(
+            "cache_hits_total",
+            "Height-keyed response cache hits",
+            namespace=ns, subsystem="gateway", fn=_gws("cache_hits"),
+        ))
+        self.gateway_cache_misses = reg.register(CallbackCounter(
+            "cache_misses_total",
+            "Height-keyed response cache misses",
+            namespace=ns, subsystem="gateway", fn=_gws("cache_misses"),
+        ))
+        self.gateway_cache_invalidations = reg.register(CallbackCounter(
+            "cache_invalidations_total",
+            "Latest-tagged cache entries dropped on height advance",
+            namespace=ns, subsystem="gateway",
+            fn=_gws("cache_invalidations"),
+        ))
+        self.gateway_cache_entries = reg.register(Gauge(
+            "cache_entries", "Entries in the response cache",
+            namespace=ns, subsystem="gateway", fn=_gws("cache_entries"),
+        ))
+        self.gateway_cache_bytes = reg.register(Gauge(
+            "cache_bytes", "Bytes held by the response cache",
+            namespace=ns, subsystem="gateway", fn=_gws("cache_bytes"),
+        ))
+
         # -- latency histograms fed at their source ---------------------
         # Process-wide module singletons (the verify service, the FSM,
         # blocksync and RPC observe them where the timing happens); this

@@ -428,6 +428,26 @@ class Node:
         from tendermint_tpu.rpc.core import Environment
         from tendermint_tpu.rpc.server import RPCServer
 
+        # -- light-client gateway (TM_TPU_GATEWAY=1; tendermint_tpu/
+        # gateway): the read-path serving mode — height-keyed response
+        # cache on the hammered RPC routes, cross-client verify
+        # coalescing for in-process light clients, and shed-first
+        # degradation driven by the remediation controller's admission
+        # level.  Default OFF: every code path below stays bit-identical
+        # (no gateway object, the stock route table, no status block).
+        self.gateway = None
+        if os.environ.get("TM_TPU_GATEWAY", "0") == "1":
+            from tendermint_tpu import gateway as _gwmod
+            from tendermint_tpu.gateway.service import Gateway as _Gateway
+
+            self.gateway = _Gateway.from_env(
+                shed_fn=(self.remediate.shed_level
+                         if self.remediate.enabled else None),
+                remediate=self.remediate,
+                latest_height_fn=self.block_store.height,
+            )
+            _gwmod.set_active(self.gateway)
+
         self.rpc_env = Environment(
             config=config,
             genesis=genesis,
@@ -449,16 +469,27 @@ class Node:
             txlife=self.txlife,
             health=self.health,
             remediate=self.remediate,
+            gateway=self.gateway,
         )
         self.grpc_server = None
         self.pprof_server = None
         self.pprof_addr = None
+        gw_routes = None
+        if self.gateway is not None:
+            from tendermint_tpu.gateway.routes import wrap_cached_routes
+            from tendermint_tpu.rpc import core as _rpc_core
+
+            routes = dict(_rpc_core.ROUTES)
+            if getattr(config.rpc, "unsafe", False):
+                routes.update(_rpc_core.UNSAFE_ROUTES)
+            gw_routes = wrap_cached_routes(routes, self.gateway)
         self.rpc_server = RPCServer(
             self.rpc_env,
             logger=self.logger,
             max_body_bytes=config.rpc.max_body_bytes,
             max_open_connections=config.rpc.max_open_connections,
             cors_allowed_origins=config.rpc.cors_allowed_origins,
+            routes=gw_routes,
         )
         self.rpc_addr: tuple[str, int] | None = None
 
@@ -738,6 +769,12 @@ class Node:
             await self.pex_reactor.stop()
         await self.router.stop()
         await self.rpc_server.stop()
+        if self.gateway is not None:
+            from tendermint_tpu import gateway as _gwmod
+
+            self.gateway.close()
+            if _gwmod.active_gateway() is self.gateway:
+                _gwmod.clear_active()
         if self.grpc_server is not None:
             await self.grpc_server.stop()
         if self.metrics is not None:

@@ -52,6 +52,7 @@ class Environment:
         txlife=None,
         health=None,
         remediate=None,
+        gateway=None,
     ):
         self.config = config
         self.genesis = genesis
@@ -83,6 +84,10 @@ class Environment:
         from tendermint_tpu.utils import remediate as _remediate
 
         self.remediate = remediate if remediate is not None else _remediate.NOP
+        # light-client gateway (tendermint_tpu/gateway): None unless the
+        # node runs with TM_TPU_GATEWAY=1 — `status` then publishes the
+        # serving block (clients, cache hit ratio, dedup, shed state)
+        self.gateway = gateway
 
 
 def _latest_height(env: Environment) -> int:
@@ -160,7 +165,7 @@ def status(env: Environment) -> dict:
         if rs.validators is not None:
             _, val = rs.validators.get_by_address(pub.address())
             power = val.voting_power if val else 0
-    return {
+    out = {
         "node_info": {
             "id": env.node_id,
             "moniker": env.moniker,
@@ -187,6 +192,12 @@ def status(env: Environment) -> dict:
         "verify_service": _verify_service_status(),
         "health": _health_status_block(env),
     }
+    # gateway serving block, only when the node actually runs one —
+    # TM_TPU_GATEWAY=0 leaves the status document bit-identical
+    gw = getattr(env, "gateway", None)
+    if gw is not None:
+        out["gateway"] = gw.status_block()
+    return out
 
 
 def genesis(env: Environment) -> dict:

@@ -19,6 +19,10 @@ INTERNAL_ERROR = -32603
 # carries {code, num_txs, total_bytes, retry_after_ms} so clients can
 # distinguish backpressure (retry later) from faults (give up).
 MEMPOOL_FULL = -32001
+# the read-path twin: the gateway is shedding light-client verify work
+# while consensus saturates the verify queue.  `data` carries
+# {code: "backpressure", source: "gateway", shed_level, retry_after_ms}.
+GATEWAY_BACKPRESSURE = -32002
 
 
 class RPCError(Exception):

@@ -36,7 +36,16 @@ class SignedHeader:
         return self.header.hash() or b""
 
     def validate_basic(self, chain_id: str) -> None:
-        """reference types/light.go:141-175."""
+        """reference types/light.go:141-175.
+
+        Success is memoized per chain_id: a signed header is an
+        immutable trust bundle (constructed or wire-decoded once, never
+        mutated), and the gateway read path hands ONE shared object to
+        N syncing clients — each of whom would otherwise re-pay the
+        header merkle hash and the per-signature commit walk.  Only
+        success memoizes; a failing bundle re-raises on every call."""
+        if getattr(self, "_valid_for_chain", None) == chain_id:
+            return
         if self.header is None:
             raise ValueError("missing header")
         if self.commit is None:
@@ -58,6 +67,7 @@ class SignedHeader:
             raise ValueError(
                 f"commit signs block {chash.hex()}, header is block {hhash.hex()}"
             )
+        self._valid_for_chain = chain_id
 
     def encode(self) -> bytes:
         return (
@@ -102,7 +112,12 @@ class LightBlock:
 
     def validate_basic(self, chain_id: str) -> None:
         """reference types/light.go:60-84: both parts valid, and the
-        validator set must hash to the header's ValidatorsHash."""
+        validator set must hash to the header's ValidatorsHash.
+        Success memoized per chain_id (see SignedHeader.validate_basic:
+        light blocks are immutable, and the gateway shares one object
+        across N clients)."""
+        if getattr(self, "_valid_for_chain", None) == chain_id:
+            return
         if self.signed_header is None:
             raise ValueError("missing signed header")
         if self.validator_set is None:
@@ -113,14 +128,24 @@ class LightBlock:
             raise ValueError(
                 "expected validator hash of header to match validator set hash"
             )
+        self._valid_for_chain = chain_id
 
     def encode(self) -> bytes:
-        return (
-            ProtoWriter()
-            .message(1, self.signed_header.encode(), always=True)
-            .message(2, self.validator_set.encode(), always=True)
-            .bytes_out()
-        )
+        # memoized like validate_basic: a light block is immutable once
+        # built, and the gateway read path hands one object to N
+        # clients, each persisting it into its own trusted store — the
+        # proto encoding (dominated by the validator set) happens once
+        # per object, not once per client
+        enc = getattr(self, "_enc_cache", None)
+        if enc is None:
+            enc = (
+                ProtoWriter()
+                .message(1, self.signed_header.encode(), always=True)
+                .message(2, self.validator_set.encode(), always=True)
+                .bytes_out()
+            )
+            self._enc_cache = enc
+        return enc
 
     @classmethod
     def decode(cls, data: bytes) -> "LightBlock":

@@ -1,0 +1,630 @@
+"""Light-client gateway suite (tendermint_tpu/gateway): coalescer
+dedup/fan-out units, height-keyed response-cache semantics, structured
+backpressure under a saturated verify queue, HTTP-provider retry knobs,
+and the tier-1 acceptance test — ≥8 concurrent in-process light clients
+syncing a live node through the gateway with cross-client sharing
+proven by the coalesced counter."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.gateway import (
+    GatewayBackpressureError,
+    active_gateway,
+    clear_active,
+    gateway_stats,
+    set_active,
+)
+from tendermint_tpu.gateway.cache import ResponseCache
+from tendermint_tpu.gateway.coalescer import VerifyCoalescer, job_key
+from tendermint_tpu.gateway.client import LightGatewayClient
+from tendermint_tpu.gateway.service import Gateway
+from tendermint_tpu.gateway import testkit as tk
+from tendermint_tpu.light.provider import MemoryProvider
+
+CHAIN = "gw-test-chain"
+
+
+@pytest.fixture(autouse=True)
+def _isolate_gateway_state():
+    """Every test leaves no active gateway and no pinned-threshold
+    verify service behind (the PR 3 singleton-isolation lesson)."""
+    yield
+    clear_active()
+    from tendermint_tpu.crypto import async_verify as _av
+
+    _av.clear_service()
+
+
+def _jobs_for(blocks, heights, chain_id=CHAIN):
+    from tendermint_tpu.types.validator import CommitVerifyJob
+
+    return [
+        CommitVerifyJob(
+            val_set=blocks[h].validator_set,
+            chain_id=chain_id,
+            block_id=blocks[h].commit.block_id,
+            height=h,
+            commit=blocks[h].commit,
+            mode="light",
+        )
+        for h in heights
+    ]
+
+
+# ---------------------------------------------------------------------------
+# coalescer units
+# ---------------------------------------------------------------------------
+
+def test_coalescer_same_heights_single_flight():
+    """N clients submitting the SAME heights produce one flush set:
+    followers join the owner's in-flight futures instead of re-queueing."""
+    blocks = tk.make_chain(4, 2, CHAIN)
+    gate = threading.Event()
+    calls = []
+
+    def slow_verify(jobs):
+        calls.append([j.height for j in jobs])
+        assert gate.wait(10)
+
+    co = VerifyCoalescer(linger_ms=1.0, verify_fn=slow_verify)
+    jobs = _jobs_for(blocks, [2, 3, 4])
+    futs_a = co.submit_jobs(jobs)
+    # wait until the worker picked the batch up (it blocks inside
+    # slow_verify, keys still registered in the in-flight window)
+    deadline = time.monotonic() + 5
+    while co.stats_snapshot()["verify_flushes"] < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.001)
+    futs_b = co.submit_jobs(_jobs_for(blocks, [2, 3, 4]))
+    st = co.stats_snapshot()
+    assert st["verify_jobs"] == 6
+    assert st["verify_coalesced"] == 3          # the whole second client
+    gate.set()
+    assert all(f.result(10) for f in futs_a + futs_b)
+    assert calls == [[2, 3, 4]]                 # exactly one flush set
+    assert co.dedup_ratio() == 2.0
+    co.close()
+
+
+def test_coalescer_distinct_heights_merge_into_one_flush():
+    """Distinct heights from concurrent clients landing inside the
+    linger window merge into one batch_verify_commits flush."""
+    blocks = tk.make_chain(6, 2, CHAIN)
+    calls = []
+    co = VerifyCoalescer(linger_ms=50.0, verify_fn=lambda jobs: calls.append(
+        sorted(j.height for j in jobs)))
+    f1 = co.submit_jobs(_jobs_for(blocks, [1, 2, 3]))
+    f2 = co.submit_jobs(_jobs_for(blocks, [4, 5, 6]))
+    assert all(f.result(10) for f in f1 + f2)
+    assert calls == [[1, 2, 3, 4, 5, 6]]
+    st = co.stats_snapshot()
+    assert st["verify_flushes"] == 1
+    assert st["verify_flushed_jobs"] == 6
+    assert st["verify_coalesced"] == 0
+    co.close()
+
+
+def test_coalescer_failure_isolated_per_job():
+    """A bad commit poisons only its own waiters: the flush falls back
+    to per-job verification and resolves the rest True."""
+    blocks = tk.make_chain(3, 2, CHAIN)
+
+    def verify(jobs):
+        for j in jobs:
+            if j.height == 2:
+                raise ValueError(f"wrong signature in commit for height "
+                                 f"{j.height}")
+
+    co = VerifyCoalescer(linger_ms=5.0, verify_fn=verify)
+    futs = co.submit_jobs(_jobs_for(blocks, [1, 2, 3]))
+    assert futs[0].result(10) is True
+    with pytest.raises(ValueError, match="height 2"):
+        futs[1].result(10)
+    assert futs[2].result(10) is True
+    co.close()
+
+
+def test_job_key_discriminates_commit_content():
+    blocks = tk.make_chain(2, 2, CHAIN)
+    j1, j2 = _jobs_for(blocks, [1, 2])
+    assert job_key(j1) != job_key(j2)
+    assert job_key(j1) == job_key(_jobs_for(blocks, [1])[0])
+
+
+# ---------------------------------------------------------------------------
+# response cache semantics
+# ---------------------------------------------------------------------------
+
+def test_cache_pinned_below_tip_is_immutable():
+    c = ResponseCache()
+    c.store("commit", {"height": 3}, {"h": 3}, latest_height=5, pinned=True)
+    assert c.lookup("commit", {"height": 3}, 5) == {"h": 3}
+    assert c.lookup("commit", {"height": 3}, 9) == {"h": 3}  # survives advance
+    assert c.hits == 2 and c.invalidations == 0
+
+
+def test_cache_latest_tagged_invalidated_on_height_advance():
+    c = ResponseCache()
+    c.store("commit", {}, {"h": 5}, latest_height=5, pinned=False)
+    assert c.lookup("commit", {}, 5) == {"h": 5}
+    assert c.lookup("commit", {}, 6) is None      # tip moved: stale
+    assert c.invalidations == 1
+    assert c.lookup("commit", {}, 6) is None      # and it is GONE
+    assert c.misses == 2
+
+
+def test_cache_latest_ttl_bounds_staleness():
+    now = [0.0]
+    c = ResponseCache(latest_ttl_s=1.0, clock=lambda: now[0])
+    c.store("status", {}, {"ok": 1}, latest_height=5, pinned=False)
+    assert c.lookup("status", {}, 5) == {"ok": 1}
+    now[0] = 2.0
+    assert c.lookup("status", {}, 5) is None      # TTL expired at same tip
+
+
+def test_cache_lru_and_bytes_accounting():
+    c = ResponseCache(max_entries=2)
+    for i in range(3):
+        c.store("block", {"height": i}, {"i": i}, latest_height=9,
+                pinned=True)
+    st = c.stats_snapshot()
+    assert st["cache_entries"] == 2
+    assert c.lookup("block", {"height": 0}, 9) is None   # LRU-evicted
+    assert c.lookup("block", {"height": 2}, 9) == {"i": 2}
+    assert st["cache_bytes"] > 0
+
+
+def test_cache_param_order_is_canonical():
+    c = ResponseCache()
+    c.store("validators", {"height": 2, "page": 1}, {"v": 1},
+            latest_height=5, pinned=True)
+    assert c.lookup("validators", {"page": 1, "height": 2}, 5) == {"v": 1}
+
+
+# ---------------------------------------------------------------------------
+# cached route wrapper (node-embedded mounting)
+# ---------------------------------------------------------------------------
+
+def test_cached_routes_wrap_and_invalidate():
+    from tendermint_tpu.gateway.routes import wrap_cached_routes
+
+    tip = [5]
+    calls = {"commit": 0, "status": 0}
+
+    def commit(env, height=None):
+        calls["commit"] += 1
+        return {"height": height if height else tip[0]}
+
+    def status(env):
+        calls["status"] += 1
+        return {}
+
+    gw = Gateway(latest_height_fn=lambda: tip[0])
+    routes = wrap_cached_routes({"commit": commit, "status": status}, gw)
+    assert routes["status"] is status            # non-cacheable untouched
+
+    async def drive():
+        # explicit height below tip: second call served from cache
+        assert (await routes["commit"](None, height=3))["height"] == 3
+        assert (await routes["commit"](None, height=3))["height"] == 3
+        assert calls["commit"] == 1
+        # latest: cached at tip 5, invalidated when the tip advances
+        await routes["commit"](None)
+        await routes["commit"](None)
+        assert calls["commit"] == 2
+        tip[0] = 6
+        await routes["commit"](None)
+        assert calls["commit"] == 3
+        # the pinned entry survives the advance
+        assert (await routes["commit"](None, height=3))["height"] == 3
+        assert calls["commit"] == 3
+
+    asyncio.run(drive())
+    st = gw.stats()
+    assert st["cache_hits"] == 3 and st["cache_invalidations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# backpressure: saturated verify queue -> structured shed -> recovery
+# ---------------------------------------------------------------------------
+
+def test_backpressure_from_remediation_controller_and_recovery():
+    """Drive the REAL remediation controller with verify-queue-
+    saturation transitions: gateway clients receive the structured
+    backpressure error (with a retry hint, journaled by the
+    controller), then recover once the detector clears."""
+    from tendermint_tpu.utils.remediate import RemediationController
+
+    class _ShedSink:
+        def set_shed(self, level, rpc_max_bytes=0, retry_after_ms=0):
+            pass
+
+        def shed_state(self):
+            return {}
+
+    rc = RemediationController(mempool=_ShedSink(), retry_after_ms=250)
+    blocks = tk.make_chain(4, 2, CHAIN)
+    now_ns = tk.chain_now_ns(4)
+    gw = Gateway(shed_fn=rc.shed_level, remediate=rc, retry_after_ms=250)
+    driver = LightGatewayClient(
+        gw, CHAIN, tk.trust_root(blocks),
+        lambda i: MemoryProvider(CHAIN, dict(blocks)),
+        n_clients=1, now_fn=lambda: now_ns,
+    )
+
+    # detector escalates: verify queue saturated with consensus traffic
+    rc.act({"detector": "verify_queue_saturation", "from": 0, "to": 1,
+            "detail": "queue over high-water", "excused": False})
+    with pytest.raises(GatewayBackpressureError) as ei:
+        driver._build_client(0).verify_light_block_at_height(4)
+    err = ei.value
+    assert err.retry_after_ms == 250 and err.shed_level == 1
+    # the structured RPC mapping (what a remote client would receive)
+    rpc_err = err.rpc_error()
+    from tendermint_tpu.rpc.jsonrpc import GATEWAY_BACKPRESSURE
+
+    assert rpc_err.code == GATEWAY_BACKPRESSURE
+    assert rpc_err.data["code"] == "backpressure"
+    assert rpc_err.data["source"] == "gateway"
+    assert rpc_err.data["retry_after_ms"] == 250
+    # the shed is journaled in the remediation event history
+    events = rc.report()["events"]
+    assert any(ev["trigger"] == "gateway_shed" for ev in events)
+    assert gw.stats()["shed"] > 0
+
+    # detector clears: the same client protocol succeeds
+    rc.act({"detector": "verify_queue_saturation", "from": 1, "to": 0,
+            "detail": "cleared", "excused": False})
+    lc = driver._build_client(0)
+    lc.verify_light_block_at_height(4)
+    assert lc.last_trusted_height() == 4
+    gw.close()
+
+
+def test_backpressure_retry_loop_recovers():
+    """A driver configured to honor retry_after_ms rides out a shed
+    window without surfacing an error (the client-side protocol)."""
+    blocks = tk.make_chain(3, 2, CHAIN)
+    now_ns = tk.chain_now_ns(3)
+    level = [1]
+    gw = Gateway(shed_fn=lambda: level[0], retry_after_ms=20)
+    driver = LightGatewayClient(
+        gw, CHAIN, tk.trust_root(blocks),
+        lambda i: MemoryProvider(CHAIN, dict(blocks)),
+        n_clients=1, backpressure_retries=5, now_fn=lambda: now_ns,
+    )
+
+    def clear_soon():
+        time.sleep(0.03)
+        level[0] = 0
+
+    threading.Thread(target=clear_soon, daemon=True).start()
+    rep = driver.sync_all(target_height=3)
+    assert rep["all_ok"], rep
+    assert rep["clients"][0]["backpressure_retries"] >= 1
+    gw.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP provider: timeout + capped-exponential retry knobs
+# ---------------------------------------------------------------------------
+
+def test_http_provider_retries_with_jittered_ladder(monkeypatch):
+    from tendermint_tpu.light.http_provider import HTTPProvider
+
+    sleeps = []
+    p = HTTPProvider(CHAIN, "http://unreachable.invalid", timeout=0.5,
+                     retries=3, backoff_base_s=0.1, backoff_cap_s=0.25,
+                     sleep=sleeps.append)
+    attempts = []
+
+    def flaky(path):
+        attempts.append(path)
+        if len(attempts) < 3:
+            raise OSError("connection refused")
+        return {"result": {"ok": True}}
+
+    monkeypatch.setattr(p, "_fetch", flaky)
+    assert p._get("/x") == {"ok": True}
+    assert len(attempts) == 3          # 2 failures + 1 success
+    assert len(sleeps) == 2
+    # DialBackoff jitter idiom: delay in [0.5x, 1.0x] of min(cap, base*2^n)
+    assert 0.05 <= sleeps[0] <= 0.1
+    assert 0.1 <= sleeps[1] <= 0.2
+
+
+def test_http_provider_exhausted_retries_raise_no_response(monkeypatch):
+    from tendermint_tpu.light.errors import ErrNoResponse
+    from tendermint_tpu.light.http_provider import HTTPProvider
+
+    sleeps = []
+    p = HTTPProvider(CHAIN, "http://unreachable.invalid", retries=2,
+                     backoff_base_s=0.01, sleep=sleeps.append)
+    calls = []
+    monkeypatch.setattr(
+        p, "_fetch",
+        lambda path: (_ for _ in ()).throw(OSError("down")) if not
+        calls.append(path) else None)
+    with pytest.raises(ErrNoResponse, match="after 3 attempts"):
+        p._get("/commit")
+    assert len(calls) == 3 and len(sleeps) == 2
+
+
+def test_http_provider_rpc_level_errors_never_retry(monkeypatch):
+    """The upstream ANSWERED (an error document): retrying would not
+    change the answer, so the ladder must not engage."""
+    from tendermint_tpu.light.errors import ErrLightBlockNotFound
+    from tendermint_tpu.light.http_provider import HTTPProvider
+
+    sleeps = []
+    p = HTTPProvider(CHAIN, "http://x.invalid", retries=3,
+                     sleep=sleeps.append)
+    calls = []
+
+    def not_found(path):
+        calls.append(path)
+        return {"error": {"message": "height 99 not found", "data": ""}}
+
+    monkeypatch.setattr(p, "_fetch", not_found)
+    with pytest.raises(ErrLightBlockNotFound):
+        p._get("/commit?height=99")
+    assert len(calls) == 1 and not sleeps
+
+
+def test_http_provider_timeout_knob_reaches_urlopen(monkeypatch):
+    from tendermint_tpu.light import http_provider as hp
+
+    seen = {}
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return json.dumps({"result": {}}).encode()
+
+    def fake_urlopen(url, timeout=None):
+        seen["timeout"] = timeout
+        return _Resp()
+
+    monkeypatch.setattr(hp.urllib.request, "urlopen", fake_urlopen)
+    p = hp.HTTPProvider(CHAIN, "http://x.invalid", timeout=3.25, retries=0)
+    p._get("/status")
+    assert seen["timeout"] == 3.25
+
+
+# ---------------------------------------------------------------------------
+# fan-out through the gateway (in-process, synthetic chain)
+# ---------------------------------------------------------------------------
+
+def test_fanout_dedup_and_cache_sharing():
+    """6 clients, same chain: verify work collapses to ~one client's
+    worth (dedup ratio == N) and the height-keyed cache serves N-1 of
+    every N block fetches."""
+    n, heights = 6, 6
+    blocks = tk.make_chain(heights, 4, CHAIN)
+    now_ns = tk.chain_now_ns(heights)
+    gw = Gateway()
+    base = MemoryProvider(CHAIN, dict(blocks))
+    driver = LightGatewayClient(
+        gw, CHAIN, tk.trust_root(blocks),
+        lambda i: tk.CachedProvider(base, gw.cache, heights),
+        n_clients=n, now_fn=lambda: now_ns,
+    )
+    rep = driver.sync_all(target_height=heights)
+    assert rep["all_ok"], rep
+    for c in rep["clients"]:
+        assert c["trusted_height"] == heights
+    st = rep["gateway"]
+    assert st["verify_jobs"] == n * (heights - 1)
+    assert st["verify_flushed_jobs"] == heights - 1     # one client's worth
+    assert st["verify_coalesced"] == (n - 1) * (heights - 1)
+    assert st["verify_dedup_ratio"] == float(n)
+    assert st["cache_hit_ratio"] > 0.5
+    gw.close()
+
+
+def test_gateway_stats_module_accessor():
+    assert gateway_stats()["clients"] == 0        # typed zeros when off
+    gw = Gateway()
+    set_active(gw)
+    try:
+        assert active_gateway() is gw
+        blocks = tk.make_chain(2, 2, CHAIN)
+        gw.verify_commits(_jobs_for(blocks, [1, 2]))
+        st = gateway_stats()
+        assert st["verify_jobs"] == 2
+        assert st["verify_flushes"] >= 1
+    finally:
+        gw.close()
+        clear_active()
+    assert gateway_stats()["verify_jobs"] == 0
+
+
+def test_skipping_mode_routes_through_coalescer():
+    """SKIPPING-mode verification also funnels its commit jobs through
+    the gateway seam (verify_non_adjacent's commit_verifier)."""
+    from tendermint_tpu.light.client import Client, SKIPPING
+
+    heights = 6
+    blocks = tk.make_chain(heights, 4, CHAIN)
+    now_ns = tk.chain_now_ns(heights)
+    gw = Gateway()
+    lc = Client(
+        chain_id=CHAIN,
+        trust_options=tk.trust_root(blocks),
+        primary=MemoryProvider(CHAIN, dict(blocks)),
+        witnesses=[],
+        mode=SKIPPING,
+        now_fn=lambda: now_ns,
+        commit_verifier=gw.verify_commits,
+    )
+    lc.verify_light_block_at_height(heights)
+    assert lc.last_trusted_height() == heights
+    assert gw.stats()["verify_jobs"] >= 1
+    gw.close()
+
+
+# ---------------------------------------------------------------------------
+# standalone front end: forwarded + cached routes over a canned primary
+# ---------------------------------------------------------------------------
+
+def test_frontend_proxy_caches_and_overlays_status():
+    import http.server
+
+    upstream_hits = []
+
+    class Primary(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            upstream_hits.append(self.path)
+            if self.path.startswith("/commit"):
+                h = 3 if "height=3" in self.path else 7
+                doc = {"result": {"signed_header": {
+                    "header": {"height": str(h)}, "commit": {}},
+                    "canonical": h < 7}}
+            elif self.path.startswith("/status"):
+                doc = {"result": {"sync_info":
+                                  {"latest_block_height": "7"}}}
+            else:
+                doc = {"result": {}}
+            body = json.dumps(doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Primary)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    async def drive():
+        from tendermint_tpu.gateway.frontend import GatewayProxy
+
+        proxy = GatewayProxy(f"http://127.0.0.1:{srv.server_address[1]}")
+        host, port = await proxy.start("127.0.0.1", 0)
+        base = f"http://{host}:{port}"
+
+        def get(url):
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())["result"]
+
+        # status forwards, feeds the tip watermark, overlays the block
+        st = await asyncio.to_thread(get, f"{base}/status")
+        assert st["gateway"]["enabled"] is True
+        assert proxy.gateway.latest_height() == 7
+        # an explicit height below the tip: second read never reaches
+        # the primary (pinned cache entry)
+        before = len(upstream_hits)
+        for _ in range(3):
+            doc = await asyncio.to_thread(get, f"{base}/commit?height=3")
+            assert doc["signed_header"]["header"]["height"] == "3"
+        assert len(upstream_hits) == before + 1
+        assert proxy.gateway.stats()["cache_hits"] >= 2
+        await proxy.stop()
+
+    try:
+        asyncio.run(drive())
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 acceptance: >=8 concurrent clients sync a LIVE node through
+# the node-embedded gateway (TM_TPU_GATEWAY=1)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cpu_backend():
+    from tendermint_tpu.crypto.batch import set_default_backend
+
+    set_default_backend("cpu")
+    yield
+    set_default_backend("auto")
+
+
+def test_gateway_fanout_against_live_node(tmp_path, monkeypatch, cpu_backend):
+    from tendermint_tpu.config import test_config as make_test_config
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+    from tendermint_tpu.light.client import TrustOptions
+    from tendermint_tpu.light.http_provider import HTTPProvider
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    monkeypatch.setenv("TM_TPU_GATEWAY", "1")
+    n_clients = 8
+
+    async def run():
+        key = priv_key_from_seed(b"\x66" * 32)
+        gen = GenesisDoc(
+            chain_id="gw-live-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.base.fast_sync = False
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = key
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        try:
+            assert node.gateway is not None
+            await node.wait_for_height(3, timeout=30)
+            host, port = node.rpc_addr
+            base = f"http://{host}:{port}"
+            tip = node.block_store.height()
+
+            def _get(url):
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    doc = json.loads(r.read())
+                if "error" in doc:
+                    raise RuntimeError(doc["error"])
+                return doc["result"]
+
+            # trust root: the commit at height 1, fetched over RPC
+            c1 = await asyncio.to_thread(_get, f"{base}/commit?height=1")
+            trusted_hash = bytes.fromhex(
+                c1["signed_header"]["commit"]["block_id"]["hash"])
+            # block 1 carries the genesis timestamp; a generous period
+            # keeps the synthetic root of trust valid under wall clock
+            trust = TrustOptions(period_ns=10 * 365 * 86400 * 10**9,
+                                 height=1, hash=trusted_hash)
+
+            driver = LightGatewayClient(
+                node.gateway, "gw-live-chain", trust,
+                lambda i: HTTPProvider("gw-live-chain", base,
+                                       timeout=10.0, retries=2),
+                n_clients=n_clients,
+            )
+            rep = await asyncio.to_thread(driver.sync_all, tip, 60.0)
+            assert rep["all_ok"], rep
+            for c in rep["clients"]:
+                assert c["trusted_height"] >= tip    # every client at tip
+            st = rep["gateway"]
+            # cross-client sharing, the acceptance signal: the counter
+            # behind tendermint_gateway_verify_coalesced_total
+            assert st["verify_coalesced"] > 0
+            assert st["verify_dedup_ratio"] > 1.0
+            assert gateway_stats()["verify_coalesced"] > 0  # node is active
+            # the cached RPC routes served the repeat reads
+            assert st["cache_hits"] > 0
+            # status publishes the gateway serving block
+            status = await asyncio.to_thread(_get, f"{base}/status")
+            assert status["gateway"]["enabled"] is True
+            assert status["gateway"]["verify_coalesced"] > 0
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
