@@ -519,6 +519,37 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["gateway_fanout_error"] = str(e)[-300:]
 
+        # -- fleet scrape (round 14, ISSUE 14): cluster-scope
+        # observability overhead — scrape+aggregate+SLO wall time over a
+        # LIVE 4-node localnet (real Node objects, RPC + metrics
+        # listeners) via the shared fleet/testkit.py harness, the same
+        # one behind the tests/test_fleet.py acceptance.  The scraper
+        # fans out over a thread pool, so the budget tracks the slowest
+        # NODE, not the node count — p50 of 5 scrape+aggregate+evaluate
+        # cycles vs a 2s budget.  Placed before the device stages (the
+        # r05 tail-loss lesson) and budgeted so the device pipeline
+        # keeps its reserve.
+        _stage_set("fleet-scrape")
+        try:
+            budget = min(60.0, _deadline_left() - 200.0)
+            if budget < 30:
+                raise RuntimeError("skipped: %.0fs left" % _deadline_left())
+            from tendermint_tpu.fleet.testkit import run_fleet_bench
+
+            fl_rep = run_fleet_bench()
+            _partial.update({
+                "fleet_nodes": fl_rep["nodes"],
+                "fleet_scrape_ms": fl_rep["scrape_ms_p50"],
+                "fleet_scrape_max_ms": fl_rep["scrape_ms_max"],
+                "fleet_scrape_within_budget": fl_rep["within_budget"],
+                "fleet_availability": fl_rep["availability"],
+                "fleet_slo_ok": fl_rep["slo_ok"],
+                "fleet_rows_scraped": fl_rep["rows_ok"],
+                "fleet_finality_observations": fl_rep["finality_count"],
+            })
+        except Exception as e:  # noqa: BLE001
+            _partial["fleet_scrape_error"] = str(e)[-300:]
+
         # -- impl shootout (round 9, ISSUE 12): the field-representation
         # comparison int64 vs packed vs f32(+MXU where the golden gate
         # validates it) on ONE rung, timed side by side, with each

@@ -59,7 +59,7 @@ META_KEYS = {
     "async_stream_rounds", "simnet_nodes", "simnet_validator_slots",
     "benchdiff_base", "benchdiff_regressions", "benchdiff_missing",
     "benchdiff_ok", "shootout_rung", "shootout_n", "shootout_runs",
-    "gateway_clients",
+    "gateway_clients", "fleet_nodes",
 }
 
 # Ordered (pattern, class, direction) — first match wins.  direction
@@ -70,6 +70,10 @@ _CLASS_RULES = (
     # efficiency ratios where higher is better: the gateway's
     # cross-client verify dedup and cache hit ratios, batch occupancy
     (re.compile(r"_ratio$"), "ratio", "higher"),
+    # fleet-scope serving fraction (fleet-scrape stage / SLO layer):
+    # a drop means nodes stopped answering their RPC — same class and
+    # direction as the ratios above, named per the SLO vocabulary
+    (re.compile(r"_availability$"), "ratio", "higher"),
     (re.compile(r"^(value|vs_baseline)$"), "throughput", "higher"),
     (re.compile(r"(_ok|_within_budget|_warmed|plan_warmed)$"),
      "boolean", "higher"),

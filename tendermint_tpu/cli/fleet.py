@@ -1,0 +1,170 @@
+"""`tendermint-tpu fleet` — cluster dashboard + SLO verdicts over N nodes.
+
+The fleet-scope twin of `top`: scrape every node's RPC `status` and
+`/metrics` concurrently (per-node timeouts; an unreachable node is a
+degraded row and an availability datapoint, never a crash), merge the
+series into fleet rollups (tendermint_tpu/fleet/aggregate.py — summed
+histograms, occupancy, compile sources, gateway ratios, health
+rollup), and evaluate the result against a declarative `slo.toml`
+(fleet/slo.py) with fast/slow dual-window burn rates.
+
+`--watch` repaints like `top` and accumulates burn history across
+frames (sigs/s comes from counter deltas); `--once` prints one frame;
+`--once --json` emits the raw fleet snapshot + SLO verdict for
+scripting.  Exit-code contract (cron/CI gates):
+  0  every objective ok (or no-data without require_data)
+  1  at least one objective at warn
+  2  at least one objective BURNING (or required data missing)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from tendermint_tpu.fleet import (
+    BurnEngine,
+    aggregate,
+    default_objectives,
+    evaluate,
+    load_slo,
+    parse_target,
+    scrape_fleet,
+)
+
+_LEVELS = ("ok", "WARN", "CRITICAL")
+
+
+def _v(x, fmt="{}"):
+    return fmt.format(x) if x is not None else "-"
+
+
+def _lat(cell) -> str:
+    if not cell:
+        return "-"
+    def q(k):
+        v = cell.get(k)
+        return f"≤{1e3 * v:.0f}ms" if v is not None else "-"
+    return f"n={cell['count']} p50{q('p50_s')} p95{q('p95_s')} p99{q('p99_s')}"
+
+
+def render(fleet: dict) -> str:
+    av = fleet["availability"]
+    hb = fleet["height"]
+    slo = fleet.get("slo") or {}
+    when = time.strftime("%H:%M:%S", time.localtime(fleet["ts"]))
+    head_state = (slo.get("state") or "no-data").upper()
+    lines = [
+        f"tendermint-tpu fleet — {av['total']} nodes"
+        f"  serving {av['serving']}/{av['total']}"
+        f"  height {_v(hb['min'])}..{_v(hb['max'])}"
+        f"  slo {head_state}  {when}",
+        f"{'node':<12} {'state':<9} {'height':>7} {'rnd':>4} "
+        f"{'health':<22} {'queue':>6} {'shed':>4} {'scrape':>8}",
+    ]
+    for n in fleet["nodes"]:
+        state = "ok" if n["rpc_ok"] else ("degraded" if n["ok"] else "DOWN")
+        health = "-"
+        if n["health_level"] is not None:
+            health = _LEVELS[min(2, n["health_level"])]
+            if n["worst_detector"]:
+                health += f" [{n['worst_detector']}]"
+        lines.append(
+            f"{n['name']:<12} {state:<9} {_v(n['height']):>7} "
+            f"{_v(n['round']):>4} {health:<22} {_v(n['queue_depth']):>6} "
+            f"{_v(n['shed_level']):>4} {_v(n['scrape_ms'], '{}ms'):>8}")
+
+    h = fleet["histograms"]
+    lines.append(f"latency    finality {_lat(h.get('finality'))}"
+                 f"  rpc {_lat(h.get('rpc'))}")
+    qw = {k: v for k, v in (("prevote", h.get("quorum_wait_prevote")),
+                            ("precommit", h.get("quorum_wait_precommit")))
+          if v}
+    if qw or h.get("residency"):
+        extra = "  ".join(f"{k} {_lat(v)}" for k, v in qw.items())
+        lines.append(f"           residency {_lat(h.get('residency'))}"
+                     + (f"  quorum-wait {extra}" if extra else ""))
+
+    verify = fleet["verify"]
+    ratio = verify.get("cache_hit_ratio")
+    lines.append(
+        f"verify     submitted {_v(verify['submitted_total'])}"
+        f"  sigs/s {_v(verify['sigs_per_s'])}"
+        f"  queue max {_v(verify['queue_depth_max'])}"
+        f" (sum {_v(verify['queue_depth_sum'])})"
+        f"  cache-hit {_v(ratio if ratio is None else round(100 * ratio, 1), '{}%')}")
+    if fleet["occupancy"]:
+        otxt = "  ".join(f"{rung}:{d['flushes']}x@{d['mean_ratio']}"
+                         for rung, d in fleet["occupancy"].items())
+        lines.append(f"occupancy  {otxt}")
+    comp = fleet["compile"]
+    stxt = "  ".join(f"{k}:{v}" for k, v in comp["sources"].items())
+    cold = comp["cold_total"]
+    lines.append(
+        f"compile    {comp['total']} programs  {comp['seconds_total']}s"
+        f"  cold {cold}"
+        + (f"  COLD ON {sorted(comp['cold_by_node'])}" if cold else "")
+        + (f"  [{stxt}]" if stxt else ""))
+    gw = fleet["gateway"]
+    if gw.get("enabled"):
+        ghr = gw.get("cache_hit_ratio")
+        lines.append(
+            f"gateway    nodes {len(gw.get('nodes') or [])}"
+            f"  clients {_v(gw.get('clients'))}"
+            f"  cache-hit {_v(ghr if ghr is None else round(100 * ghr, 1), '{}%')}"
+            f"  dedup {_v(gw.get('dedup_ratio'), '{}x')}"
+            f"  shed {_v(gw.get('shed_total'))}")
+    hl = fleet["health"]
+    if hl["level"] is not None:
+        lines.append(f"health     {_LEVELS[min(2, hl['level'])]}"
+                     + (f"  worst {hl['worst']}" if hl["worst"] else "")
+                     + (f"  slo-burns {hl['slo_burns_total']}"
+                        if hl.get("slo_burns_total") else ""))
+
+    for o in slo.get("objectives", []):
+        mark = {"ok": "  ", "no-data": " .", "warn": " !",
+                "burning": "!!"}[o["state"]]
+        burn = ""
+        if o["burn_fast"] is not None or o["burn_slow"] is not None:
+            burn = (f"  burn {_v(o['burn_fast'])}x/"
+                    f"{_v(o['burn_slow'])}x")
+        lines.append(
+            f"slo     {mark} {o['name']:<22} {o['state']:<8}"
+            f" {_v(o['value'])} {o['bound']}{burn}")
+    for err in fleet["errors"]:
+        lines.append(f"! {err}")
+    return "\n".join(lines) + "\n"
+
+
+def run_fleet(node_specs: list[str], *, slo_path: str = "",
+              watch: bool = False, once: bool = False, as_json: bool = False,
+              interval: float = 2.0, timeout: float = 2.0) -> int:
+    try:
+        targets = [parse_target(s, i) for i, s in enumerate(node_specs)]
+        objectives = load_slo(slo_path) if slo_path else default_objectives()
+    except (OSError, ValueError, ImportError, TypeError) as e:
+        print(f"fleet: {e}", file=sys.stderr)
+        return 3
+    engine = BurnEngine()
+    prev = None
+    rc = 0
+    try:
+        while True:
+            rows = scrape_fleet(targets, timeout=timeout)
+            fleet = aggregate(rows, prev=prev)
+            fleet["slo"] = evaluate(objectives, fleet, engine=engine)
+            rc = fleet["slo"]["exit_code"]
+            prev = fleet
+            if as_json:
+                sys.stdout.write(json.dumps(fleet) + "\n")
+            elif once or not watch:
+                sys.stdout.write(render(fleet))
+            else:
+                sys.stdout.write("\x1b[H\x1b[2J" + render(fleet))
+            sys.stdout.flush()
+            if not watch:
+                return rc
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return rc

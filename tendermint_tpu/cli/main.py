@@ -909,6 +909,19 @@ def cmd_top(args) -> int:
                    as_json=args.json, timeout=args.timeout)
 
 
+def cmd_fleet(args) -> int:
+    """Cluster dashboard + SLO verdicts over N nodes (cli/fleet.py):
+    concurrent status+/metrics scrapes with per-node degradation,
+    fleet-merged histograms/occupancy/compile/gateway/health rollups,
+    and slo.toml burn-rate evaluation.  Exit 0 ok / 1 warn / 2 burning
+    / 3 usage error (docs/fleet.md)."""
+    from tendermint_tpu.cli.fleet import run_fleet
+
+    return run_fleet(args.nodes, slo_path=args.slo, watch=args.watch,
+                     once=args.once, as_json=args.json,
+                     interval=args.interval, timeout=args.timeout)
+
+
 def cmd_health(args) -> int:
     """One node's health-watchdog verdict over RPC (cli/health.py):
     per-detector status table or JSON, `--watch` refresh loop.  Exit 0
@@ -1197,6 +1210,32 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="emit the snapshot as JSON (implies one frame)")
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser(
+        "fleet",
+        help="cluster dashboard + SLO burn-rate verdicts over N nodes "
+             "(exit 0 ok / 1 warn / 2 burning)")
+    sp.add_argument("nodes", nargs="+",
+                    help="one spec per node: [name=]rpc_addr[,metrics_addr] "
+                         "(e.g. node0=127.0.0.1:26657,127.0.0.1:26660); "
+                         "omitting the metrics addr scrapes RPC only")
+    sp.add_argument("--slo", default="",
+                    help="slo.toml/.json objectives file (default: a "
+                         "minimal availability objective; docs/fleet.md)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds for --watch")
+    sp.add_argument("--timeout", type=float, default=2.0,
+                    help="per-node per-request HTTP timeout")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (the default; kept "
+                         "for scripting symmetry with top)")
+    sp.add_argument("--watch", action="store_true",
+                    help="refresh every --interval seconds until "
+                         "interrupted (burn rates accumulate across "
+                         "frames)")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the fleet snapshot + SLO verdict as JSON")
+    sp.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser(
         "health",

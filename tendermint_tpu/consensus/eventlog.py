@@ -95,6 +95,10 @@ EVENT_TYPES = (
     "remediation_retune",  # occupancy-fed shape-plan retune: rungs
     "remediation_evict",   # flapping peer evicted + quarantined: peer
     "remediation_pardon",  # quarantine expired, ladder reset: peer
+    # fleet-scope SLO pressure (fleet/slo.py): the fleet layer told this
+    # node an objective's error budget is burning.  Carries objective,
+    # value (the failing measurement), detail.
+    "slo_burn",
 )
 
 # Rotation/pruning checks stat() files, so they are amortized — but on a

@@ -1,0 +1,40 @@
+"""Fleet-scope observability: multi-node aggregation + SLO burn rates.
+
+Every observability surface built in PRs 2-13 (metrics, health
+detectors, txtrace, `top`, journals) answers questions about exactly
+ONE node.  This package is the layer above: scrape N nodes' `/metrics`
+and RPC `status` concurrently with per-node timeouts (`scrape`), merge
+the per-node series into fleet rollups — summed Prometheus histograms
+for finality/residency/quorum-wait/RPC latency, fleet verify totals,
+per-rung occupancy, compile-source and health rollups (`aggregate`) —
+and evaluate the merged snapshot against a declarative `slo.toml` with
+Google-SRE-style fast/slow dual-window burn rates (`slo`).
+
+Degradation is the design center: an unreachable node becomes a
+degraded row and an availability datapoint, never a crash — the fleet
+view must be at its best exactly when the fleet is at its worst.
+
+Surfaces: `tendermint-tpu fleet` (cli/fleet.py — live dashboard,
+`--once --json` snapshots, exit 0/1/2 = ok/warn/burning for cron/CI),
+the `fleet-scrape` bench stage (`testkit`), and simnet verdicts' `fleet`
+block (the runner samples availability and runs the same SLO engine
+over its SimNodes).  docs/fleet.md has the schema and worked examples.
+"""
+
+from .aggregate import aggregate
+from .scrape import NodeTarget, parse_target, scrape_fleet, scrape_node
+from .slo import (
+    BurnEngine,
+    Objective,
+    default_objectives,
+    evaluate,
+    load_slo,
+    objectives_from_doc,
+)
+
+__all__ = [
+    "NodeTarget", "parse_target", "scrape_node", "scrape_fleet",
+    "aggregate",
+    "Objective", "BurnEngine", "load_slo", "objectives_from_doc",
+    "default_objectives", "evaluate",
+]

@@ -1,0 +1,246 @@
+"""Merge N per-node scrapes into one fleet snapshot.
+
+The merge is exact where Prometheus semantics make it exact: histogram
+bucket/sum/count series and counters are additive across instances
+(the standard `sum by (le)` aggregation), so the fleet-level
+finality/residency/quorum-wait/RPC-latency distributions come from
+`promparse.merge_samples` + `hist_summary` over the union of every
+reachable node's exposition — NOT from averaging per-node percentiles,
+which is statistically meaningless.  Capacity gauges (queue depths)
+aggregate as sum AND max; identity gauges (height, round) as min/max
+spread.
+
+Unreachable nodes contribute a degraded row and the availability
+denominator; they are excluded from the merged series (no data is no
+data) but never fail the aggregate.
+
+`sigs/s` needs a rate, which one snapshot cannot carry — pass the
+previous aggregate as `prev` (the dashboard's refresh loop and the
+simnet sampler both do) and the counter deltas produce
+`verify.sigs_per_s` over the inter-snapshot interval.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tendermint_tpu.utils import promparse
+
+#: fleet-merged histogram panel: alias -> (series base, label match)
+HISTOGRAMS = {
+    "finality": ("tendermint_tx_time_to_finality_seconds", None),
+    "residency": ("tendermint_mempool_residency_seconds", None),
+    "quorum_wait_prevote": ("tendermint_consensus_quorum_wait_seconds",
+                            {"type": "prevote"}),
+    "quorum_wait_precommit": ("tendermint_consensus_quorum_wait_seconds",
+                              {"type": "precommit"}),
+    "rpc": ("tendermint_rpc_request_duration_seconds", None),
+}
+
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _worst_detector(snap: dict) -> tuple[str | None, int]:
+    """(name, level) of the worst-firing health detector in a node
+    snapshot; (None, 0) when healthy or unknown."""
+    hl = (snap or {}).get("health") or {}
+    dets = hl.get("detectors") or {}
+    worst, level = None, 0
+    for name, lvl in sorted(dets.items()):
+        if int(lvl) > level:
+            worst, level = name, int(lvl)
+    return worst, level
+
+
+def _node_row(row: dict) -> dict:
+    snap = row.get("snap") or {}
+    verify = snap.get("verify") or {}
+    rem = snap.get("remediation") or {}
+    worst, level = _worst_detector(snap)
+    hl = (snap.get("health") or {}).get("level")
+    return {
+        "name": row["name"],
+        "ok": bool(row.get("ok")),
+        "rpc_ok": bool(row.get("rpc_ok")),
+        "scrape_ms": row.get("scrape_ms"),
+        "height": snap.get("height"),
+        "round": snap.get("round"),
+        "catching_up": (snap.get("node") or {}).get("catching_up"),
+        "health_level": int(hl) if hl is not None else None,
+        "worst_detector": worst if level else None,
+        "queue_depth": verify.get("queue_depth"),
+        "shed_level": rem.get("shed_level") if rem.get("enabled") else 0,
+        "peers": (snap.get("peers") or {}).get("count"),
+        "errors": list(row.get("errors") or []),
+    }
+
+
+def aggregate(rows: list[dict], prev: dict | None = None) -> dict:
+    """Fleet snapshot from `scrape_fleet` rows (see module docstring).
+    `prev` (the previous aggregate) turns cumulative verify counters
+    into `verify.sigs_per_s`."""
+    now = time.time()
+    nodes = [_node_row(r) for r in rows]
+    total = len(rows)
+    reachable = sum(1 for n in nodes if n["ok"])
+    serving = sum(1 for n in nodes if n["rpc_ok"])
+
+    heights = [n["height"] for n in nodes if n["height"] is not None]
+    merged = promparse.merge_samples(
+        [r["samples"] for r in rows if r.get("samples")])
+    by_name = promparse.index_samples(merged)
+
+    hists = {alias: promparse.hist_summary(by_name, base, match=match,
+                                           quantiles=QUANTILES)
+             for alias, (base, match) in HISTOGRAMS.items()}
+
+    # verify rollup: counters sum exactly; queue depth reports sum+max
+    submitted = promparse.scalar(
+        by_name, "tendermint_crypto_verify_submitted_total")
+    hits = promparse.scalar(
+        by_name, "tendermint_crypto_verify_cache_hits_total", 0) or 0
+    misses = promparse.scalar(
+        by_name, "tendermint_crypto_verify_cache_misses_total", 0) or 0
+    depths = [n["queue_depth"] for n in nodes
+              if n["queue_depth"] is not None]
+    verify = {
+        "submitted_total": int(submitted) if submitted is not None else None,
+        "flushes_total": _int_scalar(
+            by_name, "tendermint_crypto_verify_flushes_total"),
+        "device_batches_total": _int_scalar(
+            by_name, "tendermint_crypto_verify_device_batches_total"),
+        "padding_rows_total": _int_scalar(
+            by_name, "tendermint_crypto_verify_padding_rows_total"),
+        "queue_depth_sum": sum(depths) if depths else None,
+        "queue_depth_max": max(depths) if depths else None,
+        "cache_hit_ratio": round(hits / (hits + misses), 4)
+        if (hits + misses) else None,
+        "sigs_per_s": None,
+    }
+    if prev is not None and submitted is not None:
+        p_sub = (prev.get("verify") or {}).get("submitted_total")
+        dt = now - prev.get("ts", now)
+        if p_sub is not None and dt > 0 and submitted >= p_sub:
+            verify["sigs_per_s"] = round((submitted - p_sub) / dt, 1)
+
+    # per-rung occupancy across the fleet: histogram sum/count merge
+    occupancy: dict[str, dict] = {}
+    counts = {l.get("rung", "?"): v for l, v in by_name.get(
+        "tendermint_crypto_verify_batch_occupancy_ratio_count", [])}
+    sums = {l.get("rung", "?"): v for l, v in by_name.get(
+        "tendermint_crypto_verify_batch_occupancy_ratio_sum", [])}
+    for rung, c in sorted(counts.items(),
+                          key=lambda kv: promparse.rung_key(kv[0])):
+        occupancy[rung] = {
+            "flushes": int(c),
+            "mean_ratio": round(sums.get(rung, 0.0) / c, 4) if c else None,
+        }
+
+    # compile-source table: where every program on the fleet came from;
+    # cold_total is the post-warm zero-cold invariant at fleet scope
+    sources: dict[str, int] = {}
+    compile_total = 0
+    for l, v in by_name.get("tendermint_crypto_jit_compile_total", []):
+        src = l.get("source")
+        if src:
+            sources[src] = sources.get(src, 0) + int(v)
+        compile_total += int(v)
+    cold_by_node: dict[str, int] = {}
+    for r in rows:
+        if not r.get("samples"):
+            continue
+        node_cold = sum(
+            int(v) for l, v in promparse.index_samples(r["samples"]).get(
+                "tendermint_crypto_jit_compile_total", [])
+            if l.get("source") == "cold")
+        if node_cold:
+            cold_by_node[r["name"]] = node_cold
+    compile_blk = {
+        "total": compile_total,
+        "sources": dict(sorted(sources.items())),
+        "cold_total": sources.get("cold", 0),
+        "cold_by_node": cold_by_node,
+        "seconds_total": round(sum(
+            v for _l, v in by_name.get(
+                "tendermint_crypto_jit_compile_seconds_total", [])), 3),
+    }
+
+    # gateway rollup: only when some node actually serves one
+    g_jobs = promparse.scalar(
+        by_name, "tendermint_gateway_verify_jobs_total", 0) or 0
+    g_coal = promparse.scalar(
+        by_name, "tendermint_gateway_verify_coalesced_total", 0) or 0
+    g_hits = promparse.scalar(
+        by_name, "tendermint_gateway_cache_hits_total", 0) or 0
+    g_miss = promparse.scalar(
+        by_name, "tendermint_gateway_cache_misses_total", 0) or 0
+    gw_nodes = [r["name"] for r in rows
+                if ((r.get("snap") or {}).get("gateway") or {}).get("enabled")]
+    gateway = {"enabled": bool(gw_nodes), "nodes": gw_nodes}
+    if gw_nodes or g_jobs or (g_hits + g_miss):
+        flushed = g_jobs - g_coal
+        gateway.update({
+            "enabled": True,
+            "clients": _int_scalar(by_name, "tendermint_gateway_clients"),
+            "jobs_total": int(g_jobs),
+            "dedup_ratio": round(g_jobs / flushed, 2) if flushed > 0 else 0.0,
+            "cache_hit_ratio": round(g_hits / (g_hits + g_miss), 4)
+            if (g_hits + g_miss) else 0.0,
+            "shed_total": int(promparse.scalar(
+                by_name, "tendermint_gateway_shed_total", 0) or 0),
+        })
+
+    # health rollup: worst detector per node, fleet level = worst node
+    by_node_health = {
+        n["name"]: {"level": n["health_level"], "worst": n["worst_detector"]}
+        for n in nodes if n["health_level"] is not None
+    }
+    levels = [h["level"] for h in by_node_health.values()]
+    worst_node = None
+    for name, h in sorted(by_node_health.items()):
+        if h["level"] and (worst_node is None
+                           or h["level"] > by_node_health[worst_node]["level"]):
+            worst_node = name
+    health = {
+        "level": max(levels) if levels else None,
+        "by_node": by_node_health,
+        "worst": (f"{worst_node}:{by_node_health[worst_node]['worst']}"
+                  if worst_node and by_node_health[worst_node]["worst"]
+                  else None),
+        "slo_burns_total": _int_scalar(
+            by_name, "tendermint_health_slo_burn_total"),
+    }
+
+    scrape_ms = [n["scrape_ms"] for n in nodes if n["scrape_ms"] is not None]
+    return {
+        "ts": now,
+        "nodes": nodes,
+        "availability": {
+            "total": total,
+            "reachable": reachable,
+            "serving": serving,
+            "ratio": round(serving / total, 4) if total else 0.0,
+        },
+        "height": {
+            "min": min(heights) if heights else None,
+            "max": max(heights) if heights else None,
+            "spread": (max(heights) - min(heights)) if heights else None,
+        },
+        "histograms": hists,
+        "verify": verify,
+        "occupancy": occupancy,
+        "compile": compile_blk,
+        "gateway": gateway,
+        "health": health,
+        "scrape": {
+            "ms_max": max(scrape_ms) if scrape_ms else None,
+            "ms_mean": round(sum(scrape_ms) / len(scrape_ms), 2)
+            if scrape_ms else None,
+        },
+        "errors": [f"{n['name']}: {e}" for n in nodes for e in n["errors"]],
+    }
+
+
+def _int_scalar(by_name, name):
+    v = promparse.scalar(by_name, name)
+    return int(v) if v is not None else None

@@ -1,0 +1,114 @@
+"""Concurrent multi-node scraping with per-node timeouts.
+
+One `NodeTarget` per node (RPC base + optional metrics base);
+`scrape_fleet` fans the scrapes out over a small thread pool so one
+wedged listener costs its own timeout, not N of them serially.  Every
+failure is contained per node AND per source: a dead RPC listener
+still yields a metrics-sourced row, a dead metrics listener an
+RPC-sourced one, and a fully unreachable node a degraded row
+(`ok: False` with the error) — which is itself the availability
+datapoint the SLO layer consumes.  Nothing here raises for a remote
+failure.
+
+The per-node snapshot is the same shape `tendermint-tpu top` renders
+(utils/promparse.empty_snapshot + fold_metrics, cli/top.fold_status),
+so the fleet dashboard's node rows and `top` agree by construction;
+the raw parsed samples ride along for the aggregator's additive
+histogram merge.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+from tendermint_tpu.utils import promparse
+
+
+@dataclass(frozen=True)
+class NodeTarget:
+    """One node's scrape endpoints (normalized http bases).  An empty
+    `metrics` skips the exposition scrape for this node (RPC-only row)."""
+
+    name: str
+    rpc: str
+    metrics: str = ""
+
+
+def parse_target(spec: str, index: int = 0) -> NodeTarget:
+    """`[name=]rpc_addr[,metrics_addr]` → NodeTarget.  The default name
+    is node<index> (testnet layout order)."""
+    name, sep, rest = spec.partition("=")
+    if not sep:
+        name, rest = f"node{index}", spec
+    rpc, _, metrics = rest.partition(",")
+    if not rpc:
+        raise ValueError(f"target {spec!r}: empty rpc address")
+    return NodeTarget(name=name.strip(),
+                      rpc=promparse.http_base(rpc.strip()),
+                      metrics=promparse.http_base(metrics.strip())
+                      if metrics.strip() else "")
+
+
+def scrape_node(target: NodeTarget, timeout: float = 2.0) -> dict:
+    """One node's scrape: `{name, ok, rpc_ok, metrics_ok, scrape_ms,
+    snap, samples, errors}`.  `ok` means at least one source answered;
+    `rpc_ok` is the availability signal (the node is serving its RPC).
+    `samples` is the raw parsed exposition (None when metrics were
+    unreachable/disabled) — the aggregator's merge input."""
+    t0 = time.monotonic()
+    snap = promparse.empty_snapshot()
+    errors: list[str] = []
+    rpc_ok = metrics_ok = False
+
+    from tendermint_tpu.cli.top import fold_status
+
+    try:
+        fold_status(snap, promparse.get_json(f"{target.rpc}/status", timeout))
+        rpc_ok = True
+    except Exception as e:  # noqa: BLE001 — degraded row, never a crash
+        errors.append(f"status: {e}")
+    try:
+        cs = promparse.get_json(f"{target.rpc}/consensus_state", timeout)
+        rs = cs.get("round_state", {})
+        snap["round"] = rs.get("round")
+        snap["step"] = rs.get("step")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"consensus_state: {e}")
+
+    samples = None
+    if target.metrics:
+        try:
+            samples = promparse.parse_exposition(promparse.get_text(
+                f"{target.metrics}/metrics", timeout))
+            promparse.fold_metrics(snap, promparse.index_samples(samples))
+            metrics_ok = True
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"metrics: {e}")
+
+    snap["errors"] = errors
+    return {
+        "name": target.name,
+        "ok": rpc_ok or metrics_ok,
+        "rpc_ok": rpc_ok,
+        "metrics_ok": metrics_ok,
+        "scrape_ms": round((time.monotonic() - t0) * 1e3, 2),
+        "snap": snap,
+        "samples": samples,
+        "errors": errors,
+    }
+
+
+def scrape_fleet(targets: list[NodeTarget], timeout: float = 2.0,
+                 workers: int = 8) -> list[dict]:
+    """Scrape every target concurrently; rows come back in target
+    order.  Wall time is bounded by the slowest single node (≈ the
+    per-node timeout), not the sum — the property the `fleet-scrape`
+    bench stage budgets."""
+    if not targets:
+        return []
+    with ThreadPoolExecutor(max_workers=min(workers, len(targets)),
+                            thread_name_prefix="fleet-scrape") as pool:
+        return list(pool.map(
+            lambda t: scrape_node(t, timeout=timeout), targets))

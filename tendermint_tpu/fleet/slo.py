@@ -1,0 +1,326 @@
+"""Declarative SLOs over the fleet snapshot, with SRE-style burn rates.
+
+An `slo.toml` names objectives; each is measured against one fleet
+aggregate (`aggregate.aggregate()` output, or the simnet runner's
+synthesized snapshot — same field paths) and tracked through a
+dual-window burn-rate engine:
+
+  [defaults]                        # optional; objective fields win
+  target = 0.99
+  [[objective]]
+  name = "finality-p95"
+  kind = "quantile"                 # quantile | ratio | counter | availability
+  metric = "finality"               # histogram alias (quantile kind) or a
+                                    # dotted snapshot path (ratio/counter)
+  quantile = 0.95                   # one of 0.5 / 0.95 / 0.99
+  max = 2.0                         # upper bound (seconds here); `min`
+                                    # is the lower-bound twin
+
+Kinds:
+  quantile      bound a merged-histogram quantile upper edge
+                (`histograms.<metric>` in the snapshot: finality,
+                residency, quorum_wait_prevote/precommit, rpc)
+  ratio         bound any numeric snapshot field by dotted path, e.g.
+                `verify.queue_depth_max` max 512 (queue saturation) or
+                `gateway.cache_hit_ratio` min 0.5
+  counter       same lookup, framed for cumulative counts — e.g.
+                `compile.cold_total` max 0, the post-warm zero-cold
+                invariant at fleet scope
+  availability  sugar for `availability.ratio` with a `min` bound —
+                the fraction of nodes serving their RPC
+
+Burn rates (Google SRE workbook, multiwindow multi-burn-rate): each
+objective has a compliance `target` (default 0.99 — the objective may
+be violated 1% of the time).  Every evaluation feeds a good/bad point
+into the engine; the burn rate over a window is
+
+    bad_fraction(window) / (1 - target)
+
+i.e. how many times faster than "exactly spends the error budget" the
+fleet is failing.  An objective is BURNING when both the fast window
+(default 300 s at 14.4x — the page condition) and the slow window
+(default 3600 s at 6x) are over their thresholds — the dual-window
+rule that keeps a single bad scrape from paging while still firing
+within minutes of a real incident.  It is WARN when only one window
+burns or the objective is currently violated.  With a single datapoint
+(`--once`), both windows collapse to the instantaneous verdict: a
+current violation of a tight-target objective reads as burning, which
+is exactly what a CI gate wants.
+
+No data is a first-class verdict: a missing metric (e.g. no gateway in
+the deployment) reports `no-data` and passes, unless the objective
+sets `require_data = true` (then absence is itself a violation —
+"the metric I gate on must exist").
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+KINDS = ("quantile", "ratio", "counter", "availability")
+
+#: states, worst-last; exit codes for the CLI / simnet verdict
+STATES = ("no-data", "ok", "warn", "burning")
+EXIT_CODES = {"no-data": 0, "ok": 0, "warn": 1, "burning": 2}
+
+DEFAULTS = {
+    "target": 0.99,
+    "fast_window_s": 300.0,
+    "slow_window_s": 3600.0,
+    "fast_burn": 14.4,
+    "slow_burn": 6.0,
+}
+
+_QUANTILE_KEYS = {0.5: "p50_s", 0.95: "p95_s", 0.99: "p99_s"}
+
+MAX_POINTS = 4096   # per-objective history bound (engine memory)
+
+
+@dataclass
+class Objective:
+    name: str
+    kind: str
+    metric: str = ""
+    quantile: float = 0.95
+    max: float | None = None
+    min: float | None = None
+    target: float = DEFAULTS["target"]
+    fast_window_s: float = DEFAULTS["fast_window_s"]
+    slow_window_s: float = DEFAULTS["slow_window_s"]
+    fast_burn: float = DEFAULTS["fast_burn"]
+    slow_burn: float = DEFAULTS["slow_burn"]
+    require_data: bool = False
+
+    def validate(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(f"objective {self.name!r}: unknown kind "
+                             f"{self.kind!r} (known: {KINDS})")
+        if self.kind == "availability" and self.min is None:
+            self.min = 0.95
+        if self.kind == "quantile":
+            if self.quantile not in _QUANTILE_KEYS:
+                raise ValueError(
+                    f"objective {self.name!r}: quantile must be one of "
+                    f"{sorted(_QUANTILE_KEYS)}")
+            if not self.metric:
+                raise ValueError(f"objective {self.name!r}: quantile "
+                                 "objectives need `metric`")
+        if self.kind in ("ratio", "counter") and not self.metric:
+            raise ValueError(f"objective {self.name!r}: {self.kind} "
+                             "objectives need `metric`")
+        if self.max is None and self.min is None:
+            raise ValueError(f"objective {self.name!r}: needs `max` "
+                             "and/or `min`")
+        if not (0.0 < self.target < 1.0):
+            raise ValueError(f"objective {self.name!r}: target must be "
+                             "in (0, 1)")
+
+    def bound_text(self) -> str:
+        parts = []
+        if self.max is not None:
+            parts.append(f"<= {self.max:g}")
+        if self.min is not None:
+            parts.append(f">= {self.min:g}")
+        return " and ".join(parts)
+
+
+def _lookup(snapshot: dict, path: str):
+    """Dotted-path lookup into the fleet snapshot; None when any hop is
+    missing (no data, not an error)."""
+    cur = snapshot
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def measure(obj: Objective, snapshot: dict) -> tuple[float | None, bool | None]:
+    """(value, ok) for one objective against one fleet snapshot; (None,
+    None) means no data.  A quantile that resolved only in the +Inf
+    bucket reads as unbounded: a violation of any `max`."""
+    if obj.kind == "availability":
+        value = _lookup(snapshot, obj.metric or "availability.ratio")
+    elif obj.kind == "quantile":
+        cell = _lookup(snapshot, f"histograms.{obj.metric}") \
+            if "." not in obj.metric else _lookup(snapshot, obj.metric)
+        if not isinstance(cell, dict) or not cell.get("count"):
+            return None, None
+        value = cell.get(_QUANTILE_KEYS[obj.quantile])
+        if value is None:
+            # observations exist but the quantile is past the last
+            # finite bucket edge — that IS a latency violation
+            return float("inf"), obj.max is None
+    else:
+        value = _lookup(snapshot, obj.metric)
+    if value is None or not isinstance(value, (int, float)):
+        return None, None
+    value = float(value)
+    ok = True
+    if obj.max is not None and value > obj.max:
+        ok = False
+    if obj.min is not None and value < obj.min:
+        ok = False
+    return value, ok
+
+
+class BurnEngine:
+    """Per-objective good/bad point history → dual-window burn rates.
+    Injectable clock (monotonic) so tests and the simnet runner drive
+    synthetic timelines; `--once` feeds exactly one point and the
+    windows collapse to the instantaneous verdict."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._points: dict[str, deque] = {}
+
+    def feed(self, name: str, good: bool | None, t: float | None = None) -> None:
+        """Record one evaluation point (None = no data, not recorded)."""
+        if good is None:
+            return
+        dq = self._points.setdefault(name, deque(maxlen=MAX_POINTS))
+        dq.append((self._clock() if t is None else t, 1.0 if good else 0.0))
+
+    def _bad_fraction(self, name: str, window_s: float,
+                      now: float) -> float | None:
+        pts = [g for (t, g) in self._points.get(name, ())
+               if now - t <= window_s]
+        if not pts:
+            return None
+        return 1.0 - (sum(pts) / len(pts))
+
+    def burn(self, obj: Objective, now: float | None = None
+             ) -> tuple[float | None, float | None]:
+        """(fast, slow) burn rates, None where the window has no
+        points.  A zero error budget cannot happen (target < 1)."""
+        now = self._clock() if now is None else now
+        budget = 1.0 - obj.target
+        fast = self._bad_fraction(obj.name, obj.fast_window_s, now)
+        slow = self._bad_fraction(obj.name, obj.slow_window_s, now)
+        return (None if fast is None else fast / budget,
+                None if slow is None else slow / budget)
+
+    def verdict(self, obj: Objective, ok: bool | None,
+                now: float | None = None) -> dict:
+        """State for one objective from its current measurement + burn
+        history (feed() the measurement first)."""
+        if ok is None and obj.name not in self._points:
+            state = "burning" if obj.require_data else "no-data"
+            return {"state": state, "burn_fast": None, "burn_slow": None}
+        fast, slow = self.burn(obj, now=now)
+        over_fast = fast is not None and fast >= obj.fast_burn
+        over_slow = slow is not None and slow >= obj.slow_burn
+        if over_fast and over_slow:
+            state = "burning"
+        elif over_fast or over_slow or ok is False:
+            state = "warn"
+        else:
+            state = "ok"
+        return {
+            "state": state,
+            "burn_fast": None if fast is None else round(fast, 2),
+            "burn_slow": None if slow is None else round(slow, 2),
+        }
+
+
+def evaluate(objectives: list[Objective], snapshot: dict,
+             engine: BurnEngine | None = None,
+             now: float | None = None) -> dict:
+    """Measure + verdict every objective against one fleet snapshot.
+    `engine` carries burn history across calls (the --watch loop and
+    the simnet sampler); omitting it evaluates one-shot semantics."""
+    engine = engine if engine is not None else BurnEngine()
+    results = []
+    worst = "no-data"
+    for obj in objectives:
+        value, ok = measure(obj, snapshot)
+        engine.feed(obj.name, ok, t=now)
+        v = engine.verdict(obj, ok, now=now)
+        results.append({
+            "name": obj.name,
+            "kind": obj.kind,
+            "metric": obj.metric or ("availability.ratio"
+                                     if obj.kind == "availability" else ""),
+            "bound": obj.bound_text(),
+            "target": obj.target,
+            "value": (round(value, 4)
+                      if isinstance(value, float) and value == value
+                      and abs(value) != float("inf") else value),
+            "ok": ok,
+            **v,
+        })
+        if STATES.index(v["state"]) > STATES.index(worst):
+            worst = v["state"]
+    return {
+        "objectives": results,
+        "state": worst,
+        "ok": worst in ("ok", "no-data"),
+        "exit_code": EXIT_CODES[worst],
+    }
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def objectives_from_doc(doc: dict) -> list[Objective]:
+    """Objectives from a decoded slo.toml/json document: `[defaults]`
+    merges under every `[[objective]]`; every objective validates."""
+    defaults = dict(DEFAULTS)
+    user_defaults = doc.get("defaults", {})
+    if not isinstance(user_defaults, dict):
+        raise ValueError("[defaults] must be a table")
+    defaults.update(user_defaults)
+    raw = doc.get("objective", [])
+    if not isinstance(raw, list) or not raw:
+        raise ValueError("slo document needs at least one [[objective]]")
+    known = set(Objective.__dataclass_fields__)
+    out = []
+    for entry in raw:
+        merged = {**defaults, **entry}
+        unknown = set(merged) - known
+        if unknown:
+            raise ValueError(f"objective {entry.get('name', '?')!r}: "
+                             f"unknown keys {sorted(unknown)}")
+        obj = Objective(**merged)
+        obj.validate()
+        out.append(obj)
+    names = [o.name for o in out]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate objective names: {names}")
+    return out
+
+
+def objectives_from_list(entries: list[dict]) -> list[Objective]:
+    """Objectives from a bare list of tables (the simnet scenario's
+    inline `[[slo_objectives]]` form)."""
+    return objectives_from_doc({"objective": list(entries)})
+
+
+def load_slo(path: str) -> list[Objective]:
+    """Load slo.toml (tomllib/tomli via the config loader's fallback)
+    or a .json twin."""
+    if path.endswith(".toml"):
+        from tendermint_tpu.config.config import tomllib
+        if tomllib is None:
+            raise ImportError(
+                "TOML slo files need tomllib (Python >= 3.11) or the tomli "
+                "backport; neither is installed — use a JSON slo file")
+        with open(path, "rb") as fh:
+            doc = tomllib.load(fh)
+    else:
+        import json
+
+        with open(path) as fh:
+            doc = json.load(fh)
+    return objectives_from_doc(doc)
+
+
+def default_objectives() -> list[Objective]:
+    """The no-slo.toml default: the deployment serves.  Kept minimal —
+    real latency objectives belong to the operator's file."""
+    obj = Objective(name="availability", kind="availability", min=0.75)
+    obj.validate()
+    return [obj]

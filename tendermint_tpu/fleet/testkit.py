@@ -1,0 +1,189 @@
+"""Shared fleet test/bench harness: a real N-node localnet in-process.
+
+`LocalFleet` stands up N full `Node`s (the production node class — RPC
+server, Prometheus listener, health watchdog, the lot) as a validator
+quorum over the in-process `MemoryNetwork`, each on ephemeral
+127.0.0.1 ports, and hands back the `NodeTarget`s the fleet scraper
+consumes.  This is the same harness behind bench.py's `fleet-scrape`
+stage and tests/test_fleet.py's live acceptance test (one definition,
+the gateway/testkit.py idiom), so "works against a live localnet"
+means the same thing in both places.
+
+The scraper is blocking HTTP; the nodes' servers run on the asyncio
+loop — callers inside the loop must scrape via `asyncio.to_thread`
+(`run_fleet_bench` does).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import statistics
+import tempfile
+import time
+
+from .scrape import NodeTarget, scrape_fleet
+from .aggregate import aggregate
+from .slo import BurnEngine, default_objectives, evaluate
+
+
+class LocalFleet:
+    """N in-process validator nodes with live RPC + metrics listeners."""
+
+    def __init__(self, root: str, n: int = 4, chain_id: str = "fleet-local"):
+        self.root = root
+        self.n = n
+        self.chain_id = chain_id
+        self.nodes: list = []
+        self.node_keys: list = []
+        self._started: list = []
+
+    async def start(self) -> None:
+        from tendermint_tpu.config import test_config as make_test_config
+        from tendermint_tpu.crypto.keys import priv_key_from_seed
+        from tendermint_tpu.node import Node
+        from tendermint_tpu.node.node_key import load_or_gen_node_key
+        from tendermint_tpu.p2p import MemoryNetwork
+        from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+        keys = [priv_key_from_seed(bytes([11 * i + 5]) * 32)
+                for i in range(self.n)]
+        gen = GenesisDoc(
+            chain_id=self.chain_id,
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=k.pub_key(), power=10)
+                        for k in keys],
+        )
+        network = MemoryNetwork()
+        for i in range(self.n):
+            cfg = make_test_config(os.path.join(self.root, f"node{i}"))
+            cfg.base.moniker = f"node{i}"
+            cfg.base.fast_sync = False
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = "tcp://127.0.0.1:0"
+            nk = load_or_gen_node_key(cfg.node_key_file)
+            node = Node(cfg, genesis=gen,
+                        transport=network.create_transport(nk.node_id))
+            node.priv_validator.priv_key = keys[i]
+            node.consensus.priv_validator = node.priv_validator
+            self.nodes.append(node)
+            self.node_keys.append(nk)
+        for node in self.nodes:
+            await node.start()
+            self._started.append(node)
+        for i, a in enumerate(self.nodes):
+            for b in self.node_keys[i + 1:]:
+                await a.router.dial(b.node_id)
+
+    async def wait_for_height(self, h: int, timeout: float = 60.0) -> None:
+        async def poll():
+            while any(n.block_store.height() < h for n in self._started):
+                await asyncio.sleep(0.05)
+
+        await asyncio.wait_for(poll(), timeout)
+
+    def targets(self) -> list[NodeTarget]:
+        out = []
+        for i, node in enumerate(self.nodes):
+            host, port = node.rpc_addr
+            mhost, mport = node.metrics.addr
+            out.append(NodeTarget(name=f"node{i}",
+                                  rpc=f"http://{host}:{port}",
+                                  metrics=f"http://{mhost}:{mport}"))
+        return out
+
+    async def broadcast_load(self, n_txs: int = 20) -> int:
+        """Offer n_txs over RPC broadcast_tx_async round-robin — real
+        ingress, so the rpc-latency AND tx-lifecycle histograms gain
+        observations for the merged fleet panels.  Returns accepted."""
+        import base64
+        from urllib.parse import quote
+
+        from tendermint_tpu.utils import promparse
+
+        targets = self.targets()
+        accepted = 0
+        for i in range(n_txs):
+            t = targets[i % len(targets)]
+            tx = base64.b64encode(f"fleet-{i}=load".encode()).decode()
+
+            def _send(url):
+                return promparse.get_json(url, 5.0)
+
+            try:
+                await asyncio.to_thread(
+                    _send, f"{t.rpc}/broadcast_tx_async?tx={quote(tx)}")
+                accepted += 1
+            except Exception:  # noqa: BLE001 — load is best-effort
+                pass
+        return accepted
+
+    async def kill(self, index: int) -> None:
+        """Take one node down (servers included): its row must degrade
+        and the availability ratio must drop — never crash the scrape."""
+        node = self.nodes[index]
+        if node in self._started:
+            self._started.remove(node)
+            await node.stop()
+
+    async def stop(self) -> None:
+        for node in list(self._started):
+            self._started.remove(node)
+            await node.stop()
+
+
+def run_fleet_bench(n_nodes: int = 4, cycles: int = 5,
+                    target_height: int = 2,
+                    budget_ms: float = 2000.0) -> dict:
+    """The `fleet-scrape` bench stage body: stand the localnet up, run
+    `cycles` scrape+aggregate+SLO rounds, report wall-time percentiles
+    against `budget_ms`.  Scrape wall time is the headline — it bounds
+    the dashboard refresh and the cron-gate cost, and must track the
+    slowest NODE, not the node count."""
+    async def run():
+        with tempfile.TemporaryDirectory(prefix="fleet-bench-") as td:
+            fl = LocalFleet(td, n=n_nodes)
+            await fl.start()
+            try:
+                await fl.wait_for_height(target_height, timeout=90.0)
+                # real tx ingress so the merged finality histogram has
+                # observations to fold, then let the txs commit
+                await fl.broadcast_load(20)
+                h = max(n.block_store.height() for n in fl.nodes)
+                await fl.wait_for_height(h + 2, timeout=90.0)
+                targets = fl.targets()
+                engine = BurnEngine()
+                prev = None
+                verdict = None
+                walls: list[float] = []
+                rows_ok = 0
+                for _ in range(cycles):
+                    t0 = time.monotonic()
+                    rows = await asyncio.to_thread(
+                        scrape_fleet, targets, 5.0)
+                    fleet = aggregate(rows, prev=prev)
+                    verdict = evaluate(default_objectives(), fleet,
+                                       engine=engine)
+                    walls.append((time.monotonic() - t0) * 1e3)
+                    rows_ok = sum(1 for r in rows if r["ok"])
+                    prev = fleet
+                    await asyncio.sleep(0.1)
+                p50 = statistics.median(walls)
+                return {
+                    "nodes": n_nodes,
+                    "cycles": cycles,
+                    "scrape_ms_p50": round(p50, 2),
+                    "scrape_ms_max": round(max(walls), 2),
+                    "budget_ms": budget_ms,
+                    "within_budget": p50 <= budget_ms,
+                    "rows_ok": rows_ok,
+                    "availability": prev["availability"]["ratio"],
+                    "finality_count": (prev["histograms"]["finality"]
+                                       or {}).get("count", 0),
+                    "slo_ok": bool(verdict and verdict["ok"]),
+                    "height_min": prev["height"]["min"],
+                }
+            finally:
+                await fl.stop()
+
+    return asyncio.run(run())

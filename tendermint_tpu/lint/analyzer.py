@@ -93,7 +93,9 @@ JAX_ALLOWED_DIRS = {"ops", "parallel"}
 OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
                            "txlife.py", "health.py", "remediate.py",
                            "gateway/coalescer.py", "gateway/cache.py",
-                           "gateway/service.py"}
+                           "gateway/service.py",
+                           "fleet/slo.py", "fleet/aggregate.py",
+                           "fleet/scrape.py"}
 
 #: label names that explode series cardinality on a real network
 HIGH_CARDINALITY_LABELS = {"height", "hash", "tx_hash", "block_hash",

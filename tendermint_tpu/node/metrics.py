@@ -326,6 +326,14 @@ class NodeMetrics:
             namespace=ns, kind="counter",
             fn=lambda: node.health.transition_samples(),
         ))
+        self.health_slo_burn = reg.register(LabeledCallbackGauge(
+            "health_slo_burn_total",
+            "slo_burn records pushed into this node's monitor by the "
+            "fleet layer (fleet/slo.py burn-rate verdicts) — fleet-scope "
+            "pressure surfaced next to the local detectors",
+            namespace=ns, kind="counter",
+            fn=lambda: node.health.slo_burn_samples(),
+        ))
 
         # -- remediation controller (utils/remediate.py) ----------------
         # actions executed per (action, triggering detector), and the

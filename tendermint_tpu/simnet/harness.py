@@ -372,6 +372,22 @@ class SimnetRunner:
         # flood-op load spike: the driver multiplies its offered rate
         # by this for the duration of the injection window
         self._load_factor = 1.0
+        # fleet-scope SLOs (scenario [[slo_objectives]]): the sampler
+        # task feeds availability ticks into the burn engine through
+        # the run; _finish evaluates every objective against the
+        # synthesized fleet snapshot and the verdict gains a `fleet`
+        # block.  Availability here means "the node is serving": alive
+        # AND committed within the stall-budget horizon — a quorum-loss
+        # partition reads as the whole fleet going unavailable, exactly
+        # like its RPC rows would read to the live scraper.
+        self._slo_objectives = scenario.parsed_slo_objectives()
+        self._slo_engine = None
+        self._avail_ticks: list[float] = []   # per-tick serving ratio
+        self._slo_burn_episode: set[str] = set()
+        if self._slo_objectives:
+            from tendermint_tpu.fleet.slo import BurnEngine
+
+            self._slo_engine = BurnEngine()
 
     # -- construction ----------------------------------------------------
     def _consensus_config(self) -> ConsensusConfig:
@@ -475,6 +491,8 @@ class SimnetRunner:
         ]
         if sc.load_rate > 0:
             self._aux.append(loop.create_task(self._load_driver()))
+        if self._slo_objectives:
+            self._aux.append(loop.create_task(self._fleet_sampler()))
 
         try:
             await asyncio.wait_for(
@@ -538,8 +556,20 @@ class SimnetRunner:
             for node in self.nodes
         }
 
+        fleet_block = None
+        if self._slo_objectives:
+            from tendermint_tpu.fleet.slo import evaluate as slo_evaluate
+
+            snap = self._fleet_snapshot(report)
+            fleet_block = {
+                **snap,
+                "slo": slo_evaluate(self._slo_objectives, snap,
+                                    engine=self._slo_engine),
+            }
+
         run_info = {
             "t_start_ns": t_start_ns,
+            "fleet": fleet_block,
             "health": health_reports,
             "remediation": remediation_reports,
             "duration_s": duration_s,
@@ -678,6 +708,121 @@ class SimnetRunner:
                     pass  # full mempool / dup under churn: offered, not accepted
             i += 1
             await asyncio.sleep(interval)
+
+    # -- fleet SLO sampling ----------------------------------------------
+    def _round_ms(self) -> int:
+        return (self._ccfg.timeout_propose_ms + self._ccfg.timeout_prevote_ms
+                + self._ccfg.timeout_precommit_ms
+                + self._ccfg.timeout_commit_ms)
+
+    def _avail_horizon_s(self) -> float:
+        """A node counts as serving while it committed within this
+        horizon — the verdict's stall budget reused, so 'unavailable'
+        and 'stalled' mean the same thing."""
+        if self.scenario.stall_factor > 0:
+            return (self.scenario.stall_factor
+                    * self._ccfg.timeout_commit_ms / 1e3)
+        return max(5.0, 6.0 * self._round_ms() / 1e3)
+
+    async def _fleet_sampler(self) -> None:
+        """The in-process twin of the live fleet scraper: tick the
+        per-node serving state, feed availability-kind objectives into
+        the burn engine, and on a good→bad edge push an `slo_burn`
+        record into every live node's HealthMonitor + journal — the
+        fleet layer telling the nodes their deployment is burning."""
+        from tendermint_tpu.fleet import slo as fleet_slo
+
+        horizon = self._avail_horizon_s()
+        loop = asyncio.get_running_loop()
+        last_height: dict[int, int] = {}
+        last_advance: dict[int, float] = {}
+        avail_objs = [o for o in self._slo_objectives
+                      if o.kind == "availability"]
+        while True:
+            now = loop.time()
+            serving = 0
+            for node in self.nodes:
+                if node is None or node.crashed:
+                    last_height.pop(node.index if node else -1, None)
+                    continue
+                h = node.height()
+                if h != last_height.get(node.index):
+                    last_height[node.index] = h
+                    last_advance[node.index] = now
+                if now - last_advance.get(node.index, now) <= horizon:
+                    serving += 1
+            ratio = serving / len(self.nodes) if self.nodes else 0.0
+            self._avail_ticks.append(ratio)
+            for obj in avail_objs:
+                good = ratio >= (obj.min if obj.min is not None else 0.0)
+                self._slo_engine.feed(obj.name, good)
+                if good:
+                    self._slo_burn_episode.discard(obj.name)
+                elif obj.name not in self._slo_burn_episode:
+                    # one slo_burn per bad episode, fanned out to every
+                    # live node's monitor + journal (both sink-gated)
+                    self._slo_burn_episode.add(obj.name)
+                    for node in self.nodes:
+                        if node is None or node.crashed:
+                            continue
+                        if node.health.enabled:
+                            node.health.record(
+                                "slo_burn", {"objective": obj.name,
+                                             "value": round(ratio, 4)})
+                        if node.cs.journal.enabled:
+                            node.cs.journal.log(
+                                "slo_burn", objective=obj.name,
+                                value=round(ratio, 4),
+                                detail="fleet availability under bound")
+            await asyncio.sleep(0.25)
+
+    def _fleet_snapshot(self, report) -> dict:
+        """The simnet-side fleet aggregate: the same field paths
+        fleet/aggregate.py produces, synthesized from the run instead
+        of scraped — availability from the sampler's ticks, finality
+        percentiles from the merged tx_* journal lifecycles WITHOUT
+        fault-window exclusion ('the fleet met its objective THROUGH
+        the fault window' is exactly the question), health from the
+        monitors."""
+        ticks = self._avail_ticks
+        live = sum(1 for n in self.nodes if n is not None and not n.crashed)
+        samples: list[float] = []
+        for tv in report.txs.values():
+            start = tv.first.get("rpc") or tv.first.get("admit")
+            end = tv.first.get("apply") or tv.first.get("commit")
+            if start is None or end is None or end[0] < start[0]:
+                continue
+            samples.append((end[0] - start[0]) / 1e9)
+        samples.sort()
+
+        def pct(q: float):
+            if not samples:
+                return None
+            idx = min(len(samples) - 1, int(q * (len(samples) - 1) + 0.5))
+            return round(samples[idx], 4)
+
+        finality = None
+        if samples:
+            finality = {
+                "count": len(samples),
+                "mean_s": round(sum(samples) / len(samples), 4),
+                "p50_s": pct(0.50), "p95_s": pct(0.95), "p99_s": pct(0.99),
+            }
+        levels = [n.health.level() for n in self.nodes
+                  if n is not None and not n.crashed and n.health.enabled]
+        return {
+            "availability": {
+                "total": len(self.nodes),
+                "serving": live,
+                "ratio": (round(sum(ticks) / len(ticks), 4)
+                          if ticks else (1.0 if live == len(self.nodes)
+                                         else 0.0)),
+                "min_ratio": round(min(ticks), 4) if ticks else None,
+                "samples": len(ticks),
+            },
+            "histograms": {"finality": finality},
+            "health": {"level": max(levels) if levels else None},
+        }
 
     # -- progress --------------------------------------------------------
     def _honest_live(self) -> list[SimNode]:

@@ -146,6 +146,18 @@ class Scenario:
     # at 0 by run end.  With TM_TPU_REMEDIATE=0 the same seeded
     # scenario fails this block — the controller is load-bearing.
     expect_remediation: list = field(default_factory=list)
+    # fleet-scope SLOs (fleet/slo.py): inline [[slo_objectives]] tables
+    # with the slo.toml objective schema (kind/metric/bounds/burn
+    # windows — size the windows to the run, not to production).  When
+    # set, the runner samples fleet availability through the run, runs
+    # the burn-rate engine over its SimNodes, and the verdict gains a
+    # `fleet` block (docs/fleet.md).  `expect_slo` turns the block into
+    # an invariant: "ok" = every objective must end ok (the clean-run
+    # contract), "violated" = at least one must be warn/burning (the
+    # partition variant proving the block load-bearing), "" = report
+    # only.
+    slo_objectives: list = field(default_factory=list)
+    expect_slo: str = ""
 
     # -- derived ---------------------------------------------------------
     def total_slots(self) -> int:
@@ -201,8 +213,25 @@ class Scenario:
             if a not in REMEDIATION_ACTIONS:
                 raise ValueError(f"unknown remediation action {a!r} "
                                  f"(known: {REMEDIATION_ACTIONS})")
+        if self.expect_slo not in ("", "ok", "violated"):
+            raise ValueError(
+                f"expect_slo must be '', 'ok' or 'violated', "
+                f"not {self.expect_slo!r}")
+        if self.expect_slo and not self.slo_objectives:
+            raise ValueError("expect_slo set but no [[slo_objectives]]")
+        self.parsed_slo_objectives()   # schema errors surface at load
         for op in self.faults:
             op.validate(self.validators)
+
+    def parsed_slo_objectives(self) -> list:
+        """The inline slo_objectives tables as validated fleet/slo.py
+        Objective instances (lazy import: the scenario schema stays
+        usable without pulling the fleet package until SLOs are used)."""
+        if not self.slo_objectives:
+            return []
+        from tendermint_tpu.fleet.slo import objectives_from_list
+
+        return objectives_from_list(self.slo_objectives)
 
     def to_dict(self) -> dict:
         doc = asdict(self)

@@ -31,6 +31,15 @@ Invariants (each names itself in `violations` on failure):
                to normal by run end — the shed-and-survive contract.
                Disabled controllers (TM_TPU_REMEDIATE=0) fail this
                block outright.
+  slo          when the scenario sets `expect_slo` over its inline
+               [[slo_objectives]] (fleet/slo.py): "ok" demands every
+               objective end ok through the run — the fleet met its
+               objective THROUGH the fault window, not just per-node
+               facts — and "violated" demands at least one objective
+               warn/burn (the >1/3-partition variant proving the fleet
+               block load-bearing).  The runner's sampler feeds the
+               burn engine with per-tick serving ratios and the report
+               carries the full `fleet` block either way.
 
 Beyond the invariants, the report carries the BENCH metrics (accepted
 tx/s, heights/min, rounds>0 streaks, recovery-after-heal) and — from the
@@ -375,6 +384,29 @@ def evaluate(scenario: Scenario, report: TimelineReport,
     remediation = _remediation_block(run_info)
     _check_remediation(scenario, remediation, violations)
 
+    # -- fleet SLO -------------------------------------------------------
+    fleet = run_info.get("fleet")
+    if fleet is not None and scenario.expect_slo:
+        slo = fleet["slo"]
+        if scenario.expect_slo == "ok" and not slo["ok"]:
+            failing = [f"{o['name']}={o['state']}"
+                       for o in slo["objectives"]
+                       if o["state"] in ("warn", "burning")]
+            violations.append({
+                "invariant": "slo",
+                "detail": ("fleet SLO expected ok but "
+                           f"{', '.join(failing) or slo['state']} "
+                           f"(availability "
+                           f"{fleet['availability']['ratio']})"),
+            })
+        elif scenario.expect_slo == "violated" and slo["ok"]:
+            violations.append({
+                "invariant": "slo",
+                "detail": "scenario expects an SLO violation but every "
+                          "objective ended ok — the fault injection "
+                          "never dented the fleet objective",
+            })
+
     health = _health_block(run_info)
     diagnosis = None
     if violations and health["first_critical"] is not None:
@@ -390,6 +422,7 @@ def evaluate(scenario: Scenario, report: TimelineReport,
         "diagnosis": diagnosis,
         "health": health,
         "remediation": remediation,
+        "fleet": fleet,
         "scenario": {
             "name": scenario.name,
             "seed": scenario.seed,

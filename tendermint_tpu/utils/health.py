@@ -667,6 +667,8 @@ class HealthMonitor:
         self._fault_clear_at: float | None = None
         self.samples = 0
         self.probe_errors = 0
+        self.slo_burns = 0
+        self._last_slo_burn = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
 
@@ -695,9 +697,17 @@ class HealthMonitor:
     def record(self, name: str, value) -> None:
         """Push an out-of-band observation into the NEXT sample (e.g. a
         restart marker); hook sites guard on `.enabled` like every
-        other sink."""
+        other sink.  `slo_burn` records — the fleet layer telling THIS
+        node its deployment is burning an objective's error budget
+        (fleet/slo.py; the simnet runner's sampler is the feed) — are
+        additionally counted and kept, so the node's own status block,
+        journal forensics and `tendermint_health_slo_burn_total` show
+        fleet-scope pressure next to the local detectors."""
         with self._lock:
             self._extras[name] = value
+            if name == "slo_burn":
+                self.slo_burns += 1
+                self._last_slo_burn = value
 
     # -- sampling -------------------------------------------------------
 
@@ -828,6 +838,11 @@ class HealthMonitor:
             return [({"detector": name}, float(c))
                     for name, c in sorted(self._transitions_total.items())]
 
+    def slo_burn_samples(self) -> list:
+        """[(labels, value)] rows for tendermint_health_slo_burn_total."""
+        with self._lock:
+            return [({}, float(self.slo_burns))] if self.slo_burns else []
+
     def status_block(self) -> dict:
         """Compact block for RPC `status` / the health CLI."""
         now = self._clock()
@@ -843,7 +858,7 @@ class HealthMonitor:
                 for d in self.detectors
             }
             level = max((d.level for d in self.detectors), default=OK)
-            return {
+            out = {
                 "enabled": True,
                 "node": self.node,
                 "level": level,
@@ -855,6 +870,10 @@ class HealthMonitor:
                 "transitions_total": sum(self._transitions_total.values()),
                 "in_fault_window": self._in_fault(now),
             }
+            if self.slo_burns:
+                out["slo_burns"] = self.slo_burns
+                out["last_slo_burn"] = self._last_slo_burn
+            return out
 
     def report(self) -> dict:
         """Full forensic view: status + transition history + the last
@@ -934,6 +953,9 @@ class _NopMonitor:
         return []
 
     def transition_samples(self) -> list:
+        return []
+
+    def slo_burn_samples(self) -> list:
         return []
 
     def status_block(self) -> dict:

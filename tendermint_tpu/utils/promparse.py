@@ -1,0 +1,396 @@
+"""Shared Prometheus exposition-format parsing and folding.
+
+Extracted from `cli/top.py` (PR 4-13 grew the parser inside the
+dashboard as private helpers; the fleet aggregator is the second
+consumer and `tendermint-tpu health`/bench tooling keep sprouting
+ad-hoc copies).  Everything here is pure text -> data: no node imports,
+no env reads, no metrics registration — safe to import from any CLI.
+
+Three layers:
+
+  * **Parsing** — `parse_exposition` (0.0.4 text -> samples),
+    `index_samples` (samples -> by-name index), `scalar` (first value
+    of a series).
+  * **Histogram folding** — `hist_summary` reads count/sum/bucket
+    series back into {count, mean, quantile-upper-bounds}; quantiles
+    are cumulative-bucket UPPER bounds (read "<=") and `match` filters
+    labeled sub-histograms (e.g. quorum_wait by type).
+  * **Merging** — `merge_samples` folds N nodes' sample lists into one
+    by summing values per (name, labels) pair.  Prometheus histograms
+    are additive by construction (per-bucket cumulative counts, sums
+    and counts all sum across instances — the standard `sum by (le)`
+    aggregation), so a `hist_summary` over the merged index IS the
+    fleet-level distribution.  Counters are additive too; gauges merge
+    into sums, which is only meaningful for capacity-style gauges
+    (queue depths, memory bytes) — callers pick which merged series
+    they read.
+
+The top-snapshot metric fold (`fold_metrics` + `empty_snapshot`) also
+lives here: `top` renders one node's snapshot, the fleet scraper builds
+one per node, and both must agree on the shape.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+
+# ---------------------------------------------------------------------------
+# HTTP fetch helpers (shared by top / health / fleet CLIs)
+# ---------------------------------------------------------------------------
+
+def http_base(addr: str) -> str:
+    """tcp://host:port or bare host:port -> http://host:port."""
+    if addr.startswith("tcp://"):
+        addr = "http://" + addr[len("tcp://"):]
+    if not addr.startswith(("http://", "https://")):
+        addr = "http://" + addr
+    return addr.rstrip("/")
+
+
+def get_json(url: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        doc = json.loads(r.read())
+    return doc.get("result", doc)
+
+
+def get_text(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# exposition parsing
+# ---------------------------------------------------------------------------
+
+def parse_exposition(text: str):
+    """Exposition 0.0.4 text → list[(name, labels, value)]."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        labels: dict[str, str] = {}
+        if "{" in series:
+            name, _, rest = series.partition("{")
+            for pair in rest.rstrip("}").split(","):
+                k, _, v = pair.partition("=")
+                labels[k] = v.strip('"')
+        else:
+            name = series
+        try:
+            samples.append((name, labels, float(value)))
+        except ValueError:
+            continue
+    return samples
+
+
+def index_samples(samples):
+    """samples → {name: [(labels, value), ...]}."""
+    by_name: dict[str, list] = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    return by_name
+
+
+def scalar(by_name, name, default=None):
+    rows = by_name.get(name)
+    if not rows:
+        return default
+    return rows[0][1]
+
+
+def merge_samples(sample_lists):
+    """Fold N sample lists (one per node) into one list by SUMMING
+    values per (name, labels) pair — exact for counters and for every
+    histogram series (bucket/sum/count are all additive across
+    instances), meaningful for capacity gauges (depths, byte totals).
+    Label sets merge by content, so per-peer/per-rung sub-series stay
+    distinct while identical rows from different nodes add up."""
+    acc: dict[tuple, float] = {}
+    order: list[tuple] = []
+    for samples in sample_lists:
+        for name, labels, value in samples:
+            key = (name, tuple(sorted(labels.items())))
+            if key not in acc:
+                acc[key] = 0.0
+                order.append(key)
+            acc[key] += value
+    return [(name, dict(labels), acc[(name, labels)])
+            for name, labels in order]
+
+
+# ---------------------------------------------------------------------------
+# histogram folding
+# ---------------------------------------------------------------------------
+
+def hist_summary(by_name, base: str, match: dict | None = None,
+                 quantiles: tuple = (0.5, 0.95)):
+    """{count, mean_s, p50_s, p95_s[, p99_s...]} from a histogram's
+    exposition series (quantile values are cumulative-bucket UPPER
+    bounds — read '≤'); None when the histogram has no observations.
+    `match` filters by label values (labeled histograms, e.g.
+    quorum_wait by type); `quantiles` picks which pNN_s keys appear.
+    A quantile that only resolves in the +Inf bucket reports None (the
+    mass is beyond the last finite edge — unbounded, not zero)."""
+    def _rows(suffix):
+        rows = by_name.get(base + suffix, [])
+        if match:
+            rows = [(l, v) for l, v in rows
+                    if all(l.get(k) == v2 for k, v2 in match.items())]
+        return rows
+
+    count = sum(v for _l, v in _rows("_count"))
+    if not count:
+        return None
+    total = sum(v for _l, v in _rows("_sum"))
+    # cumulative buckets, folded across labelsets, sorted by edge
+    cum: dict[float, float] = {}
+    for labels, v in _rows("_bucket"):
+        le = labels.get("le", "+Inf")
+        edge = float("inf") if le == "+Inf" else float(le)
+        cum[edge] = cum.get(edge, 0.0) + v
+
+    def quantile(q):
+        target = q * count
+        for edge in sorted(cum):
+            if cum[edge] >= target:
+                return None if edge == float("inf") else edge
+        return None
+
+    out = {"count": int(count), "mean_s": round(total / count, 4)}
+    for q in quantiles:
+        out[f"p{int(round(q * 100))}_s"] = quantile(q)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the top-snapshot metric fold (shared by `top` and the fleet scraper)
+# ---------------------------------------------------------------------------
+
+def rung_key(rung: str):
+    try:
+        return (0, int(rung))
+    except ValueError:
+        return (1, rung)
+
+
+def empty_snapshot() -> dict:
+    """The per-node snapshot skeleton `fold_metrics` fills (and `top`'s
+    status fold fills first, when RPC answered)."""
+    return {
+        "node": {},
+        "height": None,
+        "round": None,
+        "step": None,
+        "peers": {"count": None, "send_queue_depths": {}},
+        "verify": {"queue_depth": None, "submitted": None, "flushes": None,
+                   "device_batches": None, "cache_hit_ratio": None,
+                   "backend": None, "device_ready": None,
+                   "occupancy": {}, "padding_rows_total": None,
+                   "transfer_bytes_total": None},
+        "compile": {"total": 0, "seconds_total": 0.0, "recompiles": 0,
+                    "by_rung": {}, "sources": {}},
+        "costs": {},
+        "txlife": {"finality": None, "residency": None, "quorum_wait": {}},
+        "health": {"level": None, "detectors": {}},
+        "remediation": {"enabled": None, "shed_level": None,
+                        "by_action": {}, "quarantined": 0},
+        "gateway": {"enabled": None, "clients": None,
+                    "cache_hit_ratio": None, "dedup_ratio": None,
+                    "shed_total": None, "shed_level": None},
+        "device_memory": [],
+        "errors": [],
+    }
+
+
+def fold_metrics(snap: dict, by_name: dict) -> None:
+    """Fill a snapshot from an indexed /metrics scrape.  Status-block
+    fields already present (RPC answered first) are left alone; every
+    metrics-only field fills in, so a node with a dead RPC listener
+    still produces a near-complete row."""
+    verify = snap["verify"]
+    if snap["height"] is None:
+        h = scalar(by_name, "tendermint_consensus_height")
+        snap["height"] = int(h) if h is not None else None
+    if snap["round"] is None:
+        r = scalar(by_name, "tendermint_consensus_rounds")
+        snap["round"] = int(r) if r is not None else None
+    if snap["peers"]["count"] is None:
+        p = scalar(by_name, "tendermint_p2p_peers")
+        snap["peers"]["count"] = int(p) if p is not None else None
+
+    depths: dict[str, int] = {}
+    for labels, v in by_name.get("tendermint_p2p_peer_send_queue_depth", []):
+        pid = labels.get("peer_id", "?")
+        depths[pid] = depths.get(pid, 0) + int(v)
+    snap["peers"]["send_queue_depths"] = depths
+
+    if verify["queue_depth"] is None:
+        q = scalar(by_name, "tendermint_crypto_verify_queue_depth")
+        verify["queue_depth"] = int(q) if q is not None else None
+    if verify["submitted"] is None:
+        s = scalar(by_name, "tendermint_crypto_verify_submitted_total")
+        verify["submitted"] = int(s) if s is not None else None
+    fl = scalar(by_name, "tendermint_crypto_verify_flushes_total")
+    verify["flushes"] = int(fl) if fl is not None else None
+    db = scalar(by_name, "tendermint_crypto_verify_device_batches_total")
+    verify["device_batches"] = int(db) if db is not None else None
+    if verify["cache_hit_ratio"] is None:
+        hits = scalar(by_name, "tendermint_crypto_verify_cache_hits_total", 0)
+        misses = scalar(by_name,
+                        "tendermint_crypto_verify_cache_misses_total", 0)
+        total = (hits or 0) + (misses or 0)
+        verify["cache_hit_ratio"] = round(hits / total, 4) if total else 0.0
+
+    pad = scalar(by_name, "tendermint_crypto_verify_padding_rows_total")
+    verify["padding_rows_total"] = int(pad) if pad is not None else None
+    xfer = scalar(by_name, "tendermint_crypto_verify_transfer_bytes_total")
+    verify["transfer_bytes_total"] = int(xfer) if xfer is not None else None
+
+    # per-rung mean occupancy from the histogram's sum/count series
+    occ: dict[str, dict] = {}
+    counts = {labels.get("rung", "?"): v for labels, v in by_name.get(
+        "tendermint_crypto_verify_batch_occupancy_ratio_count", [])}
+    sums = {labels.get("rung", "?"): v for labels, v in by_name.get(
+        "tendermint_crypto_verify_batch_occupancy_ratio_sum", [])}
+    for rung, c in sorted(counts.items(), key=lambda kv: rung_key(kv[0])):
+        occ[rung] = {"flushes": int(c),
+                     "mean_ratio": round(sums.get(rung, 0.0) / c, 4)
+                     if c else None}
+    verify["occupancy"] = occ
+
+    comp = snap["compile"]
+    by_rung = {}
+    sources = {}
+    total = 0
+    for labels, v in by_name.get("tendermint_crypto_jit_compile_total", []):
+        # samples are per (rung, impl, source): fold sources into the
+        # per-rung view, and keep the source totals as the warm-state
+        # summary (cold=0 is the post-warm health check)
+        key = f"{labels.get('rung', '?')}/{labels.get('impl', '?')}"
+        by_rung[key] = by_rung.get(key, 0) + int(v)
+        src = labels.get("source")
+        if src:
+            sources[src] = sources.get(src, 0) + int(v)
+        total += int(v)
+    comp["by_rung"] = by_rung
+    comp["sources"] = sources
+    comp["total"] = total
+    comp["seconds_total"] = round(sum(
+        v for _l, v in by_name.get(
+            "tendermint_crypto_jit_compile_seconds_total", [])), 3)
+    rc = scalar(by_name, "tendermint_crypto_jit_recompile_total", 0)
+    comp["recompiles"] = int(rc or 0)
+
+    # per-rung roofline from the costmodel gauges: FLOPs-util % needs
+    # the measured device-execute mean (histogram sum/count) and the
+    # peak gauge; every piece degrades to absence independently
+    costs: dict[str, dict] = {}
+
+    def _fold_cost(series: str, field: str) -> None:
+        for labels, v in by_name.get(series, []):
+            if labels.get("kind", "verify") != "verify":
+                continue  # the panel is the per-row verify program's
+            costs.setdefault(labels.get("rung", "?"), {})[field] = v
+
+    _fold_cost("tendermint_crypto_verify_rung_flops", "flops")
+    _fold_cost("tendermint_crypto_verify_rung_bytes_accessed",
+               "bytes_accessed")
+    _fold_cost("tendermint_crypto_verify_rung_peak_memory_bytes",
+               "peak_memory_bytes")
+    peak = scalar(by_name, "tendermint_crypto_verify_device_peak_flops_per_s")
+    ex_count = {labels.get("rung", "?"): v for labels, v in by_name.get(
+        "tendermint_crypto_verify_device_execute_seconds_count", [])}
+    ex_sum = {labels.get("rung", "?"): v for labels, v in by_name.get(
+        "tendermint_crypto_verify_device_execute_seconds_sum", [])}
+    for rung, cell in costs.items():
+        try:
+            cell["hlo_bytes_per_row"] = cell["bytes_accessed"] / int(rung)
+        except (KeyError, ValueError, ZeroDivisionError):
+            pass
+        c = ex_count.get(rung)
+        if c and cell.get("flops") and ex_sum.get(rung):
+            achieved = cell["flops"] / (ex_sum[rung] / c)
+            cell["achieved_flops_per_s"] = achieved
+            if peak:
+                cell["flops_util"] = achieved / peak
+    snap["costs"] = costs
+
+    # tx lifecycle summary from the always-on histograms: count + mean +
+    # bucket-quantile upper bounds (p50/p95 read "≤ bucket edge")
+    tl = snap.setdefault(
+        "txlife", {"finality": None, "residency": None, "quorum_wait": {}})
+    tl["finality"] = hist_summary(
+        by_name, "tendermint_tx_time_to_finality_seconds")
+    tl["residency"] = hist_summary(
+        by_name, "tendermint_mempool_residency_seconds")
+    for vtype in ("prevote", "precommit"):
+        cell = hist_summary(
+            by_name, "tendermint_consensus_quorum_wait_seconds",
+            match={"type": vtype})
+        if cell:
+            tl["quorum_wait"][vtype] = cell
+
+    # health watchdog: the per-detector gauge is the metrics-side twin
+    # of the RPC status block (whichever source answered fills it)
+    hl = snap.setdefault("health", {"level": None, "detectors": {}})
+    if hl["level"] is None:
+        dets = {labels.get("detector", "?"): int(v)
+                for labels, v in by_name.get("tendermint_health_status", [])}
+        if dets:
+            hl["detectors"] = dets
+            hl["level"] = max(dets.values())
+
+    # remediation controller: the active-state gauge is the metrics-side
+    # twin of status.health.remediation
+    rl = snap.setdefault("remediation", {"enabled": None, "shed_level": None,
+                                         "by_action": {}, "quarantined": 0})
+    if rl["enabled"] is None:
+        active = {labels.get("action", "?"): v for labels, v in
+                  by_name.get("tendermint_remediation_active", [])}
+        acts: dict[str, int] = {}
+        for labels, v in by_name.get("tendermint_remediation_actions_total",
+                                     []):
+            a = labels.get("action", "?")
+            acts[a] = acts.get(a, 0) + int(v)
+        if active or acts:
+            rl.update({"enabled": True,
+                       "shed_level": int(active.get("shed", 0)),
+                       "by_action": acts,
+                       "quarantined": int(active.get("evict", 0))})
+
+    # gateway: the metrics-side twin of status.gateway.  The series are
+    # registered typed-but-zero when no gateway is active, so only a
+    # non-zero signal (clients, jobs or cache traffic) fills the panel.
+    gl = snap.setdefault("gateway", {"enabled": None})
+    if gl.get("enabled") is None:
+        g_clients = scalar(by_name, "tendermint_gateway_clients")
+        g_jobs = scalar(by_name, "tendermint_gateway_verify_jobs_total", 0)
+        g_hits = scalar(by_name, "tendermint_gateway_cache_hits_total", 0)
+        g_miss = scalar(by_name, "tendermint_gateway_cache_misses_total", 0)
+        if (g_clients or 0) or (g_jobs or 0) or (g_hits or 0) + (g_miss or 0):
+            coal = scalar(by_name,
+                          "tendermint_gateway_verify_coalesced_total", 0)
+            lookups = (g_hits or 0) + (g_miss or 0)
+            flushed = (g_jobs or 0) - (coal or 0)
+            gl.update({
+                "enabled": True,
+                "clients": int(g_clients or 0),
+                "cache_hit_ratio": round((g_hits or 0) / lookups, 4)
+                if lookups else 0.0,
+                "dedup_ratio": round((g_jobs or 0) / flushed, 2)
+                if flushed > 0 else 0.0,
+                "shed_total": int(scalar(
+                    by_name, "tendermint_gateway_shed_total", 0) or 0),
+                "shed_level": None,
+            })
+
+    mem: dict[str, dict] = {}
+    for labels, v in by_name.get("tendermint_crypto_device_memory_bytes", []):
+        dev = labels.get("device", "?")
+        entry = mem.setdefault(dev, {"device": dev,
+                                     "platform": labels.get("platform", "?")})
+        entry[labels.get("kind", "bytes")] = int(v)
+    snap["device_memory"] = [mem[k] for k in sorted(mem)]

@@ -1,0 +1,173 @@
+"""utils/promparse.py — the shared exposition parser/folder.
+
+The parser lived inside cli/top.py for six PRs with no direct tests
+(only indirect coverage through top's fold); now that the fleet
+scraper is its second consumer it gets pinned on its own: quantile
+edges (empty, single-bucket, +Inf-only mass, labeled sub-hists),
+merged-histogram additivity, and a round-trip against the repo's OWN
+exposition writer (utils/metrics.Registry.expose) so writer and parser
+can never drift apart.  The live-node pin (a real 4-node localnet's
+merged series) rides tests/test_fleet.py's acceptance test.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.utils import promparse
+from tendermint_tpu.utils.metrics import Counter, Histogram, Registry
+
+
+def _hist_text(base: str, buckets: dict, count: float, total: float,
+               labels: str = "") -> str:
+    def lbl(extra: str) -> str:
+        parts = [x for x in (labels, extra) if x]
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    lines = []
+    for le, v in buckets.items():
+        le_label = 'le="' + str(le) + '"'
+        lines.append(f"{base}_bucket{lbl(le_label)} {v}")
+    lines.append(f"{base}_sum{lbl('')} {total}")
+    lines.append(f"{base}_count{lbl('')} {count}")
+    return "\n".join(lines)
+
+
+def test_parse_exposition_labels_and_garbage():
+    text = "\n".join([
+        "# HELP x y",
+        "# TYPE x counter",
+        'x{a="1",b="two"} 3',
+        "x 4",
+        "not-a-sample",
+        "trailing NaNish abc",
+        "y 1.5",
+    ])
+    samples = promparse.parse_exposition(text)
+    assert ("x", {"a": "1", "b": "two"}, 3.0) in samples
+    assert ("x", {}, 4.0) in samples
+    assert ("y", {}, 1.5) in samples
+    assert len(samples) == 3  # comments/garbage skipped
+
+
+def test_scalar_and_index():
+    by = promparse.index_samples([("a", {}, 2.0), ("a", {"l": "x"}, 5.0)])
+    assert promparse.scalar(by, "a") == 2.0
+    assert promparse.scalar(by, "missing", default=7) == 7
+
+
+def test_hist_summary_empty_is_none():
+    by = promparse.index_samples(promparse.parse_exposition(
+        _hist_text("h", {"0.1": 0, "+Inf": 0}, 0, 0.0)))
+    assert promparse.hist_summary(by, "h") is None
+    assert promparse.hist_summary({}, "h") is None
+
+
+def test_hist_summary_single_bucket():
+    by = promparse.index_samples(promparse.parse_exposition(
+        _hist_text("h", {"0.5": 4, "+Inf": 4}, 4, 1.2)))
+    s = promparse.hist_summary(by, "h", quantiles=(0.5, 0.95, 0.99))
+    assert s["count"] == 4
+    assert s["mean_s"] == 0.3
+    assert s["p50_s"] == s["p95_s"] == s["p99_s"] == 0.5
+
+
+def test_hist_summary_inf_only_mass():
+    # every observation past the last finite edge: quantiles are
+    # UNBOUNDED (None), not zero — the SLO layer reads this as a
+    # latency violation, never as "fast"
+    by = promparse.index_samples(promparse.parse_exposition(
+        _hist_text("h", {"0.1": 0, "+Inf": 3}, 3, 30.0)))
+    s = promparse.hist_summary(by, "h")
+    assert s["count"] == 3
+    assert s["p50_s"] is None and s["p95_s"] is None
+
+
+def test_hist_summary_labeled_subhists_match():
+    text = "\n".join([
+        _hist_text("w", {"0.1": 10, "+Inf": 10}, 10, 0.5,
+                   labels='type="prevote"'),
+        _hist_text("w", {"0.1": 0, "1": 2, "+Inf": 2}, 2, 1.6,
+                   labels='type="precommit"'),
+    ])
+    by = promparse.index_samples(promparse.parse_exposition(text))
+    pre = promparse.hist_summary(by, "w", match={"type": "prevote"})
+    assert pre["count"] == 10 and pre["p95_s"] == 0.1
+    post = promparse.hist_summary(by, "w", match={"type": "precommit"})
+    assert post["count"] == 2 and post["p50_s"] == 1.0
+    # unfiltered folds BOTH labelsets additively
+    both = promparse.hist_summary(by, "w")
+    assert both["count"] == 12
+
+
+def test_merge_samples_histogram_additivity():
+    # two "nodes" with the same histogram: the merged summary must be
+    # the per-bucket SUM (the Prometheus sum-by-le aggregation), and
+    # the merged quantile must re-resolve over the combined mass
+    a = promparse.parse_exposition(
+        _hist_text("h", {"0.1": 8, "1": 8, "+Inf": 8}, 8, 0.4))
+    b = promparse.parse_exposition(
+        _hist_text("h", {"0.1": 0, "1": 4, "+Inf": 6}, 6, 9.0))
+    merged = promparse.index_samples(promparse.merge_samples([a, b]))
+    s = promparse.hist_summary(merged, "h", quantiles=(0.5, 0.95))
+    sa = promparse.hist_summary(promparse.index_samples(a), "h")
+    sb = promparse.hist_summary(promparse.index_samples(b), "h")
+    assert s["count"] == sa["count"] + sb["count"] == 14
+    # bucket math: le=0.1 -> 8, le=1 -> 12, target p50 = 7 <= 8 -> 0.1
+    assert s["p50_s"] == 0.1
+    # p95 target 13.3 > 12: only +Inf covers it -> unbounded
+    assert s["p95_s"] is None
+    # counters sum; distinct labelsets stay distinct
+    c = promparse.merge_samples([
+        [("t", {"k": "a"}, 2.0), ("t", {"k": "b"}, 1.0)],
+        [("t", {"k": "a"}, 3.0)],
+    ])
+    as_dict = {tuple(sorted(l.items())): v for _n, l, v in c}
+    assert as_dict[(("k", "a"),)] == 5.0
+    assert as_dict[(("k", "b"),)] == 1.0
+
+
+def test_round_trip_against_repo_exposition_writer():
+    # writer/parser pin: whatever utils/metrics renders, promparse must
+    # read back exactly — including label ordering and +Inf buckets
+    reg = Registry()
+    h = reg.register(Histogram("lat_seconds", "x", namespace="tm",
+                               buckets=(0.1, 1.0)))
+    c = reg.register(Counter("events_total", "x", namespace="tm"))
+    for v in (0.05, 0.06, 0.5, 5.0):
+        h.observe(v)
+    c.inc(7)
+    by = promparse.index_samples(
+        promparse.parse_exposition(reg.expose()))
+    assert promparse.scalar(by, "tm_events_total") == 7.0
+    s = promparse.hist_summary(by, "tm_lat_seconds",
+                               quantiles=(0.5, 0.95, 0.99))
+    assert s["count"] == 4
+    assert s["p50_s"] == 0.1      # 2 of 4 within the 0.1 bucket
+    assert s["p95_s"] is None     # the 5.0 observation is +Inf-only
+    assert abs(s["mean_s"] - (0.05 + 0.06 + 0.5 + 5.0) / 4) < 1e-6
+
+
+def test_top_backcompat_aliases():
+    # cli/top re-exports the parser under its historical names; the
+    # devmon/metrics tests (and any operator scripts) rely on them
+    from tendermint_tpu.cli import top
+
+    assert top.parse_exposition is promparse.parse_exposition
+    assert top._hist_summary is promparse.hist_summary
+    assert top._fold_metrics is promparse.fold_metrics
+    assert top._index is promparse.index_samples
+
+
+def test_fold_metrics_fills_empty_snapshot():
+    snap = promparse.empty_snapshot()
+    text = "\n".join([
+        "tendermint_consensus_height 9",
+        "tendermint_crypto_verify_queue_depth 3",
+        'tendermint_health_status{detector="height_stall"} 2',
+        'tendermint_health_status{detector="peer_flap"} 0',
+    ])
+    by = promparse.index_samples(promparse.parse_exposition(text))
+    promparse.fold_metrics(snap, by)
+    assert snap["height"] == 9
+    assert snap["verify"]["queue_depth"] == 3
+    assert snap["health"]["level"] == 2
+    assert snap["health"]["detectors"]["height_stall"] == 2
