@@ -438,6 +438,72 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["simnet_error"] = str(e)[-300:]
 
+        # -- virtual-time simnet (round 15, ISSUE 15): the same harness
+        # on the deterministic discrete-event scheduler.  A fixed-seed
+        # 50-node / 1000-slot scenario runs TWICE; the stage reports the
+        # wall cost of simulating it (slots/s, virtual-seconds per wall
+        # second) and whether the two verdicts are byte-identical — the
+        # determinism contract as a tracked boolean.  Before the device
+        # stages (the r05 tail-loss lesson) and budgeted like its wall
+        # twin above.
+        _stage_set("simnet-virtual")
+        try:
+            budget = min(140.0, _deadline_left() - 240.0)
+            if budget < 80:
+                raise RuntimeError("skipped: %.0fs left" % _deadline_left())
+            import hashlib
+            import json as _json
+            import tempfile
+
+            from tendermint_tpu.simnet.harness import run_scenario
+            from tendermint_tpu.simnet.scenario import FaultOp, Scenario
+
+            def _vsc():
+                return Scenario(
+                    name="bench-virtual-50", seed=701, validators=50,
+                    validator_slots=1000, slot_power=1, target_height=4,
+                    max_runtime_s=60.0, load_rate=15, time="virtual",
+                    mesh_degree=5, max_rounds=10,
+                    faults=[
+                        FaultOp(op="slow", at_height=2, nodes=[2, 3],
+                                latency_ms=40, jitter_ms=10),
+                        FaultOp(op="clear", at_height=3),
+                    ],
+                )
+
+            walls, hashes, reps = [], [], []
+            for _run in range(2):
+                t0 = time.monotonic()
+                with tempfile.TemporaryDirectory() as td:
+                    rep = run_scenario(_vsc(), td)
+                walls.append(time.monotonic() - t0)
+                hashes.append(hashlib.sha256(
+                    _json.dumps(rep, sort_keys=True,
+                                default=str).encode()).hexdigest())
+                reps.append(rep)
+            rep = reps[0]
+            sc0 = _vsc()
+            heights = rep["heights"]["min_honest"]
+            wall = walls[0]
+            _partial.update({
+                "simnet_virtual_ok": rep["ok"],
+                "simnet_virtual_nodes": sc0.validators,
+                "simnet_virtual_slots": sc0.total_slots(),
+                "simnet_virtual_heights": heights,
+                # validator-slot-heights simulated per wall second: the
+                # scale x progress the scheduler buys per core-second
+                "simnet_virtual_slots_per_s": round(
+                    sc0.total_slots() * heights / wall, 2),
+                # virtual seconds simulated per wall second
+                "simnet_time_compression": round(
+                    rep["duration_s"] / wall, 4) if wall else 0.0,
+                "simnet_virtual_wall_s": round(wall, 2),
+                "simnet_virtual_duration_s": rep["duration_s"],
+                "simnet_virtual_deterministic": hashes[0] == hashes[1],
+            })
+        except Exception as e:  # noqa: BLE001
+            _partial["simnet_virtual_error"] = str(e)[-300:]
+
         # -- tx latency (round 9, ISSUE 9): finality percentiles on a
         # clean 4-node localnet — the latency twin of the simnet stage's
         # accepted-tx/s.  The metric keys end in _ms so benchdiff tracks

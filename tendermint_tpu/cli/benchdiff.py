@@ -60,6 +60,8 @@ META_KEYS = {
     "benchdiff_base", "benchdiff_regressions", "benchdiff_missing",
     "benchdiff_ok", "shootout_rung", "shootout_n", "shootout_runs",
     "gateway_clients", "fleet_nodes",
+    "simnet_virtual_nodes", "simnet_virtual_slots",
+    "simnet_virtual_heights",
 }
 
 # Ordered (pattern, class, direction) — first match wins.  direction
@@ -75,7 +77,12 @@ _CLASS_RULES = (
     # direction as the ratios above, named per the SLO vocabulary
     (re.compile(r"_availability$"), "ratio", "higher"),
     (re.compile(r"^(value|vs_baseline)$"), "throughput", "higher"),
-    (re.compile(r"(_ok|_within_budget|_warmed|plan_warmed)$"),
+    # virtual-time simnet (simnet-virtual stage): simulated seconds per
+    # wall second — the whole point of the discrete-event scheduler, so
+    # a drop is a straight throughput regression
+    (re.compile(r"_time_compression$"), "throughput", "higher"),
+    (re.compile(r"(_ok|_within_budget|_warmed|plan_warmed"
+                r"|_deterministic)$"),
      "boolean", "higher"),
     (re.compile(r"(_p50_ms|_ms)$"), "latency", "lower"),
     (re.compile(r"(_bytes_per_row|_flops_per_row)$"), "resource", "lower"),

@@ -873,6 +873,11 @@ def cmd_simnet(args) -> int:
             scenario = load_scenario(args.scenario)
         else:
             scenario = generate_scenario(args.gen_seed, args.gen_index)
+        if args.time:
+            # operator override: rerun any scenario file on the other
+            # clock (e.g. confirm a virtual verdict against wall time)
+            scenario.time = args.time
+            scenario.validate()
     except (OSError, ValueError, ImportError) as e:
         print(f"simnet: cannot load scenario: {e}", file=sys.stderr)
         return 2
@@ -1181,6 +1186,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--gen-index", dest="gen_index", type=int, default=0,
                     help="generator mode: scenario index within the seed's "
                          "sweep (default 0)")
+    sp.add_argument("--time", choices=("wall", "virtual"), default="",
+                    help="override the scenario's time mode: 'virtual' runs "
+                         "on the deterministic discrete-event scheduler "
+                         "(zero wall time per simulated second, "
+                         "byte-reproducible verdicts; docs/simnet.md)")
     sp.add_argument("--root", default="",
                     help="directory for node homes (default: a temp dir, "
                          "removed unless --keep)")

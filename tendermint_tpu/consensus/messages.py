@@ -279,12 +279,32 @@ def encode_consensus_message(msg) -> bytes:
     return ProtoWriter().message(fld, msg.encode(), always=True).bytes_out()
 
 
+# Bounded decode memo: gossip re-delivers IDENTICAL wire frames many
+# times — a broadcast vote reaches every peer as the same bytes, each
+# relay hop re-sends it, and an in-process net (simnet, test localnets)
+# decodes each frame once per receiving node.  Decoding is a pure
+# function of the bytes and every decoded message is a value object the
+# handlers never mutate (Vote's verify marker binds content, not
+# identity), so identical frames can share one decode.  CPython caches
+# the hash of a bytes object, and the router encodes a broadcast once —
+# so for the dominant case the lookup costs a pointer-keyed dict probe.
+_DECODE_MEMO_MAX = 8192
+_decode_memo: dict[bytes, object] = {}
+
+
 @guard_decode
 def decode_consensus_message(data: bytes):
+    msg = _decode_memo.get(data)
+    if msg is not None:
+        return msg
     f = fields_to_dict(data)
     for t, fld in _GOSSIP_FIELD.items():
         if fld in f:
-            return t.decode(f[fld][0])
+            msg = t.decode(f[fld][0])
+            if len(_decode_memo) >= _DECODE_MEMO_MAX:
+                _decode_memo.clear()   # wholesale: heights age out anyway
+            _decode_memo[bytes(data)] = msg
+            return msg
     raise ValueError("unknown consensus message")
 
 

@@ -38,6 +38,17 @@ class PeerState:
     def __init__(self, node_id: str):
         self.node_id = node_id
         self.prs = PeerRoundState()
+        # catchup-stall watchdog (reactor._gossip_catchup): the bitmaps
+        # above are marked on SEND, so a frame dropped by a partition or
+        # lossy link leaves them claiming the peer has data it never
+        # received.  A peer whose step never advances can't reset them
+        # through apply_new_round_step either — the wedge simnet's
+        # deterministic runs exposed: one lost block part froze a node
+        # in COMMIT step forever while every peer believed it had the
+        # full set.  The reactor counts no-progress catchup ticks here
+        # and re-initializes the optimistic bitmaps past the threshold.
+        self.catchup_stale_height = -1
+        self.catchup_stale_ticks = 0
 
     def snapshot(self) -> dict:
         """JSON-ready view of the peer's claimed round state (reference
@@ -73,6 +84,15 @@ class PeerState:
         # must not regress the view or clear the vote bitmaps
         if (msg.height, msg.round, int(msg.step)) <= (ps_height, ps_round, int(ps_step)):
             return
+        # capture BEFORE the wipe below (reference ApplyNewRoundStepMessage
+        # saves psPrecommits first): the height-advance branch shifts the
+        # peer's precommit bitmap into last_commit.  Reading the field
+        # after nulling it — the bug this replaces — made every height
+        # transition forget which precommits the peer already holds, so
+        # the NEW_HEIGHT gossip path re-streamed the ENTIRE last commit
+        # over every link every height (the dominant vote-frame source
+        # on 100-node simnet runs).
+        ps_precommits = prs.precommits
         prs.height = msg.height
         prs.round = msg.round
         prs.step = Step(msg.step)
@@ -92,7 +112,11 @@ class PeerState:
             # peer moved to a new height: shift commit tracking
             if ps_height + 1 == msg.height and ps_round == msg.last_commit_round:
                 prs.last_commit_round = msg.last_commit_round
-                prs.last_commit = prs.precommits
+                # a degenerate empty bitmap must not survive the shift
+                # (see _ensure_vote_bitarrays) — None lets the gossip
+                # path lazily create a correctly-sized one
+                prs.last_commit = (ps_precommits if ps_precommits is not None
+                                   and ps_precommits.size() > 0 else None)
             else:
                 prs.last_commit_round = msg.last_commit_round
                 prs.last_commit = None
@@ -142,6 +166,16 @@ class PeerState:
 
     # -- vote bitmaps -----------------------------------------------------
     def _ensure_vote_bitarrays(self, height: int, num_validators: int) -> None:
+        # A zero/unknown validator count must create NOTHING: a
+        # BitArray(0) parked in prs.prevotes/precommits silently eats
+        # every subsequent set_has_vote (set_index range-checks), the
+        # sender keeps seeing an empty "theirs" bitmap, and PickSendVote
+        # re-streams the same votes forever — observed as a wall-clock
+        # runaway at 40+ nodes when a HasVote for a not-yet-stored
+        # height arrived (reactor._nvals returns 0 there).  Leaving the
+        # slot None lets a later call with the real size create it.
+        if num_validators <= 0:
+            return
         prs = self.prs
         if prs.height == height:
             if prs.prevotes is None:

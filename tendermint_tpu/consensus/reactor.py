@@ -70,6 +70,16 @@ class _CommitVotes:
     def bit_array(self) -> list[bool]:
         return [not cs.absent() for cs in self.commit.signatures]
 
+    def bits(self) -> BitArray:
+        """Present-signature bitmap, memoized on the (immutable) Commit
+        — one stored commit serves every peer's catchup gossip, so the
+        bitmap is computed once, not per pick (the PR 13 memo idiom)."""
+        ba = getattr(self.commit, "_bits_memo", None)
+        if ba is None:
+            ba = BitArray.from_bools(self.bit_array())
+            self.commit._bits_memo = ba
+        return ba
+
     def get_by_index(self, idx: int) -> Vote | None:
         cs = self.commit.signatures[idx]
         if cs.absent():
@@ -95,6 +105,7 @@ class ConsensusReactor:
         logger: Logger | None = None,
         gossip_sleep_ms: int = 100,
         maj23_sleep_ms: int = 2000,
+        jitter_rng: "random.Random | None" = None,
     ):
         self.cs = cs
         self.router = router
@@ -111,10 +122,17 @@ class ConsensusReactor:
         # wallclock-in-consensus: consensus paths use seeded entropy so
         # runs are reproducible).  TM_TPU_GOSSIP_SEED pins it for tests;
         # the default decorrelates reactors across processes AND within
-        # one process (multi-node test nets share a pid).
-        seed = os.environ.get("TM_TPU_GOSSIP_SEED")
-        self._jitter_rng = random.Random(
-            int(seed) if seed else hash((os.getpid(), id(self))))
+        # one process (multi-node test nets share a pid).  A caller may
+        # inject `jitter_rng` instead: the virtual-time simnet derives
+        # one per node from the scenario seed, because the id()-based
+        # default would differ between two same-seed runs in one process
+        # and break byte-reproducible verdicts.
+        if jitter_rng is not None:
+            self._jitter_rng = jitter_rng
+        else:
+            seed = os.environ.get("TM_TPU_GOSSIP_SEED")
+            self._jitter_rng = random.Random(
+                int(seed) if seed else hash((os.getpid(), id(self))))
 
         self.state_ch = router.open_channel(_descriptor(STATE_CHANNEL, 6))
         self.data_ch = router.open_channel(_descriptor(DATA_CHANNEL, 10))
@@ -452,7 +470,7 @@ class ConsensusReactor:
         ):
             ours = BitArray.from_bools(rs.proposal_block_parts.bit_array())
             needed = ours.sub(prs.proposal_block_parts)
-            idx, ok = needed.pick_random()
+            idx, ok = needed.pick_random(self._jitter_rng)
             if ok:
                 part = rs.proposal_block_parts.get_part(idx)
                 if part is not None:
@@ -491,7 +509,9 @@ class ConsensusReactor:
             if proposal.pol_round >= 0 and rs.votes is not None:
                 prevotes = rs.votes.prevotes(proposal.pol_round)
                 if prevotes is not None:
-                    pol = BitArray.from_bools(prevotes.bit_array())
+                    # copy: pol rides a wire message that encodes after
+                    # an await — the live bitmap could grow meanwhile
+                    pol = prevotes.bits().copy()
             await self.data_ch.send(
                 Envelope(message=ProposalMessage(proposal), to=ps.node_id)
             )
@@ -523,9 +543,30 @@ class ConsensusReactor:
             prs.proposal_block_part_set_header = meta.block_id.part_set_header
             prs.proposal_block_parts = BitArray(meta.block_id.part_set_header.total)
         needed = prs.proposal_block_parts.not_()
-        idx, ok = needed.pick_random()
+        idx, ok = needed.pick_random(self._jitter_rng)
         if not ok:
+            # Everything is marked sent yet the peer is still behind.
+            # Marks are optimistic (set on send, not on receipt): a part
+            # dropped by a partition/lossy link leaves the bitmap full
+            # while the peer still lacks it, and a peer wedged in COMMIT
+            # step never advances its round step, so nothing ever resets
+            # the bitmap (PeerState.catchup_stale_* documents the wedge).
+            # After enough no-progress gossip ticks at the same height,
+            # forget what we think it has and re-stream — a few dozen
+            # redundant frames against a liveness wedge.
+            if ps.catchup_stale_height == prs.height:
+                ps.catchup_stale_ticks += 1
+                if ps.catchup_stale_ticks >= 16:
+                    prs.proposal_block_parts = None
+                    prs.catchup_commit = None
+                    prs.catchup_commit_round = -1
+                    ps.catchup_stale_ticks = 0
+            else:
+                ps.catchup_stale_height = prs.height
+                ps.catchup_stale_ticks = 1
             return False
+        ps.catchup_stale_height = -1
+        ps.catchup_stale_ticks = 0
         part = self.block_store.load_block_part(prs.height, idx)
         if part is None:
             return False
@@ -622,7 +663,13 @@ class ConsensusReactor:
         height = getattr(votes, "height", prs.height)
         vtype = getattr(votes, "signed_msg_type", SignedMsgType.PRECOMMIT)
         round_ = votes.round
-        ours = BitArray.from_bools(votes.bit_array())
+        # the live incremental bitmap where the source keeps one
+        # (VoteSet.bits / _CommitVotes.bits): every read below is
+        # non-mutating, and sub() copies — rebuilding from bools here
+        # was O(validator slots) per peer-tick
+        bits = getattr(votes, "bits", None)
+        ours = bits() if bits is not None else \
+            BitArray.from_bools(votes.bit_array())
         # When the source IS a commit (canonical Commit, or a precommit
         # set carrying +2/3) and the peer sits at that height on a LATER
         # round, it still needs these round-`round_` precommits to
@@ -650,7 +697,7 @@ class ConsensusReactor:
                               peer_h=prs.height, peer_r=prs.round)
             return False
         needed = ours.sub(theirs)
-        idx, ok = needed.pick_random()
+        idx, ok = needed.pick_random(self._jitter_rng)
         if not ok:
             return False
         vote = votes.get_by_index(idx)

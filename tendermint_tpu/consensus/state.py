@@ -21,10 +21,10 @@ signature inline per addVote, types/vote_set.go:203).
 from __future__ import annotations
 
 import asyncio
-import time
 
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.state import State
+from tendermint_tpu.utils import clock as _clock
 from tendermint_tpu.utils import trace as _trace
 from tendermint_tpu.utils import txlife as _txlife
 from tendermint_tpu.utils.metrics import Histogram
@@ -464,12 +464,15 @@ class ConsensusState:
         prev = self.rs.step
         self.rs.round = round_
         self.rs.step = step
+        # perf stamps ride the pluggable clock seam: the derived wait_ms
+        # lands on journal polka/commit_maj lines, which a virtual-time
+        # simnet run must reproduce byte-for-byte across same-seed runs
         if step == Step.PREVOTE:
             self._quorum_t0["prevote"] = (
-                self.rs.height, round_, time.perf_counter())
+                self.rs.height, round_, _clock.perf())
         elif step == Step.PRECOMMIT:
             self._quorum_t0["precommit"] = (
-                self.rs.height, round_, time.perf_counter())
+                self.rs.height, round_, _clock.perf())
         if self.journal.enabled and not self.replay_mode:
             self.journal.log("step", h=self.rs.height, r=round_,
                              step=step.name, prev=prev.name)
@@ -487,7 +490,7 @@ class ConsensusState:
         h, r, t0 = ent
         if h != height or r != round_:
             return None
-        dt = time.perf_counter() - t0
+        dt = _clock.perf() - t0
         _txlife.QUORUM_WAIT_SECONDS.observe(dt, type=kind)
         return dt
 
@@ -496,7 +499,7 @@ class ConsensusState:
         step_duration histogram plus (when tracing) a complete span
         carrying height/round.  WAL replay transitions are synthetic and
         are excluded, same as event publication."""
-        now = time.perf_counter()
+        now = _clock.perf()
         t0, self._step_t0 = self._step_t0, now
         if self.replay_mode or t0 is None:
             return

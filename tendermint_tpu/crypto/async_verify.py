@@ -615,6 +615,38 @@ def submit(pub, msg: bytes, sig: bytes) -> Future:
     return get_service().submit(pub, msg, sig)
 
 
+def verify_one(pub, msg: bytes, sig: bytes, fill: bool = True) -> bool:
+    """ONE signature against the shared verified-sig LRU: probe, else
+    verify on the caller's thread and (only on success, and only when
+    `fill`) populate the cache.  The single-signature admission paths
+    (VoteSet.add_vote for own/broadcast-delivered votes, proposal
+    signature checks) were the last verify surfaces still paying a full
+    scalar-mult per CALLER per signature — an in-process multi-node net
+    (simnet, test localnets) re-verified every broadcast vote once per
+    node.  Deliberately NOT submitted to the service queue: a single
+    must not perturb the worker's flush/coalescing behavior (threshold
+    routing, linger) nor block on the linger window — the cache is the
+    only shared state touched.
+
+    `fill=False` (the vote path) probes without populating: votes are
+    ALSO verified through the batched service path (precheck slices),
+    and a cache pre-filled by trickling singles would starve those
+    flushes of fresh work — the device batch path would never engage on
+    a quiet net.  Slice-verified votes fill the cache through the
+    service as before; the probe here then serves every later caller.
+    TM_TPU_ASYNC_VERIFY=0 keeps even the cache out of the path."""
+    if not service_enabled():
+        return bool(pub.verify_signature(msg, sig))
+    cache = get_service().cache
+    key = VerifiedSigCache.key(_pub_bytes(pub), bytes(msg), bytes(sig))
+    if cache.get(key):
+        return True
+    ok = bool(pub.verify_signature(msg, sig))
+    if ok and fill:
+        cache.put(key)
+    return ok
+
+
 def service_stats() -> dict:
     """Counters for metrics/bench scraping; zeros before first use (the
     metrics server must not instantiate the service).  The service

@@ -11,6 +11,7 @@ going through crypto.batch / ops.ed25519_jax.
 
 from __future__ import annotations
 
+import functools
 import secrets
 
 from . import ed25519 as _ed
@@ -115,5 +116,10 @@ def gen_priv_key() -> PrivKey:
     return PrivKey(secrets.token_bytes(32))
 
 
+@functools.lru_cache(maxsize=16384)
 def priv_key_from_seed(seed: bytes) -> PrivKey:
+    """Seed -> key, memoized: construction derives the public key (a
+    full scalar mult — milliseconds on the pure-python path), keys are
+    immutable, and deterministic harnesses (simnet genesis, testnets)
+    re-derive the same thousands of slot keys every run."""
     return PrivKey(seed)

@@ -1,6 +1,6 @@
 """AST analyzer behind `tendermint-tpu lint`.
 
-Six rules, each motivated by a shipped bug or a hot-path invariant:
+Seven rules, each motivated by a shipped bug or a hot-path invariant:
 
   import-time-env          Module-level `os.environ` reads freeze config
                            before tests/operators can set it (the PR 3
@@ -24,6 +24,11 @@ Six rules, each motivated by a shipped bug or a hot-path invariant:
                            `random.*` in consensus/ — steps must use
                            monotonic clocks and seeded entropy so replay
                            and tests are deterministic.
+  unpluggable-clock        direct `time.*` calls in the modules the
+                           virtual-time simnet must own (ISSUE 15):
+                           every read flows through the utils/clock
+                           seam or `time = "virtual"` runs stop being
+                           a pure function of their seed.
   metric-name-conformance  Counter series must end `_total`, gauges must
                            not, duplicate metric names, and unbounded
                            ("high-cardinality") label names.
@@ -70,6 +75,10 @@ RULES: dict[str, str] = {
     "wallclock-in-consensus":
         "wall clock (time.time/time_ns) or unseeded module-level random.* "
         "in consensus/ — use monotonic clocks / seeded random.Random",
+    "unpluggable-clock":
+        "direct time.* read (time/time_ns/monotonic/perf_counter[_ns]/"
+        "sleep) in a simnet-controlled module — route it through the "
+        "utils/clock seam so virtual-time runs stay deterministic",
     "metric-name-conformance":
         "counter not ending _total, gauge/histogram ending _total, "
         "duplicate metric name, or high-cardinality label name",
@@ -96,6 +105,29 @@ OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
                            "gateway/service.py",
                            "fleet/slo.py", "fleet/aggregate.py",
                            "fleet/scrape.py"}
+
+#: modules the virtual-time simnet must fully own the clock of
+#: (ISSUE 15): every time they read — journal stamps, detector
+#: timelines, peer liveness, block timestamps — flows through the
+#: utils/clock seam, so a `time = "virtual"` run is a pure function of
+#: its seed.  A direct `time.*` call here silently re-couples the
+#: module to the wall clock and breaks byte-reproducible verdicts.
+#: Entries are "dir/filename" (or bare filenames for unambiguous
+#: names); utils/clock.py itself is the seam and exempt.  asyncio.sleep
+#: is NOT flagged: it rides the event loop, which IS the virtual clock.
+CLOCK_SEAM_FILES = {
+    "simnet/harness.py", "simnet/faults.py", "simnet/scenario.py",
+    "simnet/verdict.py", "simnet/vclock.py",
+    "consensus/eventlog.py", "consensus/ticker.py", "consensus/state.py",
+    "consensus/peer_state.py",
+    "types/basic.py", "p2p/backoff.py", "p2p/router.py",
+    "utils/health.py", "utils/remediate.py", "utils/txlife.py",
+    "fleet/slo.py",
+}
+
+#: the time.* attributes the unpluggable-clock rule flags when CALLED
+_CLOCK_ATTRS = {"time", "time_ns", "monotonic", "monotonic_ns",
+                "perf_counter", "perf_counter_ns", "sleep"}
 
 #: label names that explode series cardinality on a real network
 HIGH_CARDINALITY_LABELS = {"height", "hash", "tx_hash", "block_hash",
@@ -140,6 +172,9 @@ class FileContext:
         self.tree = ast.parse(source, filename=str(path))
         parts = Path(display).parts
         self.in_consensus = "consensus" in parts
+        self.clock_seam = (
+            f"{path.parent.name}/{path.name}" in CLOCK_SEAM_FILES
+            or path.name in CLOCK_SEAM_FILES)
         self.jax_allowed = bool(JAX_ALLOWED_DIRS.intersection(parts))
         self.obs_definition = (
             path.name in OBSERVABILITY_DEF_FILES
@@ -556,6 +591,20 @@ class _Walker:
                 self._report(
                     node, "host-sync-in-jit",
                     "jax.device_get() inside a jit-compiled function")
+
+        # unpluggable-clock: direct time.* CALLS in the modules the
+        # virtual-time simnet must own (references like the
+        # `clock=time.monotonic` default-argument idiom are fine — only
+        # a call reads the wall clock)
+        if self.ctx.clock_seam and isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "time" and func.attr in _CLOCK_ATTRS:
+            self._report(
+                node, "unpluggable-clock",
+                f"time.{func.attr}() in a simnet-controlled module — "
+                "read the utils/clock seam (clock.wall_ns/monotonic/"
+                "perf) so time = \"virtual\" runs stay a pure function "
+                "of the seed")
 
         # wallclock-in-consensus
         if self.ctx.in_consensus and isinstance(func, ast.Attribute) \

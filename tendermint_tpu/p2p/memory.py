@@ -34,7 +34,16 @@ class MemoryConnection:
 
     async def receive(self) -> tuple[int, bytes]:
         """Returns (channel_id, payload); raises ConnectionError on close."""
-        if self._closed.is_set() and self._recv_q.empty():
+        if not self._recv_q.empty():
+            # fast path: a frame is already queued — skip the two-future
+            # wait below, which built and tore down two tasks per frame
+            # and dominated the per-frame cost on busy simnet nets
+            item = self._recv_q.get_nowait()
+            if item is None:
+                self._closed.set()
+                raise ConnectionError("connection closed by peer")
+            return item
+        if self._closed.is_set():
             raise ConnectionError("connection closed")
         recv = asyncio.ensure_future(self._recv_q.get())
         closed = asyncio.ensure_future(self._closed.wait())

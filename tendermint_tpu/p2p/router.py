@@ -30,9 +30,9 @@ redials with backoff.
 from __future__ import annotations
 
 import asyncio
-import time
 from collections import deque
 
+from tendermint_tpu.utils import clock as _clock
 from tendermint_tpu.utils.log import Logger, nop_logger
 
 from .channel import Channel
@@ -51,7 +51,7 @@ class _Peer:
     def __init__(self, node_id: NodeID, conn):
         self.node_id = node_id
         self.conn = conn
-        self.connected_at = time.monotonic()
+        self.connected_at = _clock.monotonic()
         # per-channel bounded send queues (reference MConnection
         # Channel.sendQueue w/ SendQueueCapacity): channel isolation is
         # the point — see module docstring
@@ -59,11 +59,11 @@ class _Peer:
         # exponentially-decayed bytes recently sent per channel, the
         # fair-scheduling signal (reference channel.recentlySent)
         self.recent_sent: dict[int, float] = {}
-        self._recent_stamp = time.monotonic()
+        self._recent_stamp = _clock.monotonic()
         self.send_ready = asyncio.Event()
         self.pong_owed = False
         self.ping_due = False
-        self.last_recv = time.monotonic()
+        self.last_recv = _clock.monotonic()
         self.tasks: list[asyncio.Task] = []
 
 
@@ -150,7 +150,7 @@ class Router:
         peer = self.peers.get(node_id)
         if peer is None:
             return None
-        now = time.monotonic()
+        now = _clock.monotonic()
         recv = self.peer_bytes_received.get(node_id, {})
         sent = self.peer_bytes_sent.get(node_id, {})
         channels = []
@@ -264,7 +264,7 @@ class Router:
         try:
             while True:
                 channel_id, data = await peer.conn.receive()
-                peer.last_recv = time.monotonic()
+                peer.last_recv = _clock.monotonic()
                 self._count_recv(peer.node_id, channel_id, len(data))
                 if channel_id == CTRL_CHANNEL:
                     if data == _PING:
@@ -297,7 +297,19 @@ class Router:
         """Non-empty channel with the lowest recently-sent/priority ratio
         (reference MConnection channel selection, connection.go:422-434):
         priority-weighted fair shares, no channel ever starved."""
-        now = time.monotonic()
+        # fast path: exactly one channel has queued data — fairness math
+        # is moot, and this is the common shape of a gossip burst (the
+        # per-frame decay walk showed up on 100-node simnet profiles)
+        busy = None
+        for cid, q in peer.send_queues.items():
+            if q:
+                if busy is not None:   # second busy channel: need fairness
+                    busy = None
+                    break
+                busy = cid
+        else:
+            return busy   # zero or one busy channel — no contest
+        now = _clock.monotonic()
         # decay recentlySent ~0.8x per 100 ms (reference flush cadence)
         decay = 0.8 ** ((now - peer._recent_stamp) / 0.1)
         peer._recent_stamp = now
@@ -352,13 +364,13 @@ class Router:
         ping_interval + pong_timeout instead of occupying a peer slot
         until the OS gives up (VERDICT r3 missing #2)."""
         try:
-            next_ping = time.monotonic() + self.ping_interval
+            next_ping = _clock.monotonic() + self.ping_interval
             while True:
                 # pings hold the ping_interval cadence: the pong wait
                 # overlaps the time until the next ping rather than
                 # stretching the period to interval + timeout
-                await asyncio.sleep(max(0.0, next_ping - time.monotonic()))
-                t_ping = time.monotonic()
+                await asyncio.sleep(max(0.0, next_ping - _clock.monotonic()))
+                t_ping = _clock.monotonic()
                 next_ping = t_ping + self.ping_interval
                 peer.ping_due = True
                 peer.send_ready.set()
@@ -367,7 +379,7 @@ class Router:
                     self.logger.info(
                         "peer unresponsive, evicting",
                         peer=peer.node_id[:8],
-                        silent_s=round(time.monotonic() - peer.last_recv, 1),
+                        silent_s=round(_clock.monotonic() - peer.last_recv, 1),
                     )
                     asyncio.get_running_loop().create_task(
                         self._disconnect(peer.node_id)

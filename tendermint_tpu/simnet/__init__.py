@@ -1,22 +1,33 @@
 """Simnet: fault-injecting in-process scenario harness.
 
-Stands up 20-50 in-process nodes (hundreds-to-thousands of validator
-slots) over a fault-injection layer wrapped around the memory transport
-(`faults.FaultyNetwork`), drives tx load, applies a declarative fault
-schedule (partitions, slow links, drops, crash-restart with WAL replay,
-byzantine mavericks), and computes a machine-checkable verdict from the
-merged consensus event journals (the PR 3 timeline analyzer) plus
-invariant checks — exit 0/1 with a JSON report, nothing eyeballed.
+Stands up tens-to-hundreds of in-process nodes (hundreds-to-thousands
+of validator slots) over a fault-injection layer wrapped around the
+memory transport (`faults.FaultyNetwork`), drives tx load, applies a
+declarative fault schedule (partitions, slow links, drops,
+crash-restart with WAL replay, byzantine mavericks), and computes a
+machine-checkable verdict from the merged consensus event journals
+(the PR 3 timeline analyzer) plus invariant checks — exit 0/1 with a
+JSON report, nothing eyeballed.
+
+Scenarios run on one of two clocks (`time = "wall" | "virtual"`):
+wall is real time, the historic behavior; virtual runs the whole
+scenario on `vclock.VirtualTimeLoop`, a deterministic discrete-event
+scheduler under which sleeps/timeouts/latency cost zero wall time and
+two same-seed runs produce byte-identical verdicts — the FoundationDB
+-style simulation discipline, and what makes 100+ node scenarios
+(scenarios/century.toml) affordable.
 
 Entry points:
   scenario.load_scenario / scenario.generate_scenario  — declarative or
       seeded-random scenario definitions
   harness.run_scenario                                 — run one scenario
+      (dispatches to vclock.run_in_virtual_time for time="virtual")
   verdict.evaluate                                     — invariants over
       the timeline report + run info
+  vclock.VirtualTimeLoop / vclock.run_in_virtual_time  — the scheduler
 
-CLI: `tendermint-tpu simnet --scenario <file>` (cli/main.py).
-Docs: docs/simnet.md.
+CLI: `tendermint-tpu simnet --scenario <file> [--time wall|virtual]`.
+Docs: docs/simnet.md ("Virtual time").
 """
 
 from .faults import FaultyNetwork, LinkSpec

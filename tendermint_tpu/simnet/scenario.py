@@ -63,6 +63,21 @@ FAULT_OPS = ("partition", "heal", "slow", "clear", "isolate", "rejoin",
 # kept literal here so the scenario schema stays import-light)
 REMEDIATION_ACTIONS = ("shed", "rewarm", "retune", "evict", "pardon")
 
+# health detector names a scenario may excuse via expect_health
+# (utils/health default_detectors; literal for the same reason)
+HEALTH_DETECTORS = ("height_stall", "round_thrash",
+                    "verify_queue_saturation", "compile_storm",
+                    "memory_growth", "peer_flap")
+
+TIME_MODES = ("wall", "virtual")
+
+#: live-node ceiling per mode.  Wall mode keeps the historic 64 (one
+#: event loop on real time: past that, scheduler starvation fails
+#: scenarios that say nothing about the protocol).  Virtual mode can
+#: afford far more — CPU slowness cannot fire a virtual timeout — and
+#: is capped only to bound memory (full node stacks) and wall CPU.
+MAX_LIVE_NODES = {"wall": 64, "virtual": 256}
+
 MISBEHAVIORS = (
     "double-prevote",
     "double-precommit",
@@ -79,6 +94,10 @@ class FaultOp:
     at_s: float | None = None
     at_height: int | None = None
     nodes: list = field(default_factory=list)
+    to_nodes: list = field(default_factory=list)  # slow: degrade only the
+    #                           links nodes<->to_nodes (both directions)
+    #                           instead of nodes<->everyone — the
+    #                           inter-region edge of a geo topology
     latency_ms: float = 0.0
     jitter_ms: float = 0.0
     drop: float = 0.0
@@ -99,9 +118,13 @@ class FaultOp:
             raise ValueError(f"unknown fault op {self.op!r}")
         if self.at_s is None and self.at_height is None:
             raise ValueError(f"fault op {self.op!r} needs at_s or at_height")
-        for i in self.nodes:
+        for i in list(self.nodes) + list(self.to_nodes):
             if not (0 <= int(i) < n_nodes):
                 raise ValueError(f"fault op {self.op!r}: node {i} out of range")
+        if self.to_nodes and self.op != "slow":
+            raise ValueError("to_nodes is only meaningful on slow ops")
+        if self.to_nodes and not self.nodes:
+            raise ValueError("slow with to_nodes needs a nodes group too")
         if self.op == "partition" and not self.nodes:
             raise ValueError("partition needs a minority node list")
         if self.op in ("crash", "restart", "isolate", "rejoin", "flap") and \
@@ -130,6 +153,13 @@ class Scenario:
     # node index (as int or str) -> {height: misbehavior name}
     mavericks: dict = field(default_factory=dict)
     faults: list = field(default_factory=list)   # list[FaultOp]
+    # baseline link topology ([[links]] tables), applied BEFORE the run
+    # starts and never treated as a fault: geo-latency scenarios model a
+    # WAN as permanent inter-region delay, and the stall/health
+    # invariants must stay armed through it (a fault window would
+    # excuse them).  Each entry: {nodes: [...], to_nodes: [...] (empty =
+    # everyone else), latency_ms, jitter_ms, drop, bandwidth}.
+    links: list = field(default_factory=list)
     # verdict knobs (verdict.py)
     stall_factor: float = 0.0     # x timeout_commit; 0 = default w/ floor
     max_rounds: int = 8
@@ -158,6 +188,20 @@ class Scenario:
     # only.
     slo_objectives: list = field(default_factory=list)
     expect_slo: str = ""
+    # time = "wall" (default: real clocks, pre-existing behavior,
+    # bit-identical) or "virtual": the run executes on the simnet's
+    # deterministic discrete-event scheduler (simnet/vclock.py) — every
+    # sleep/timeout/latency consumes zero wall time, two same-seed runs
+    # produce byte-identical verdicts, and 100+ node scenarios stop
+    # being a wall-clock budget problem (docs/simnet.md "Virtual time").
+    time: str = "wall"
+    # health-layer oracle (utils/health.py, the PR 10 watchdog): when
+    # non-empty, the verdict gains a `health` invariant — zero UNexcused
+    # critical transitions anywhere on the net, and every excused
+    # critical's detector must be in this list (the detectors the fault
+    # schedule is EXPECTED to trip inside its declared windows).  Empty
+    # = report-only, the pre-existing behavior.
+    expect_health: list = field(default_factory=list)
 
     # -- derived ---------------------------------------------------------
     def total_slots(self) -> int:
@@ -187,12 +231,19 @@ class Scenario:
         )
 
     def validate(self) -> None:
+        if self.time not in TIME_MODES:
+            raise ValueError(f"time must be one of {TIME_MODES}, "
+                             f"not {self.time!r}")
         if self.validators < 1:
             raise ValueError("validators must be >= 1")
-        if self.validators > 64:
-            raise ValueError("more than 64 live in-process nodes is asking "
-                             "for an event-loop meltdown; use validator_slots "
-                             "for set size")
+        cap = MAX_LIVE_NODES[self.time]
+        if self.validators > cap:
+            hint = ("switch time='virtual' for 100+ node runs, or "
+                    if self.time == "wall" else "")
+            raise ValueError(
+                f"more than {cap} live in-process nodes in {self.time} "
+                f"mode is asking for a meltdown; {hint}use "
+                "validator_slots for set size")
         if self.total_slots() > 10_000:
             raise ValueError("validator_slots > 10000")
         if self.mesh_degree < 0 or self.mesh_degree == 1:
@@ -209,10 +260,25 @@ class Scenario:
             for h, m in per_height.items():
                 if m not in MISBEHAVIORS:
                     raise ValueError(f"unknown misbehavior {m!r} at {h}")
+        link_keys = {"nodes", "to_nodes", "latency_ms", "jitter_ms",
+                     "drop", "bandwidth"}
+        for ln in self.links:
+            unknown = set(ln) - link_keys
+            if unknown:
+                raise ValueError(f"unknown link keys: {sorted(unknown)}")
+            if not ln.get("nodes"):
+                raise ValueError("a [[links]] entry needs a nodes group")
+            for i in list(ln.get("nodes", [])) + list(ln.get("to_nodes", [])):
+                if not (0 <= int(i) < self.validators):
+                    raise ValueError(f"links: node {i} out of range")
         for a in self.expect_remediation:
             if a not in REMEDIATION_ACTIONS:
                 raise ValueError(f"unknown remediation action {a!r} "
                                  f"(known: {REMEDIATION_ACTIONS})")
+        for d in self.expect_health:
+            if d not in HEALTH_DETECTORS:
+                raise ValueError(f"unknown health detector {d!r} "
+                                 f"(known: {HEALTH_DETECTORS})")
         if self.expect_slo not in ("", "ok", "violated"):
             raise ValueError(
                 f"expect_slo must be '', 'ok' or 'violated', "
@@ -347,12 +413,15 @@ def generate_scenario(seed: int, index: int = 0) -> Scenario:
         max_runtime_s=240.0,
         mavericks=mavericks,
         faults=faults,
-        # one event loop: a full mesh past ~12 nodes saturates the core
-        # with O(n^2) gossip and scheduler starvation masquerades as
-        # round churn (docs/simnet.md "Keeping big nets honest")
-        mesh_degree=0 if n <= 12 else 6,
-        gossip_sleep_ms=10 if n <= 12 else 50,
-        timeout_scale=1.0 if n <= 12 else 6.0,
+        # virtual time (simnet/vclock.py): generated scenarios replay
+        # bit-identically and cost wall CPU, not wall SECONDS — which
+        # retires the wall-mode calibration this generator used to hand
+        # big nets (mesh_degree=6 / gossip_sleep_ms=50 / timeout_scale=6
+        # past 12 nodes; scheduler starvation cannot fire a virtual
+        # timeout).  A mild mesh bound survives purely as a wall-CPU
+        # limit on O(n^2) gossip decode work (docs/simnet.md).
+        time="virtual",
+        mesh_degree=0 if n <= 16 else 8,
     )
     sc.validate()
     return sc

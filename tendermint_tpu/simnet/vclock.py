@@ -1,0 +1,241 @@
+"""Virtual-time discrete-event scheduling for simnet.
+
+Wall-clock simnet pays real seconds for every consensus timeout, gossip
+cadence and injected latency, which caps both scale and scenario count
+(runs must be serialized, 20+ node nets needed hand-tuned mesh degree
+and load, the 50-node soak was exiled to `slow`).  `VirtualTimeLoop`
+removes the wall clock from the equation: it is an asyncio event loop
+whose `time()` is a virtual clock, and whose "sleep" — the selector
+wait the loop would block in — instead JUMPS virtual time to the next
+scheduled callback.  The discrete-event rule:
+
+  * while any callback is ready, virtual time stands still and the
+    callbacks run (CPU work is free in virtual time);
+  * when every task is quiescent (nothing ready, everything awaiting a
+    timer), virtual time jumps exactly to the earliest timer deadline —
+    `asyncio.sleep`, consensus timeout scheduling, DialBackoff delays
+    and `FaultyNetwork`'s `deliver_at` latency all ride loop timers, so
+    all of them consume zero wall time while preserving exact relative
+    order;
+  * quiescence with NO pending timer is a deadlock in a discrete-event
+    world (nothing can ever wake the net again) — the loop raises
+    `VirtualDeadlock` instead of hanging, naming the state that a wall
+    loop would have silently slept in forever.
+
+Determinism: timer order for DISTINCT deadlines is the deadline order;
+ties (equal float deadlines — common when N nodes schedule the same
+timeout in one tick) are broken by a seeded draw plus an insertion
+sequence number, so the fire order of simultaneous timers is a pure
+function of the scenario seed and the schedule itself.  Two same-seed
+runs therefore replay the same event sequence bit-for-bit — the
+FoundationDB-style simulation discipline — which is what lets the
+simnet verdict (journals, health transitions, fleet block included) be
+compared byte-for-byte across runs (tests/test_simnet.py pins this).
+
+`VirtualClock` is the `utils/clock.Clock` face of the loop: wall time
+is a fixed epoch plus virtual seconds, monotonic/perf ARE virtual
+seconds.  `run_in_virtual_time` wires both up around a coroutine and
+restores the process wall clock in a finally block.
+
+What virtual time canNOT virtualize (docs/simnet.md "Virtual time"):
+blocking work on the loop thread (signature verification, WAL writes)
+still costs real CPU — it just costs zero VIRTUAL time — and daemon
+threads cannot block on virtual sleeps, so thread-based samplers (the
+health watchdog, the fleet SLO sampler) are driven as runner ticks in
+virtual mode (`Clock.virtual` is the flag they check).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import random
+import selectors
+
+from tendermint_tpu.utils import clock as _clockmod
+
+#: wall epoch for virtual runs: matches the simnet genesis_time_ns so
+#: virtual wall stamps read as a plausible chain timeline
+DEFAULT_EPOCH_NS = 1_700_000_000 * 10**9
+
+
+class VirtualDeadlock(RuntimeError):
+    """Every task is quiescent and no timer is pending: in a
+    discrete-event world nothing can ever run again."""
+
+
+class _TieTimerHandle(asyncio.TimerHandle):
+    """TimerHandle ordered by (deadline, seeded tie-break, insertion
+    seq).  Stock TimerHandle compares `_when` alone, which leaves the
+    fire order of equal deadlines to heap internals; making the tie
+    explicit (and seeded) pins it as part of the scenario's identity."""
+
+    __slots__ = ("_tie",)
+
+    def __lt__(self, other):
+        if isinstance(other, _TieTimerHandle):
+            return (self._when, self._tie) < (other._when, other._tie)
+        if isinstance(other, asyncio.TimerHandle):
+            return self._when < other._when
+        return NotImplemented
+
+    def __le__(self, other):
+        if isinstance(other, _TieTimerHandle):
+            return (self._when, self._tie) <= (other._when, other._tie)
+        if isinstance(other, asyncio.TimerHandle):
+            return self._when <= other._when
+        return NotImplemented
+
+
+class _VirtualSelector:
+    """Selector wrapper: a zero-timeout poll services real readiness
+    (the loop's self-pipe), and the wait the loop would have blocked in
+    becomes the virtual-time jump."""
+
+    def __init__(self, loop: "VirtualTimeLoop", inner):
+        self._loop = loop
+        self._inner = inner
+
+    # -- delegation ------------------------------------------------------
+    def register(self, *args, **kw):
+        return self._inner.register(*args, **kw)
+
+    def unregister(self, *args):
+        return self._inner.unregister(*args)
+
+    def modify(self, *args, **kw):
+        return self._inner.modify(*args, **kw)
+
+    def get_map(self):
+        return self._inner.get_map()
+
+    def get_key(self, fileobj):
+        return self._inner.get_key(fileobj)
+
+    def close(self):
+        return self._inner.close()
+
+    # -- the jump --------------------------------------------------------
+    def select(self, timeout=None):
+        events = self._inner.select(0)
+        if events or timeout == 0:
+            return events
+        if timeout is None:
+            # only reachable if a task awaits something no timer will
+            # ever resolve (the loop computes a None timeout exactly
+            # when nothing is ready and nothing is scheduled)
+            raise VirtualDeadlock(
+                "virtual-time deadlock: every task is quiescent and no "
+                "timer is scheduled — nothing can ever wake the net")
+        self._loop._advance(timeout)
+        return []
+
+
+class VirtualTimeLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop on a virtual clock (see module docstring).
+
+    The base loop already implements the discrete-event contract —
+    "run ready callbacks, else sleep until the earliest timer" — in
+    `_run_once`; this subclass only swaps what "now" and "sleep" mean.
+    """
+
+    def __init__(self, seed: int = 0, start: float = 0.0):
+        super().__init__(selectors.DefaultSelector())
+        self._vt = float(start)
+        self._selector = _VirtualSelector(self, self._selector)
+        # virtual deadlines are exact floats; a coarse resolution would
+        # let near-future timers fire a fraction early
+        self._clock_resolution = 1e-12
+        self._tie_rng = random.Random(f"vclock-{seed}")
+        self._tie_seq = itertools.count()
+        self.jumps = 0
+        self.advanced_s = 0.0
+
+    def time(self) -> float:
+        return self._vt
+
+    def _advance(self, dt: float) -> None:
+        self._vt += dt
+        self.jumps += 1
+        self.advanced_s += dt
+
+    def call_at(self, when, callback, *args, context=None):
+        """`BaseEventLoop.call_at` with the tie-aware handle (the body
+        matches CPython's, which constructs TimerHandle inline)."""
+        self._check_closed()
+        if self._debug:
+            self._check_thread()
+            self._check_callback(callback, "call_at")
+        timer = _TieTimerHandle(when, callback, args, self, context)
+        timer._tie = (self._tie_rng.random(), next(self._tie_seq))
+        if timer._source_traceback:
+            del timer._source_traceback[-1]
+        heapq.heappush(self._scheduled, timer)
+        timer._scheduled = True
+        return timer
+
+
+class VirtualClock(_clockmod.Clock):
+    """`utils/clock.Clock` over a VirtualTimeLoop: monotonic/perf ARE
+    the loop's virtual seconds, wall is a fixed epoch plus them — so
+    wall deltas and monotonic deltas agree exactly, and every stamp is
+    a pure function of the event schedule."""
+
+    virtual = True
+
+    def __init__(self, loop: VirtualTimeLoop, epoch_ns: int = DEFAULT_EPOCH_NS):
+        self._loop = loop
+        self.epoch_ns = epoch_ns
+
+    def wall_ns(self) -> int:
+        return self.epoch_ns + int(self._loop.time() * 1e9)
+
+    def wall(self) -> float:
+        return self.epoch_ns / 1e9 + self._loop.time()
+
+    def monotonic(self) -> float:
+        return self._loop.time()
+
+    def perf(self) -> float:
+        return self._loop.time()
+
+    def perf_ns(self) -> int:
+        return int(self._loop.time() * 1e9)
+
+
+def run_in_virtual_time(coro_factory, seed: int = 0,
+                        epoch_ns: int = DEFAULT_EPOCH_NS):
+    """Run `coro_factory()` to completion on a fresh VirtualTimeLoop
+    with the matching VirtualClock installed as the process clock; the
+    previous clock and event loop policy state are restored on exit.
+
+    The factory is called AFTER the clock is installed, so everything
+    the coroutine constructs (journals, monitors, backoff ladders)
+    captures virtual time from the start."""
+    loop = VirtualTimeLoop(seed=seed)
+    clock = VirtualClock(loop, epoch_ns=epoch_ns)
+    token = _clockmod.install(clock)
+    try:
+        asyncio.set_event_loop(loop)
+        return loop.run_until_complete(coro_factory())
+    finally:
+        _clockmod.restore(token)
+        try:
+            # the asyncio.run teardown contract: reap stragglers (peer
+            # reader tasks of crashed nodes and the like), then async
+            # generators, so nothing holds a closed-loop reference
+            # two sweeps: cancellation handlers may spawn follow-up tasks
+            for _ in range(2):
+                pending = asyncio.all_tasks(loop)
+                if not pending:
+                    break
+                for task in pending:
+                    task.cancel()
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
+            loop.run_until_complete(loop.shutdown_asyncgens())
+        except Exception:  # noqa: BLE001 — teardown must not mask the run
+            pass
+        asyncio.set_event_loop(None)
+        loop.close()

@@ -31,6 +31,12 @@ Invariants (each names itself in `violations` on failure):
                to normal by run end — the shed-and-survive contract.
                Disabled controllers (TM_TPU_REMEDIATE=0) fail this
                block outright.
+  health       when the scenario sets `expect_health` (a list of
+               detector names), the PR 10 watchdog becomes an oracle:
+               zero unexcused critical transitions anywhere, and every
+               excused critical must come from a named detector — the
+               fault schedule tripped exactly the alarms it declared
+               inside its declared windows, and nothing else.
   slo          when the scenario sets `expect_slo` over its inline
                [[slo_objectives]] (fleet/slo.py): "ok" demands every
                objective end ok through the run — the fleet met its
@@ -248,6 +254,41 @@ def _check_remediation(scenario: Scenario, block: dict,
         })
 
 
+def _check_health(scenario: Scenario, health: dict,
+                  violations: list[dict]) -> None:
+    """`expect_health` contract (the PR 10 watchdog as a first-class
+    oracle): zero UNexcused critical transitions anywhere on the net,
+    and every excused critical must come from a detector the scenario
+    names — i.e. the fault schedule tripped exactly the alarms it
+    declared, inside its declared windows, and nothing else.  Empty
+    expect_health keeps the pre-existing report-only behavior."""
+    allowed = set(scenario.expect_health)
+    if not allowed:
+        return
+    unexcused = {name: rep["unexcused_criticals"]
+                 for name, rep in health["per_node"].items()
+                 if rep.get("unexcused_criticals")}
+    if unexcused:
+        violations.append({
+            "invariant": "health",
+            "detail": f"unexcused critical health transitions: {unexcused} "
+                      "(every critical must fall inside a declared fault "
+                      "window)",
+        })
+    stray: dict[str, set] = {}
+    for name, rep in health["per_node"].items():
+        for det in rep.get("critical_detectors", ()):
+            if det not in allowed:
+                stray.setdefault(name, set()).add(det)
+    if stray:
+        violations.append({
+            "invariant": "health",
+            "detail": (f"critical detector(s) outside expect_health "
+                       f"{sorted(allowed)}: "
+                       f"{ {n: sorted(d) for n, d in stray.items()} }"),
+        })
+
+
 def _health_block(run_info: dict) -> dict:
     """Per-node watchdog summary from the runners' HealthMonitor
     reports (utils/health.py): transition counts, critical counts split
@@ -270,6 +311,7 @@ def _health_block(run_info: dict) -> dict:
             "criticals": len(crits),
             "unexcused_criticals": sum(1 for tr in crits
                                        if not tr.get("excused")),
+            "critical_detectors": sorted({tr.get("detector") for tr in crits}),
             "detectors": {dn: d.get("level", 0) for dn, d in
                           (rep.get("detectors") or {}).items()},
             "bundles": (rep.get("recorder") or {}).get("written", 0),
@@ -408,6 +450,7 @@ def evaluate(scenario: Scenario, report: TimelineReport,
             })
 
     health = _health_block(run_info)
+    _check_health(scenario, health, violations)
     diagnosis = None
     if violations and health["first_critical"] is not None:
         fc = health["first_critical"]
@@ -426,6 +469,7 @@ def evaluate(scenario: Scenario, report: TimelineReport,
         "scenario": {
             "name": scenario.name,
             "seed": scenario.seed,
+            "time": scenario.time,
             "validators": scenario.validators,
             "validator_slots": scenario.total_slots(),
             "target_height": scenario.target_height,

@@ -9,9 +9,9 @@ nanoseconds since the Unix epoch (Go time.Time semantics: zero value is
 from __future__ import annotations
 
 import enum
-import time as _time
 from dataclasses import dataclass, field
 
+from tendermint_tpu.utils import clock as _clock
 from tendermint_tpu.wire.proto import (
     ProtoWriter,
     encode_uvarint,
@@ -26,7 +26,11 @@ NS = 1_000_000_000
 
 
 def now_ns() -> int:
-    return _time.time_ns()
+    """Wall time for block/vote timestamps, via the pluggable clock
+    seam (utils/clock.py): the wall clock on a live node, the virtual
+    clock inside a virtual-time simnet run — which is what makes block
+    timestamps (and with them header hashes) seed-reproducible there."""
+    return _clock.wall_ns()
 
 
 def encode_timestamp(ns: int) -> bytes:

@@ -243,6 +243,12 @@ class Block:
         )
 
     def validate_basic(self) -> None:
+        # success-only memo (the PR 13 SignedHeader idiom): one assembled
+        # block is validated at every surface that touches it — proposal
+        # completion, commit entry, apply, store — and each pass walks
+        # the O(validator slots) last-commit rows.  Failure never caches.
+        if getattr(self, "_validated", False):
+            return
         self.header.validate_basic()
         if self.header.height > 1:
             if self.last_commit is None:
@@ -253,6 +259,7 @@ class Block:
                 raise ValueError("wrong LastCommitHash")
         if self.header.data_hash != self.data.hash():
             raise ValueError("wrong DataHash")
+        self._validated = True
 
 
 def _evidence_hash(evidence: list) -> bytes:

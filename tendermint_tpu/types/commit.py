@@ -34,6 +34,12 @@ from .basic import (
 from .canonical import _canonical_block_id, vote_sign_bytes_raw
 
 
+# the one absent commit row and its wire form (filled in right after
+# the class body; None disarms the fast paths while it bootstraps)
+_ABSENT_SIG = None
+_ABSENT_SIG_ENC = None
+
+
 @dataclass
 class CommitSig:
     block_id_flag: BlockIDFlag
@@ -78,6 +84,13 @@ class CommitSig:
         """Hand-rolled, byte-identical to the ProtoWriter form
         (differential-tested): encoded once per signature per block save
         — the single hottest encoder during replay."""
+        if (_ABSENT_SIG_ENC is not None
+                and self.block_id_flag == BlockIDFlag.ABSENT
+                and not self.validator_address and not self.signature
+                and self.timestamp_ns == GO_ZERO_TIME_NS):
+            # thousand-slot validator sets are mostly passive: their
+            # commit rows are ALL this one absent value, encoded once
+            return _ABSENT_SIG_ENC
         ts = encode_timestamp(self.timestamp_ns)
         out = bytearray()
         if self.block_id_flag:
@@ -92,6 +105,11 @@ class CommitSig:
 
     @classmethod
     def decode(cls, data: bytes) -> "CommitSig":
+        if data == _ABSENT_SIG_ENC:
+            # value object: every absent row decodes to ONE shared
+            # instance (the encode fast path's mirror — a 1000-slot
+            # commit is ~90% this row, decoded per node per save)
+            return _ABSENT_SIG
         f = fields_to_dict(data)
         ts = f.get(3, [None])[0]
         return cls(
@@ -100,6 +118,13 @@ class CommitSig:
             timestamp_ns=decode_timestamp(ts) if ts is not None else GO_ZERO_TIME_NS,
             signature=f.get(4, [b""])[0],
         )
+
+
+# arm the absent-row fast paths: the canonical instance and its wire
+# form (computed through the slow path above while the cell was None,
+# so the bytes are the encoder's own)
+_ABSENT_SIG = CommitSig.absent_sig()
+_ABSENT_SIG_ENC = _ABSENT_SIG.encode()
 
 
 @dataclass
@@ -187,8 +212,15 @@ class Commit:
 
     def hash(self) -> bytes:
         """Merkle root over proto-encoded CommitSigs (reference block.go
-        Commit.Hash)."""
-        return merkle.hash_from_byte_slices([cs.encode() for cs in self.signatures])
+        Commit.Hash).  Memoized like encode(): the root covers every
+        signature row — O(validator slots) — and block validation
+        recomputes it at each surface that sees the block."""
+        h = getattr(self, "_hash_memo", None)
+        if h is None:
+            h = merkle.hash_from_byte_slices(
+                [cs.encode() for cs in self.signatures])
+            self._hash_memo = h
+        return h
 
     def size(self) -> int:
         return len(self.signatures)
@@ -211,6 +243,16 @@ class Commit:
                 cs.validate_basic()
 
     def encode(self) -> bytes:
+        # memoized on the instance: a stored commit is re-encoded for
+        # every block save / WAL record / catchup frame that carries it,
+        # and each encode walks EVERY CommitSig — O(validator slots).
+        # Commits are append-frozen after construction (MakeCommit /
+        # decode build the signature list once); the memo is as safe as
+        # the _sb_tpl template cache above and saved whole seconds per
+        # thousand-slot simnet run.
+        enc = getattr(self, "_enc_memo", None)
+        if enc is not None:
+            return enc
         w = (
             ProtoWriter()
             .varint(1, self.height)
@@ -219,7 +261,9 @@ class Commit:
         )
         for cs in self.signatures:
             w.message(4, cs.encode(), always=True)
-        return w.bytes_out()
+        enc = w.bytes_out()
+        self._enc_memo = enc
+        return enc
 
     @classmethod
     def decode(cls, data: bytes) -> "Commit":

@@ -36,7 +36,12 @@ class Proposal:
         )
 
     def verify(self, chain_id: str, pub_key: PubKey) -> bool:
-        return pub_key.verify_signature(self.sign_bytes(chain_id), self.signature)
+        # service-routed like the vote paths (crypto/async_verify): one
+        # proposal is signature-checked by every node that receives it,
+        # and the verified-sig cache collapses the repeats to lookups
+        from tendermint_tpu.crypto.async_verify import verify_one
+
+        return verify_one(pub_key, self.sign_bytes(chain_id), self.signature)
 
     def validate_basic(self) -> None:
         if self.height < 0:

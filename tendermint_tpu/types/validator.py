@@ -18,7 +18,7 @@ Semantics parity targets (reference types/validator_set.go):
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from fractions import Fraction
 
 from tendermint_tpu.crypto import merkle
@@ -84,7 +84,12 @@ class Validator:
             self.address = self.pub_key.address()
 
     def copy(self) -> "Validator":
-        return replace(self)
+        # positional construction, not dataclasses.replace(): set copies
+        # run this once per row per proposer rotation, and replace()'s
+        # kwargs/machinery showed up as whole seconds on thousand-slot
+        # simnet runs
+        return Validator(self.pub_key, self.voting_power,
+                         self.proposer_priority, self.address)
 
     def bytes_(self) -> bytes:
         return simple_validator_bytes(self.pub_key, self.voting_power)
@@ -169,6 +174,9 @@ class ValidatorSet:
         # through here: the hash covers (pub_key, power) only
         # (simple_validator_bytes), so it survives rotation.
         self._hash: bytes | None = None
+        # the memoized wire form IS priority-sensitive, so it is also
+        # invalidated at every mutator (rotation, updates, get_proposer)
+        self._enc: bytes | None = None
 
     # -- bookkeeping ---------------------------------------------------
     def _update_total_voting_power(self) -> None:
@@ -197,6 +205,12 @@ class ValidatorSet:
         c._total_voting_power = self._total_voting_power
         c._reindex()
         c._hash = self._hash  # same membership ⇒ same hash
+        c._enc = self._enc    # row-for-row copy ⇒ same wire form; the
+        #                       copy's own mutators re-invalidate it.
+        #                       This is what lets a state save encode
+        #                       each thousand-slot set once per rotation
+        #                       instead of once per save that sees it
+        #                       (validators/next/last share lineage).
         c.proposer = self.proposer.copy() if self.proposer else None
         return c
 
@@ -227,6 +241,7 @@ class ValidatorSet:
         for _ in range(times):
             proposer = self._increment_proposer_priority_once()
         self.proposer = proposer
+        self._enc = None   # priorities/proposer are in the wire form
 
     def copy_increment_proposer_priority(self, times: int) -> "ValidatorSet":
         c = self.copy()
@@ -273,6 +288,7 @@ class ValidatorSet:
             raise ValueError("empty validator set")
         if self.proposer is None:
             self.proposer = self._val_with_most_priority()
+            self._enc = None   # proposer rides the wire form (field 2)
         return self.proposer
 
     # -- hashing -------------------------------------------------------
@@ -421,14 +437,21 @@ class ValidatorSet:
     # -- wire (persistence / light blocks) ----------------------------
     def encode(self) -> bytes:
         """validator.proto ValidatorSet{validators=1, proposer=2,
-        total_voting_power=3}."""
+        total_voting_power=3}.  Memoized like hash(), but invalidated by
+        EVERY mutator (rotation, updates, proposer resolution — the wire
+        form covers priorities): a state save encodes up to three
+        thousand-slot sets per height, several times each."""
+        if self._enc is not None:
+            return self._enc
         w = ProtoWriter()
         for v in self.validators:
             w.message(1, v.encode(), always=True)
         if self.proposer is not None:
             w.message(2, self.proposer.encode())
         w.varint(3, self._total_voting_power)
-        return w.bytes_out()
+        enc = w.bytes_out()
+        self._enc = enc
+        return enc
 
     @classmethod
     def decode(cls, data: bytes) -> "ValidatorSet":

@@ -36,9 +36,20 @@ class Vote:
     signature: bytes = b""
 
     def sign_bytes(self, chain_id: str) -> bytes:
-        return vote_sign_bytes_raw(
+        # memoized per chain: every verify surface (precheck slices,
+        # single-vote admission, the service cache key) recomputes the
+        # canonical bytes, and the decode memo shares one Vote instance
+        # across all in-process receivers — so one encode serves them
+        # all.  Signing mutates only `signature`, which sign-bytes never
+        # cover; the other fields are set at construction.
+        memo = getattr(self, "_sb_memo", None)
+        if memo is not None and memo[0] == chain_id:
+            return memo[1]
+        sb = vote_sign_bytes_raw(
             chain_id, self.type, self.height, self.round, self.block_id, self.timestamp_ns
         )
+        self._sb_memo = (chain_id, sb)
+        return sb
 
     def _precheck_digest(self, chain_id: str, pub_key: PubKey) -> bytes:
         from tendermint_tpu.crypto import tmhash
@@ -55,7 +66,13 @@ class Vote:
         marker = getattr(self, "_sig_prechecked", None)
         if marker is not None and marker == self._precheck_digest(chain_id, pub_key):
             return  # this exact content+signature was batch-verified
-        if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
+        # probe + fill the shared verified-sig cache around the
+        # scalar-mult: N callers re-checking one wire vote (every node
+        # of an in-process net) become lookups (crypto/async_verify)
+        from tendermint_tpu.crypto.async_verify import verify_one
+
+        if not verify_one(pub_key, self.sign_bytes(chain_id),
+                          self.signature):
             raise ValueError("invalid signature")
 
     def mark_sig_verified(self, chain_id: str, pub_key: PubKey) -> None:
@@ -114,7 +131,16 @@ class Vote:
 
     # -- wire (gossip) encoding ---------------------------------------
     def encode(self) -> bytes:
-        return (
+        # memoized per instance: one vote is encoded once per SEND, and
+        # gossip fans a vote out over every mesh link — at 100 nodes the
+        # re-encodes dominated the wire layer.  Keyed on the signature
+        # object so a vote encoded before signing (or re-signed by a
+        # maverick) can never serve stale bytes; every other field is
+        # set at construction.
+        memo = getattr(self, "_enc_memo", None)
+        if memo is not None and memo[0] is self.signature:
+            return memo[1]
+        enc = (
             ProtoWriter()
             .varint(1, int(self.type))
             .varint(2, self.height)
@@ -126,6 +152,8 @@ class Vote:
             .bytes_(8, self.signature)
             .bytes_out()
         )
+        self._enc_memo = (self.signature, enc)
+        return enc
 
     @classmethod
     def decode(cls, data: bytes) -> "Vote":

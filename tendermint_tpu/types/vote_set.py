@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from tendermint_tpu.utils.bits import BitArray
 
 from .basic import BlockID, SignedMsgType
 from .commit import Commit, CommitSig
@@ -71,6 +72,13 @@ class VoteSet:
         self.signed_msg_type = signed_msg_type
         self.val_set = val_set
         self.votes: list[Vote | None] = [None] * val_set.size()
+        # incrementally-maintained twin of `[v is not None for v in
+        # self.votes]`: the reactor's PickSendVote diffs this bitmap on
+        # EVERY gossip tick, and rebuilding it per tick from bools was
+        # O(validator slots) per peer-tick — the dominant cost of big
+        # simnet nets.  Updated at the three assignment sites in
+        # _add_verified; callers treat bits() as read-only.
+        self._bits = BitArray(val_set.size())
         self.sum = 0
         self.maj23: BlockID | None = None
         self.votes_by_block: dict[tuple, _BlockVotes] = {}
@@ -200,6 +208,7 @@ class VoteSet:
                 self.votes[val_index] = vote
         else:
             self.votes[val_index] = vote
+            self._bits.set_index(val_index, True)
             self.sum += power
 
         bvotes = self.votes_by_block.get(block_key)
@@ -220,6 +229,7 @@ class VoteSet:
             for i, v in enumerate(bvotes.votes):
                 if v is not None:
                     self.votes[i] = v
+                    self._bits.set_index(i, True)
         if conflicting is not None:
             raise ConflictingVoteError(conflicting, vote)
         return True
@@ -246,6 +256,12 @@ class VoteSet:
 
     def bit_array(self) -> list[bool]:
         return [v is not None for v in self.votes]
+
+    def bits(self) -> BitArray:
+        """The live has-vote bitmap (see __init__) — the zero-copy form
+        of bit_array() for the gossip hot path.  Callers must not
+        mutate it; diff with `.sub()` (which copies)."""
+        return self._bits
 
     def bit_array_by_block_id(self, block_id: BlockID) -> list[bool] | None:
         bv = self.votes_by_block.get(block_id.key())

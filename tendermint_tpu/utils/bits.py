@@ -99,14 +99,43 @@ class BitArray:
         return self.elems[-1] == (1 << tail) - 1
 
     def true_indices(self) -> list[int]:
-        return [i for i in range(self.bits) if self.get_index(i)]
+        """Set-bit indices, walked word-at-a-time (lowest-set-bit
+        peeling) — the per-bit Python loop this replaces dominated the
+        gossip tick's bitmap diffs once validator sets grew to the
+        hundreds-of-slots range."""
+        out: list[int] = []
+        for wi, w in enumerate(self.elems):
+            base = wi * 64
+            while w:
+                lsb = w & -w
+                out.append(base + lsb.bit_length() - 1)
+                w ^= lsb
+        return out
+
+    def count(self) -> int:
+        """Number of set bits."""
+        return sum(w.bit_count() for w in self.elems)
 
     def pick_random(self, rng: random.Random | None = None) -> tuple[int, bool]:
-        """A uniformly random set bit (reference PickRandom)."""
-        idxs = self.true_indices()
-        if not idxs:
+        """A uniformly random set bit (reference PickRandom): count set
+        bits per word, draw k, then peel to the k-th — no materialized
+        index list on the hot gossip path."""
+        total = self.count()
+        if total == 0:
             return 0, False
-        return (rng or random).choice(idxs), True
+        k = (rng or random).randrange(total)
+        for wi, w in enumerate(self.elems):
+            c = w.bit_count()
+            if k >= c:
+                k -= c
+                continue
+            while True:
+                lsb = w & -w
+                if k == 0:
+                    return wi * 64 + lsb.bit_length() - 1, True
+                k -= 1
+                w ^= lsb
+        return 0, False  # unreachable
 
     # -- wire -----------------------------------------------------------
     def encode(self) -> bytes:

@@ -1,0 +1,119 @@
+"""The pluggable time source (the Clock seam).
+
+Every simnet-controlled module that needs the time — journal stamps,
+block timestamps, health/remediation monotonic clocks, the router's
+peer liveness bookkeeping — reads it through this module instead of
+calling `time.*` directly.  The default (`WALL`) delegates straight to
+the `time` module, so a live node behaves bit-identically to code that
+called `time.time_ns()` itself.  The simnet's virtual-time runner
+(`simnet/vclock.py`) installs a `VirtualClock` for the duration of a
+run, which makes every stamp — wall and monotonic — a pure function of
+the discrete-event schedule: two same-seed runs produce byte-identical
+journals, and with them byte-identical verdicts.
+
+The seam is deliberately process-global (`install`/`get`): the clock
+consumers are constructed deep inside the consensus stack (the journal
+inside ConsensusState, the tx lifecycle inside the mempool) where
+threading a constructor parameter through every layer would touch far
+more code than it protects.  A virtual simnet run owns the whole
+process anyway — every SimNode shares one event loop — so one active
+clock is exactly the right scope.  `install` returns the previous
+clock as a token; callers restore it in a finally block.
+
+tmlint's `unpluggable-clock` rule enforces the seam: direct
+`time.time/time_ns/monotonic/perf_counter*/sleep` calls in the
+simnet-controlled module list are findings unless explicitly
+sanctioned.  This module is the one place allowed to touch `time`.
+
+Four faces of one clock:
+
+  wall_ns()    int nanoseconds since the epoch (block timestamps,
+               journal `w` stamps — the cross-node merge key)
+  wall()       float seconds since the epoch
+  monotonic()  float seconds, monotonic (backoff ladders, health
+               detector timelines, peer liveness)
+  perf()       float seconds, high-resolution monotonic (latency
+               deltas: quorum-wait stamps, span-ish timings)
+  perf_ns()    int nanoseconds, high-resolution monotonic (journal
+               `m` stamps)
+
+A virtual clock maps all five onto the same virtual timeline, so
+wall-vs-monotonic deltas stay mutually consistent.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    """Wall + monotonic time pair.  The base class IS the wall clock;
+    `simnet/vclock.VirtualClock` overrides every reader."""
+
+    #: True on the virtual clock: thread-based samplers must not spin
+    #: real daemon threads against it (they would sleep wall seconds
+    #: between virtual aeons) — the simnet runner drives them as ticks.
+    virtual = False
+
+    def wall_ns(self) -> int:
+        return time.time_ns()
+
+    def wall(self) -> float:
+        return time.time()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def perf(self) -> float:
+        return time.perf_counter()
+
+    def perf_ns(self) -> int:
+        return time.perf_counter_ns()
+
+
+#: the process default — every reader below delegates here until a
+#: virtual run installs its own clock
+WALL = Clock()
+
+_active: Clock = WALL
+
+
+def get() -> Clock:
+    """The currently active clock (WALL unless a virtual run is live)."""
+    return _active
+
+
+def install(clock: Clock) -> Clock:
+    """Make `clock` the active clock; returns the previous one (the
+    restore token for the caller's finally block)."""
+    global _active
+    prev = _active
+    _active = clock
+    return prev
+
+
+def restore(token: Clock) -> None:
+    global _active
+    _active = token
+
+
+# -- module-level readers (the call-site surface) ---------------------------
+
+def wall_ns() -> int:
+    return _active.wall_ns()
+
+
+def wall() -> float:
+    return _active.wall()
+
+
+def monotonic() -> float:
+    return _active.monotonic()
+
+
+def perf() -> float:
+    return _active.perf()
+
+
+def perf_ns() -> int:
+    return _active.perf_ns()

@@ -77,6 +77,8 @@ import time
 import traceback
 from collections import deque
 
+from tendermint_tpu.utils import clock as _clockmod
+
 _log = logging.getLogger("tendermint_tpu.health")
 
 ENV_FLAG = "TM_TPU_HEALTH"
@@ -563,7 +565,7 @@ class FlightRecorder:
                 "level": detector.level,
                 "detail": detector.detail,
                 "node": monitor.node,
-                "w": time.time_ns(),
+                "w": _clockmod.wall_ns(),
                 "transition": transition,
                 "errors": errors,
             }
@@ -737,7 +739,7 @@ class HealthMonitor:
                 if d.level != prev:
                     tr = {
                         "t": now,
-                        "w": time.time_ns(),
+                        "w": _clockmod.wall_ns(),
                         "detector": d.name,
                         "from": prev,
                         "to": d.level,
@@ -979,13 +981,18 @@ def from_env(node: str = "", root: str = "", probes: dict | None = None,
              compile_window_s: float | None = None,
              flap_window_s: float | None = None,
              flap_min_span_s: float | None = None,
+             clock=None,
              ) -> "HealthMonitor | _NopMonitor":
     """Build a monitor per TM_TPU_HEALTH (default ON), or return the NOP
     singleton when disabled.  `root` hosts the flight-recorder bundles
-    (`<root>/health/`); no root = no recorder (pure in-memory monitor)."""
+    (`<root>/health/`); no root = no recorder (pure in-memory monitor).
+    `clock` overrides the monotonic clock for monitor AND recorder (the
+    virtual-time simnet passes its virtual clock; default wall)."""
     raw = os.environ.get(ENV_FLAG, "1").lower()
     if raw in ("0", "false", "off"):
         return NOP
+    if clock is None:
+        clock = time.monotonic
     try:
         interval = float(os.environ.get("TM_TPU_HEALTH_INTERVAL_S",
                                         interval_s if interval_s is not None
@@ -1012,7 +1019,7 @@ def from_env(node: str = "", root: str = "", probes: dict | None = None,
         except ValueError:
             min_s = 60.0
         recorder = FlightRecorder(root, keep=keep, min_interval_s=min_s,
-                                  journal_path=journal_path)
+                                  journal_path=journal_path, clock=clock)
     all_probes = {
         "process": process_vitals,
         "verify": verify_probe,
@@ -1032,4 +1039,5 @@ def from_env(node: str = "", root: str = "", probes: dict | None = None,
         interval_s=interval,
         journal=journal,
         recorder=recorder,
+        clock=clock,
     )

@@ -80,6 +80,8 @@ import threading
 import time
 from collections import deque
 
+from tendermint_tpu.utils import clock as _clockmod
+
 _log = logging.getLogger("tendermint_tpu.remediate")
 
 ENV_FLAG = "TM_TPU_REMEDIATE"
@@ -169,7 +171,7 @@ class RemediationController:
             key = (action, trigger)
             self._actions_total[key] = self._actions_total.get(key, 0) + 1
             self._events.append({
-                "t": self._clock(), "w": time.time_ns(), "action": action,
+                "t": self._clock(), "w": _clockmod.wall_ns(), "action": action,
                 "trigger": trigger, "detail": detail, "excused": excused,
                 **fields,
             })
@@ -207,7 +209,7 @@ class RemediationController:
         HealthMonitor.record; guard call sites with `.enabled`)."""
         with self._lock:
             self._events.append({
-                "t": self._clock(), "w": time.time_ns(),
+                "t": self._clock(), "w": _clockmod.wall_ns(),
                 "action": "record", "trigger": name, "detail": str(value),
                 "excused": False,
             })

@@ -38,9 +38,9 @@ Env knobs (resolved at construction, never at import — tmlint
 from __future__ import annotations
 
 import os
-import time
 from collections import OrderedDict, deque
 
+from tendermint_tpu.utils import clock as _clock
 from tendermint_tpu.utils.metrics import Histogram
 
 ENV_FLAG = "TM_TPU_TXLIFE"
@@ -139,7 +139,7 @@ class TxLifecycle:
                 self.evicted += 1
         if milestone in rec:
             return
-        w = time.time_ns()
+        w = _clock.wall_ns()
         rec[milestone] = w
         self.stamped += 1
         if self.journal.enabled:

@@ -1,0 +1,39 @@
+"""Fixture for the unpluggable-clock rule (path-scoped: the file poses
+as simnet/harness.py, a CLOCK_SEAM_FILES member).  Direct time.* CALLS
+are findings; seam reads, default-argument REFERENCES, non-clock time
+attrs, and disabled lines are not."""
+
+import time
+
+from tendermint_tpu.utils import clock as clockmod
+
+
+def stamp_with_wall_clock():
+    t0 = time.time()              # LINT: unpluggable-clock
+    t1 = time.time_ns()           # LINT: unpluggable-clock
+    t2 = time.monotonic()         # LINT: unpluggable-clock
+    t3 = time.perf_counter()      # LINT: unpluggable-clock
+    t4 = time.perf_counter_ns()   # LINT: unpluggable-clock
+    time.sleep(0.1)               # LINT: unpluggable-clock
+    return t0, t1, t2, t3, t4
+
+
+def stamp_through_the_seam():
+    # the sanctioned path: every read flows through utils/clock
+    return clockmod.wall_ns(), clockmod.monotonic(), clockmod.perf()
+
+
+def reference_not_call(clock=time.monotonic):
+    # a default-argument REFERENCE is the injectable-clock idiom, not a
+    # wall read — only calls are flagged
+    return clock()
+
+
+def non_clock_time_attr():
+    # strftime renders, it does not read the flow of time the virtual
+    # scheduler owns
+    return time.strftime("%Y%m%d")
+
+
+def sanctioned_site():
+    return time.monotonic()  # tmlint: disable=unpluggable-clock

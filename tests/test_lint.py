@@ -68,6 +68,7 @@ def test_package_root_is_the_real_tree():
     ("ungated_obs.py", "ungated-observability"),
     ("host_sync.py", "host-sync-in-jit"),
     ("metrics_bad.py", "metric-name-conformance"),
+    ("simnet/harness.py", "unpluggable-clock"),
 ])
 def test_rule_fixture(fixture, rule):
     path = FIXTURES / fixture
@@ -96,6 +97,16 @@ def test_wallclock_rule_is_scoped_to_consensus_paths(tmp_path):
     out = tmp_path / "elsewhere.py"
     out.write_text(src)
     assert lint_paths([out], rules={"wallclock-in-consensus"},
+                      base=tmp_path) == []
+
+
+def test_unpluggable_clock_rule_is_scoped_to_seam_files(tmp_path):
+    """The same source outside CLOCK_SEAM_FILES is clean — modules the
+    virtual clock does not own may read time.* freely."""
+    src = (FIXTURES / "simnet" / "harness.py").read_text()
+    out = tmp_path / "elsewhere.py"
+    out.write_text(src)
+    assert lint_paths([out], rules={"unpluggable-clock"},
                       base=tmp_path) == []
 
 

@@ -213,6 +213,11 @@ def test_four_node_net_on_jax_backend(monkeypatch):
     monkeypatch.setattr(sharding, "verify_batch_sharded", count_sh)
     # batches of ≥2 sigs hit the device; singletons take the CPU fallback
     monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "2")
+    # the verified-sig LRU must sit this test out: the single-vote
+    # admission path now fills it (crypto/async_verify.verify_one), so
+    # on a quiet 4-node net every batched slice would resolve from
+    # cache and the device premise under test would never be exercised
+    monkeypatch.setenv("TM_TPU_VERIFY_CACHE", "0")
     set_default_backend("jax")
 
     async def run():

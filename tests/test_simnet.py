@@ -18,7 +18,12 @@ Layers under test, cheapest first:
   * The tier-1 smoke: 8 nodes, partition+heal, fail-point crash-restart,
     double-prevote maverick — analyzer verdict clean; a deliberately
     over-budget scenario yields a named violation and exit 1.
-  * The 50-node/1000-slot soak (slow).
+  * The 50-node/1000-slot soak — tier-1 since ISSUE 15, running in
+    virtual time.
+  * Virtual time (ISSUE 15): schema (time=/expect_health/[[links]]),
+    the byte-identical-verdict determinism pin, the health oracle's
+    load-bearing proof, and the century acceptance (104 nodes / 1248
+    slots, two same-seed runs byte-identical).
 """
 
 import asyncio
@@ -539,7 +544,10 @@ def test_broken_scenario_names_violation(tmp_path):
     sc = Scenario(
         name="broken", seed=3, validators=4, target_height=4,
         max_runtime_s=10.0, stall_factor=100.0,  # isolate the progress check
-        faults=[FaultOp(op="partition", at_s=0.5, nodes=[2, 3])],
+        # height-triggered: a wall-offset trigger raced the (now much
+        # faster) chain — the net could pass target_height before the
+        # partition ever fired
+        faults=[FaultOp(op="partition", at_height=1, nodes=[2, 3])],
     )
     rep = _run(sc, tmp_path)
     assert not rep["ok"]
@@ -568,7 +576,7 @@ def test_cli_exit_code_contract(tmp_path, capsys):
     bad.write_text(json.dumps({
         "validators": 4, "target_height": 4, "max_runtime_s": 8.0,
         "stall_factor": 100.0,
-        "faults": [{"op": "partition", "at_s": 0.5, "nodes": [2, 3]}],
+        "faults": [{"op": "partition", "at_height": 1, "nodes": [2, 3]}],
     }))
     rc = main(["simnet", "--scenario", str(bad), "--out", str(out)])
     capsys.readouterr()
@@ -582,14 +590,17 @@ def test_cli_exit_code_contract(tmp_path, capsys):
     capsys.readouterr()
 
 
-@pytest.mark.slow
 def test_simnet_soak_50_nodes_1000_slots(tmp_path):
-    """The scale soak: 50 live nodes carrying a 1000-slot validator set
-    through a partition+heal and a crash-restart under load."""
+    """The scale soak, back from `slow` exile (ISSUE 15): 50 live nodes
+    carrying a 1000-slot validator set through a partition+heal and a
+    crash-restart under load — in VIRTUAL time, which retires the
+    hand-tuned wall-mode calibration this scenario used to need
+    (gossip_sleep_ms=100 / timeout_scale=8 / a 900s runtime budget):
+    CPU slowness cannot fire a virtual timeout, so the defaults hold."""
     sc = Scenario(
         name="soak50", seed=23, validators=50, validator_slots=1000,
-        slot_power=2, target_height=4, max_runtime_s=900.0,
-        gossip_sleep_ms=100, timeout_scale=8.0, mesh_degree=6,
+        slot_power=2, target_height=4, max_runtime_s=120.0,
+        time="virtual", mesh_degree=6,
         max_rounds=20, load_rate=20,
         faults=[
             FaultOp(op="partition", at_height=2, nodes=[47, 48, 49]),
@@ -601,4 +612,177 @@ def test_simnet_soak_50_nodes_1000_slots(tmp_path):
     rep = _run(sc, tmp_path)
     assert rep["ok"], rep["violations"]
     assert rep["scenario"]["validator_slots"] == 1000
+    assert rep["scenario"]["time"] == "virtual"
     assert rep["restarts"] == {"node11": 1}
+
+
+# ---------------------------------------------------------------------------
+# virtual time (ISSUE 15): schema, determinism, the century acceptance
+# ---------------------------------------------------------------------------
+
+
+class TestVirtualSchema:
+    def test_time_mode_validates(self):
+        with pytest.raises(ValueError, match="time must be"):
+            Scenario(validators=4, time="warp").validate()
+        Scenario(validators=4, time="virtual").validate()
+        Scenario(validators=4, time="wall").validate()
+
+    def test_virtual_mode_lifts_the_live_node_cap(self):
+        """Wall mode keeps the historic 64-node ceiling; virtual mode
+        affords 100+ (capped at 256 to bound memory/wall CPU)."""
+        with pytest.raises(ValueError, match="64"):
+            Scenario(validators=100).validate()
+        Scenario(validators=100, time="virtual").validate()
+        with pytest.raises(ValueError, match="256"):
+            Scenario(validators=257, time="virtual").validate()
+
+    def test_expect_health_validates_detector_names(self):
+        with pytest.raises(ValueError, match="unknown health detector"):
+            Scenario(validators=4, expect_health=["nope"]).validate()
+        Scenario(validators=4,
+                 expect_health=["height_stall", "peer_flap"]).validate()
+
+    def test_links_schema_validates(self):
+        with pytest.raises(ValueError, match="unknown link keys"):
+            Scenario(validators=4, links=[{"nodes": [0], "speed": 1}]
+                     ).validate()
+        with pytest.raises(ValueError, match="nodes group"):
+            Scenario(validators=4, links=[{"latency_ms": 10}]).validate()
+        with pytest.raises(ValueError, match="out of range"):
+            Scenario(validators=4,
+                     links=[{"nodes": [0], "to_nodes": [9]}]).validate()
+        Scenario(validators=4,
+                 links=[{"nodes": [0, 1], "to_nodes": [2, 3],
+                         "latency_ms": 40, "jitter_ms": 5}]).validate()
+
+    def test_slow_to_nodes_validates(self):
+        with pytest.raises(ValueError, match="only meaningful on slow"):
+            FaultOp(op="isolate", at_s=1, nodes=[0],
+                    to_nodes=[1]).validate(4)
+        with pytest.raises(ValueError, match="needs a nodes group"):
+            FaultOp(op="slow", at_s=1, to_nodes=[1]).validate(4)
+        FaultOp(op="slow", at_s=1, nodes=[0], to_nodes=[1],
+                latency_ms=10).validate(4)
+
+    def test_generator_emits_virtual_scenarios(self):
+        """The wall-mode calibration overrides (mesh/gossip/timeout
+        hand-tuning past 12 nodes) are retired: generated scenarios run
+        in virtual time with default pacing."""
+        for seed in range(4):
+            sc = generate_scenario(seed)
+            assert sc.time == "virtual"
+            assert sc.timeout_scale == 1.0
+            assert sc.gossip_sleep_ms == 10
+
+
+def _verdict_bytes(rep) -> bytes:
+    return json.dumps(rep, sort_keys=True, default=str).encode()
+
+
+def _det_scenario(seed):
+    return Scenario(
+        name="det", seed=seed, validators=8, target_height=5,
+        max_runtime_s=60.0, load_rate=10, time="virtual",
+        mavericks={"5": {"4": "double-prevote"}},
+        faults=[FaultOp(op="partition", at_height=2, nodes=[6, 7]),
+                FaultOp(op="heal", at_height=3),
+                FaultOp(op="crash", at_height=3, nodes=[2],
+                        restart_after_s=0.3)])
+
+
+def test_virtual_determinism_regression(tmp_path):
+    """ISSUE 15 determinism pin: the same seeded virtual scenario run
+    twice in-process yields BYTE-identical verdict JSON — heights,
+    evidence, journal-derived timeline, health transitions, the lot —
+    and a different seed yields different bytes, proving the seeded
+    RNGs and the scheduler's tie-break seq carry ALL nondeterminism
+    (wall monotony, thread timing, id()-seeded jitter are out of the
+    loop).  Roots differ per run, so path leakage would also fail."""
+    r1 = _run(_det_scenario(7), tmp_path / "a")
+    r2 = _run(_det_scenario(7), tmp_path / "b")
+    r3 = _run(_det_scenario(8), tmp_path / "c")
+    assert r1["ok"] and r2["ok"] and r3["ok"], (
+        r1["violations"], r2["violations"], r3["violations"])
+    assert _verdict_bytes(r1) == _verdict_bytes(r2)
+    assert _verdict_bytes(r1) != _verdict_bytes(r3)
+    # the runs actually exercised faults, not a trivial chain
+    assert r1["restarts"] == {"node2": 1}
+    assert r1["evidence"]["expected"]
+
+
+def test_expect_health_oracle_is_load_bearing(tmp_path):
+    """The health invariant must be able to FAIL: a partition-stalled
+    node goes height_stall-critical (excused — inside the declared
+    window); a scenario excusing only peer_flap rejects the verdict,
+    the same seeded scenario excusing height_stall accepts it."""
+    def sc(allowed):
+        return Scenario(
+            name="oracle", seed=31, validators=4, target_height=6,
+            max_runtime_s=60.0, time="virtual", stall_factor=200.0,
+            expect_health=allowed,
+            faults=[FaultOp(op="partition", at_s=0.5, nodes=[3]),
+                    FaultOp(op="heal", at_s=4.0)])
+
+    bad = _run(sc(["peer_flap"]), tmp_path / "bad")
+    assert not bad["ok"]
+    assert "health" in [v["invariant"] for v in bad["violations"]], \
+        bad["violations"]
+    good = _run(sc(["height_stall"]), tmp_path / "good")
+    assert good["ok"], good["violations"]
+    # the critical actually fired and was excused by the window
+    crit = [n for n, h in good["health"]["per_node"].items()
+            if "height_stall" in h.get("critical_detectors", ())]
+    assert crit, good["health"]
+
+
+def test_century_acceptance_virtual_determinism(tmp_path):
+    """THE ISSUE 15 acceptance: a seeded 100+ node / 1000+ slot
+    virtual-time scenario (scenarios/century.toml: 104 nodes, 1248
+    slots, the health layer armed) completes with a clean five-plus-
+    invariant verdict in a fraction of the wall time a real-time run
+    would need, and two same-seed runs produce byte-identical verdict
+    JSON.  Wall budget asserted loosely (shared CI boxes) — bench's
+    simnet-virtual stage tracks the measured number (~1 wall minute
+    here for a scale wall mode cannot reach at all: 64 live nodes was
+    its hard cap)."""
+    import time as _t
+
+    sc = load_scenario(os.path.join(os.path.dirname(__file__), "..",
+                                    "scenarios", "century.toml"))
+    assert sc.validators >= 100 and sc.total_slots() >= 1000
+    assert sc.time == "virtual"
+    t0 = _t.monotonic()
+    r1 = _run(sc, tmp_path / "a")
+    wall1 = _t.monotonic() - t0
+    assert r1["ok"], r1["violations"]
+    # five-plus invariants were all armed: the scenario declares the
+    # health oracle on top of progress/agreement/stall/rounds/evidence
+    assert r1["heights"]["min_honest"] >= sc.target_height
+    assert sc.expect_health
+    assert wall1 < 240.0, f"century took {wall1:.0f}s wall"
+    r2 = _run(load_scenario(os.path.join(os.path.dirname(__file__), "..",
+                                         "scenarios", "century.toml")),
+              tmp_path / "b")
+    assert _verdict_bytes(r1) == _verdict_bytes(r2)
+
+
+def test_checked_in_virtual_scenarios_are_verdict_clean(tmp_path):
+    """geo-latency (permanent 3-region WAN via [[links]] — invariants
+    stay armed through it) and rolling-restart (every node crash-
+    restarted sequentially under load) — both verdict-clean with their
+    declared health expectations."""
+    base = os.path.join(os.path.dirname(__file__), "..", "scenarios")
+    geo = _run(load_scenario(os.path.join(base, "geo-latency.toml")),
+               tmp_path / "geo")
+    assert geo["ok"], geo["violations"]
+    assert geo["scenario"]["time"] == "virtual"
+    # the WAN actually shaped traffic (latency ⇒ shaped frames)
+    assert geo["network"]["frames_shaped"] > 0
+
+    roll = _run(load_scenario(os.path.join(base, "rolling-restart.toml")),
+                tmp_path / "roll")
+    assert roll["ok"], roll["violations"]
+    assert len(roll["restarts"]) == 10  # every node died and came back
+    assert all(c == 1 for c in roll["restarts"].values())
+    assert roll["wal_replays"], "restarts must exercise WAL replay"

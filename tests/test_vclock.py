@@ -1,0 +1,206 @@
+"""Virtual-time scheduler units (simnet/vclock.py, ISSUE 15).
+
+The simnet acceptance tests prove the scheduler end to end (byte
+-identical 100-node verdicts); these pin the scheduler's CONTRACT in
+isolation: virtual ordering, zero wall cost, the quiescence jump, the
+seeded tie-break, deadlock detection, the clock seam's install/restore
+discipline, and the VirtualClock's face consistency.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tendermint_tpu.simnet.vclock import (
+    DEFAULT_EPOCH_NS,
+    VirtualClock,
+    VirtualDeadlock,
+    VirtualTimeLoop,
+    run_in_virtual_time,
+)
+from tendermint_tpu.utils import clock as clockmod
+
+
+# ---------------------------------------------------------------------------
+# scheduling semantics
+# ---------------------------------------------------------------------------
+
+def test_sleeps_execute_in_deadline_order_and_zero_wall():
+    """An hour of virtual sleeping costs milliseconds of wall time, and
+    wakeups happen in exact deadline order regardless of spawn order."""
+    order = []
+
+    async def main():
+        loop = asyncio.get_running_loop()
+
+        async def sleeper(name, d):
+            await asyncio.sleep(d)
+            order.append((name, loop.time()))
+
+        await asyncio.gather(sleeper("c", 3600.0), sleeper("a", 0.001),
+                             sleeper("b", 5.0))
+        return loop.time()
+
+    t0 = time.monotonic()
+    end = run_in_virtual_time(main, seed=1)
+    wall = time.monotonic() - t0
+    assert [n for n, _t in order] == ["a", "b", "c"]
+    assert [t for _n, t in order] == pytest.approx([0.001, 5.0, 3600.0])
+    assert end == pytest.approx(3600.0)
+    assert wall < 5.0  # an hour of virtual time, no wall sleeping
+
+
+def test_virtual_time_stands_still_while_callbacks_run():
+    """CPU work is free: time() only advances at the quiescence jump."""
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        for _ in range(1000):
+            await asyncio.sleep(0)   # ready-queue hops, not timers
+        assert loop.time() == t0
+        await asyncio.sleep(2.5)
+        return loop.time() - t0
+
+    assert run_in_virtual_time(main, seed=0) == pytest.approx(2.5)
+
+
+def test_wait_for_timeout_fires_virtually():
+    async def main():
+        loop = asyncio.get_running_loop()
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(asyncio.sleep(1e9), timeout=42.0)
+        return loop.time()
+
+    assert run_in_virtual_time(main, seed=0) == pytest.approx(42.0)
+
+
+def test_deadlock_raises_instead_of_hanging():
+    """Quiescence with no pending timer can never wake again — the loop
+    names the wedge instead of sleeping in it forever."""
+
+    async def main():
+        await asyncio.get_running_loop().create_future()
+
+    with pytest.raises(VirtualDeadlock):
+        run_in_virtual_time(main, seed=0)
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+def _tie_order(seed):
+    async def main():
+        out = []
+
+        async def s(i):
+            await asyncio.sleep(1.0)   # 20 identical deadlines
+            out.append(i)
+
+        await asyncio.gather(*[s(i) for i in range(20)])
+        return out
+
+    return run_in_virtual_time(main, seed=seed)
+
+
+def test_equal_deadline_ties_are_seeded_and_reproducible():
+    a, b, c = _tie_order(7), _tie_order(7), _tie_order(8)
+    assert a == b, "same seed must replay the same tie order"
+    assert a != c, "the tie order is part of the seed's identity"
+
+
+# ---------------------------------------------------------------------------
+# the clock seam
+# ---------------------------------------------------------------------------
+
+def test_virtual_clock_faces_agree_and_track_loop_time():
+    async def main():
+        clk = clockmod.get()
+        assert clk.virtual
+        w0, m0, p0 = clk.wall_ns(), clk.monotonic(), clk.perf()
+        await asyncio.sleep(12.5)
+        assert clk.monotonic() - m0 == pytest.approx(12.5)
+        assert clk.perf() - p0 == pytest.approx(12.5)
+        assert (clk.wall_ns() - w0) / 1e9 == pytest.approx(12.5)
+        return w0
+
+    w0 = run_in_virtual_time(main, seed=0)
+    assert w0 == DEFAULT_EPOCH_NS  # wall epoch anchors the virtual origin
+
+
+def test_install_restores_wall_clock_after_run():
+    before = clockmod.get()
+    run_in_virtual_time(lambda: asyncio.sleep(3.0), seed=0)
+    assert clockmod.get() is before
+    assert not clockmod.get().virtual
+
+
+def test_install_restores_wall_clock_after_failure():
+    before = clockmod.get()
+
+    async def boom():
+        await asyncio.sleep(1.0)
+        raise RuntimeError("scenario died")
+
+    with pytest.raises(RuntimeError, match="scenario died"):
+        run_in_virtual_time(boom, seed=0)
+    assert clockmod.get() is before
+
+
+def test_wall_clock_module_readers_delegate_to_time():
+    """The default seam is the wall clock: readers track time.* within
+    tolerance and stamps are monotone."""
+    assert abs(clockmod.wall_ns() - time.time_ns()) < 5e9
+    a = clockmod.monotonic()
+    b = clockmod.monotonic()
+    assert b >= a
+    assert clockmod.perf_ns() > 0 and clockmod.perf() > 0
+    assert abs(clockmod.wall() - time.time()) < 5.0
+
+
+def test_faulty_network_latency_rides_virtual_timers():
+    """FaultyNetwork's deliver_at machinery consumes virtual, not wall,
+    time: a 2s one-way latency delivers at t=2 virtually and costs no
+    wall sleeping."""
+    from tendermint_tpu.p2p.types import NodeID
+    from tendermint_tpu.simnet.faults import FaultyNetwork, LinkSpec
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        net = FaultyNetwork(seed=3)
+        ta = net.create_transport(NodeID("a" * 40))
+        tb = net.create_transport(NodeID("b" * 40))
+        net.set_link(NodeID("a" * 40), NodeID("b" * 40),
+                     LinkSpec(latency_ms=2000.0))
+        conn = await ta.dial(NodeID("b" * 40))
+        remote = await tb.accept()
+        t0 = loop.time()
+        await conn.send(0x20, b"payload")
+        cid, data = await remote.receive()
+        assert (cid, data) == (0x20, b"payload")
+        return loop.time() - t0
+
+    t0 = time.monotonic()
+    elapsed_virtual = run_in_virtual_time(main, seed=3)
+    assert elapsed_virtual == pytest.approx(2.0, abs=0.01)
+    assert time.monotonic() - t0 < 2.0  # no real 2s wait happened
+
+
+def test_loop_reports_jump_stats():
+    loop = VirtualTimeLoop(seed=0)
+    try:
+        clock = VirtualClock(loop)
+        token = clockmod.install(clock)
+        try:
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(asyncio.sleep(9.0))
+        finally:
+            clockmod.restore(token)
+            asyncio.set_event_loop(None)
+        assert loop.jumps >= 1
+        assert loop.advanced_s == pytest.approx(loop.time())
+        assert loop.time() == pytest.approx(9.0)
+    finally:
+        loop.close()
