@@ -62,11 +62,18 @@ META_KEYS = {
     "gateway_clients", "fleet_nodes",
     "simnet_virtual_nodes", "simnet_virtual_slots",
     "simnet_virtual_heights",
+    # mesh topology is run context, not a measurement: a different
+    # device count between rounds must read as context, not regression
+    "multichip_mesh_sizes", "n_devices",
 }
 
 # Ordered (pattern, class, direction) — first match wins.  direction
 # "higher" means a DROP is the regression; "lower" means a RISE is.
 _CLASS_RULES = (
+    # MULTICHIP stage: per-mesh-size dispatcher throughput rides the
+    # generic _sigs_per_sec rule below; the scaling-efficiency summary
+    # (rate_meshN / (rate_mesh1 * N)) is a higher-is-better ratio
+    (re.compile(r"^multichip_scaling_efficiency$"), "ratio", "higher"),
     (re.compile(r"(_sigs_per_sec|_per_sec|_per_s|_per_min|_blocks_per_s"
                 r"|_speedup|heights_per_min)$"), "throughput", "higher"),
     # efficiency ratios where higher is better: the gateway's

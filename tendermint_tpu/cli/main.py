@@ -990,7 +990,10 @@ def cmd_warm(args) -> int:
             "max_padding": round(plan.max_padding(), 4),
             "dry_run": True,
             "entries": [{"kind": k, "rung": r, "impl": i, "source": "dry-run"}
-                        for k, r, i in plan.entries(kinds=kinds, impls=impls)],
+                        for k, r, i in plan.entries(kinds=kinds, impls=impls)]
+                       + [{"kind": "verify_sharded", "rung": r, "impl": "",
+                           "mesh": m, "source": "dry-run"}
+                          for r, m in plan.mesh_entries()],
             "plan_path": shape_plan.plan_path(),
             "aot_dir": shape_plan.aot_dir(),
         }

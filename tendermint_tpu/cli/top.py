@@ -184,6 +184,18 @@ def render(snap: dict) -> str:
         ctxt = "  ".join(f"{rung}:{_roof(rung).strip() or '-'}"
                          for rung in sorted(costs, key=_rung_key))
         lines.append(f"roofline   {ctxt}")
+    # mesh dispatcher panel: routing split + which chips the flushes
+    # landed on (absent on single-device nodes / pre-mesh builds)
+    mp = verify.get("mesh_pinned_batches")
+    ms = verify.get("mesh_sharded_batches")
+    per_dev = verify.get("devices") or {}
+    if (mp or 0) or (ms or 0) or per_dev:
+        dtxt = "  ".join(
+            f"dev{d}:{c.get('flushes', 0)}x/{c.get('rows', 0)}r"
+            for d, c in per_dev.items())
+        lines.append(
+            f"mesh       pinned {_v(mp)}  sharded {_v(ms)}"
+            + (f"  [{dtxt}]" if dtxt else ""))
     lines.append(
         f"padding    rows {_v(verify['padding_rows_total'])}"
         f"  transfer {_fmt_bytes(verify['transfer_bytes_total'])}")

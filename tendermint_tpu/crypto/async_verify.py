@@ -45,6 +45,14 @@ Env knobs:
   TM_TPU_LINGER_MS      coalescing window in milliseconds (default 1.0).
   TM_TPU_VERIFY_CACHE   verified-signature cache capacity in entries
                         (default 65536; 0 disables the cache).
+  TM_TPU_MESH           multi-device dispatch (crypto/mesh_dispatch):
+                        unset/auto shards large flushes across the full
+                        device mesh and pins small ones to one chip;
+                        1 forces single-device (bit-identical to the
+                        pre-mesh service); 0 restores the legacy
+                        synchronous multi-device routing.
+  TM_TPU_MESH_MIN_SHARD flush size at/above which a flush shards
+                        (default 64 rows per device).
   TM_TPU_TRACE          1 additionally records submit/coalesce/flush/
                         host-prep/device-execute spans into the
                         utils.trace ring (docs/observability.md); the
@@ -66,6 +74,7 @@ from tendermint_tpu.utils.metrics import Histogram
 
 from . import ed25519 as _ed
 from . import batch as _batch
+from . import mesh_dispatch as _mesh
 from .batch import _pub_bytes, _split_verify
 
 DEFAULT_LINGER_MS = 1.0
@@ -207,7 +216,12 @@ class VerifyService:
             "device_batches": 0,
             "coalesced_max": 0,
             "pipelined_drains": 0,
+            "mesh_pinned_batches": 0,
+            "mesh_sharded_batches": 0,
         }
+        # last (path, reason) the router chose — tests assert the
+        # routing DECISION (pinned vs sharded), not just the verdicts
+        self.last_route: tuple[str, str] | None = None
         # the threshold/readiness arbitration reuses JAXBatchVerifier's
         # lazy measurement machinery; on a jax-less box every flush
         # routes to the host path
@@ -376,6 +390,7 @@ class VerifyService:
         counters could never answer)."""
         t0 = time.perf_counter()
         path, reason = self._route(reqs, inflight)
+        self.last_route = (path, reason)
         if _trace.enabled():
             _trace.record("verify.flush", t0, time.perf_counter() - t0,
                           path=path, reason=reason, n=len(reqs))
@@ -398,15 +413,33 @@ class VerifyService:
             self._host_verify(reqs)
             return "host", "device_not_ready"
         mixed = any(len(r.pub) != 32 for r in reqs)
-        if mixed or bv._device_count() > 1 or \
-                os.environ.get("TM_TPU_RLC", "0") == "1":
-            # rarer shapes (secp-mixed batches, mesh sharding, RLC) run
-            # the existing synchronous routing — bit-identical verdicts,
-            # no pipelining
+        if mixed or os.environ.get("TM_TPU_RLC", "0") == "1":
+            # rarer shapes (secp-mixed batches, RLC) run the existing
+            # synchronous routing — bit-identical verdicts, no pipelining
             self._sync_device_verify(reqs, bv)
             return "device", "sync_routing"
+        ndev = bv._device_count()
+        if ndev > 1:
+            if not _mesh.dispatcher_enabled():
+                # TM_TPU_MESH=0: legacy synchronous mesh routing
+                self._sync_device_verify(reqs, bv)
+                return "device", "sync_routing"
+            route, m = _mesh.decide(n, ndev)
+            if route == "sharded":
+                try:
+                    self._enqueue_sharded(reqs, inflight, m)
+                    return "device", "mesh_sharded"
+                except Exception:  # noqa: BLE001 — mesh hiccup: host
+                    self._host_verify(reqs)
+                    return "host", "device_error"
+            # pinned: fall through to the single-chip pipelined enqueue
+            # below — identical programs/cache keys to a 1-device node
         try:
             self._enqueue_device(reqs, inflight)
+            if ndev > 1:
+                with self._cv:
+                    self.stats["mesh_pinned_batches"] += 1
+                return "device", "mesh_pinned"
             return "device", "pipelined"
         except Exception:  # noqa: BLE001 — device hiccup: host fallback
             self._host_verify(reqs)
@@ -438,9 +471,8 @@ class VerifyService:
                 _trace.record("verify.host_prep", t_prep, prep_dt,
                               n=end - start, rung=b)
             if _devmon.STATS.enabled:
-                _devmon.STATS.record_flush(
-                    "verify", end - start, b,
-                    nbytes=sum(a.nbytes for a in padded))
+                _mesh.record_pinned_flush(
+                    end - start, b, nbytes=sum(a.nbytes for a in padded))
             while len(inflight) >= 2:
                 self._drain_one(inflight)
             t_enq = time.perf_counter()
@@ -448,6 +480,41 @@ class VerifyService:
             inflight.append((pending, sub, t_enq, b))
             with self._cv:
                 self.stats["device_batches"] += 1
+
+    def _enqueue_sharded(self, reqs: list[_Request], inflight: deque,
+                         m: int) -> None:
+        """Host prep + async enqueue of the SHARDED per-row program over
+        an m-device mesh: rows are padded to a device-multiple rung and
+        pre-partitioned (jax.device_put against the mesh NamedSharding)
+        so XLA never reshards.  Readback stays in _drain_one — the
+        double-buffered pipeline is preserved across the mesh hop."""
+        from tendermint_tpu.ops import ed25519_jax as dev
+        from tendermint_tpu.parallel import sharding as _sh
+
+        mesh = _mesh.mesh_for(m)
+        n = len(reqs)
+        b = _sh.sharded_bucket(n, m)
+        t_prep = time.perf_counter()
+        rows = dev.prepare_batch([r.pub for r in reqs],
+                                 [r.msg for r in reqs],
+                                 [r.sig for r in reqs])
+        padded = dev._pad_rows(n, b, *rows)
+        prep_dt = time.perf_counter() - t_prep
+        VERIFY_HOST_PREP_SECONDS.observe(prep_dt)
+        if _trace.enabled():
+            _trace.record("verify.host_prep", t_prep, prep_dt,
+                          n=n, rung=b)
+        if _devmon.STATS.enabled:
+            _mesh.record_sharded_flush(
+                n, b, mesh, nbytes=sum(a.nbytes for a in padded))
+        while len(inflight) >= 2:
+            self._drain_one(inflight)
+        t_enq = time.perf_counter()
+        pending = _mesh.enqueue_sharded(mesh, padded)
+        inflight.append((pending, reqs, t_enq, b))
+        with self._cv:
+            self.stats["device_batches"] += 1
+            self.stats["mesh_sharded_batches"] += 1
 
     def _drain_one(self, inflight: deque) -> None:
         import numpy as np
@@ -657,8 +724,9 @@ def service_stats() -> dict:
     if svc is None:
         return {"submitted": 0, "flushes": 0, "host_flushes": 0,
                 "device_batches": 0, "coalesced_max": 0,
-                "pipelined_drains": 0, "cache_hits": 0, "cache_misses": 0,
-                "cache_size": 0, "queue_depth": 0}
+                "pipelined_drains": 0, "mesh_pinned_batches": 0,
+                "mesh_sharded_batches": 0, "cache_hits": 0,
+                "cache_misses": 0, "cache_size": 0, "queue_depth": 0}
     with svc._cv:
         out = dict(svc.stats)
         out["queue_depth"] = len(svc._queue)

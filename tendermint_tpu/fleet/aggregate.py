@@ -123,6 +123,23 @@ def aggregate(rows: list[dict], prev: dict | None = None) -> dict:
         if p_sub is not None and dt > 0 and submitted >= p_sub:
             verify["sigs_per_s"] = round((submitted - p_sub) / dt, 1)
 
+    # mesh dispatcher rollup: routing split plus per-device placement
+    # summed across nodes — device N of every node's slice folds into
+    # one fleet row, so a chip sitting idle fleet-wide is visible
+    verify["mesh_pinned_batches_total"] = _int_scalar(
+        by_name, "tendermint_crypto_verify_mesh_pinned_batches_total")
+    verify["mesh_sharded_batches_total"] = _int_scalar(
+        by_name, "tendermint_crypto_verify_mesh_sharded_batches_total")
+    devices: dict[str, dict] = {}
+    for l, v in by_name.get(
+            "tendermint_crypto_verify_device_flushes_total", []):
+        devices.setdefault(l.get("device", "?"), {})["flushes"] = int(v)
+    for l, v in by_name.get(
+            "tendermint_crypto_verify_device_rows_total", []):
+        devices.setdefault(l.get("device", "?"), {})["rows"] = int(v)
+    verify["devices"] = {k: devices[k]
+                         for k in sorted(devices, key=promparse.rung_key)}
+
     # per-rung occupancy across the fleet: histogram sum/count merge
     occupancy: dict[str, dict] = {}
     counts = {l.get("rung", "?"): v for l, v in by_name.get(

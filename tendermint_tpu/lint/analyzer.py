@@ -104,7 +104,8 @@ OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
                            "gateway/coalescer.py", "gateway/cache.py",
                            "gateway/service.py",
                            "fleet/slo.py", "fleet/aggregate.py",
-                           "fleet/scrape.py"}
+                           "fleet/scrape.py",
+                           "crypto/mesh_dispatch.py"}
 
 #: modules the virtual-time simnet must fully own the clock of
 #: (ISSUE 15): every time they read — journal stamps, detector

@@ -215,6 +215,17 @@ class NodeMetrics:
             "Service flushes dispatched to the device path",
             namespace=ns, subsystem="crypto", fn=_svc("device_batches"),
         ))
+        self.verify_mesh_pinned = reg.register(CallbackCounter(
+            "verify_mesh_pinned_batches_total",
+            "Dispatcher flushes routed to the pinned single chip "
+            "(small flushes — below TM_TPU_MESH_MIN_SHARD)",
+            namespace=ns, subsystem="crypto", fn=_svc("mesh_pinned_batches"),
+        ))
+        self.verify_mesh_sharded = reg.register(CallbackCounter(
+            "verify_mesh_sharded_batches_total",
+            "Dispatcher flushes sharded across the full device mesh",
+            namespace=ns, subsystem="crypto", fn=_svc("mesh_sharded_batches"),
+        ))
         self.verify_queue_depth = reg.register(Gauge(
             "verify_queue_depth",
             "Requests waiting in the verification service's submission queue",
@@ -265,6 +276,23 @@ class NodeMetrics:
             "Device flushes by program kind and bucket rung",
             namespace=ns, subsystem="crypto", kind="counter",
             fn=lambda: _dm.STATS.rung_flush_samples(),
+        ))
+        # per-device attribution (crypto/mesh_dispatch): which chips of
+        # the mesh each flush actually landed on — a pinned flush is one
+        # device's rows, a sharded flush is rung/n_dev rows per chip
+        self.verify_device_flushes = reg.register(LabeledCallbackGauge(
+            "verify_device_flushes_total",
+            "Device flushes by mesh device (pinned: device 0; sharded: "
+            "every mesh device)",
+            namespace=ns, subsystem="crypto", kind="counter",
+            fn=lambda: _dm.STATS.device_flush_samples(),
+        ))
+        self.verify_device_rows = reg.register(LabeledCallbackGauge(
+            "verify_device_rows_total",
+            "Padded rows placed per mesh device (each device's shard of "
+            "every flush it participated in)",
+            namespace=ns, subsystem="crypto", kind="counter",
+            fn=lambda: _dm.STATS.device_rows_samples(),
         ))
         self.device_memory_bytes = reg.register(LabeledCallbackGauge(
             "device_memory_bytes",

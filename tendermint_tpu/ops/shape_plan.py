@@ -46,12 +46,19 @@ Compile provenance: every warm records a devmon compile event with
 as "persistent-cache" or "cold" by the duration heuristic.  A post-warm
 run therefore proves itself: `jit_compile_total{source="cold"}` == 0.
 
-Sharded-mesh caveat: `parallel.sharding` pads buckets to a multiple of
-the mesh size, so on meshes whose device count does not divide the plan
-rungs (3/5/6-device meshes) the effective flush shape can fall outside
-the plan; every plan rung here is a multiple of 8, covering the 1/2/4/8
-meshes the harness runs.  The sharded jits themselves are not AOT'd
-(serialized executables are topology-bound).
+Sharded-mesh story (round 10): plans carry a `mesh` dimension — the
+mesh sizes (device counts) the warm sweep covers.  `parallel.sharding`
+pads buckets to a multiple of the mesh size; every plan rung here is a
+multiple of 8, covering the 1/2/4/8 meshes the harness runs, and
+`plan_for_warm` folds the CURRENT topology into the implicit plan so
+`tendermint-tpu warm` compiles the sharded per-row program for every
+(rung, mesh) pair the dispatcher (crypto/mesh_dispatch) will route to.
+The sharded jits are warmed by executing them (which populates the
+persistent HLO cache) but never serialized: serialized executables are
+topology-bound, which is also why `_aot_path` keys artifacts on device
+count AND a host-machine signature — loading an executable compiled for
+another machine's CPU features is the cpu_aot_loader SIGILL hazard, and
+a signature mismatch must mean "recompile", never "deserialize".
 """
 
 from __future__ import annotations
@@ -99,18 +106,25 @@ def _ladder_bucket(n: int) -> int:
 
 class ShapePlan:
     """An explicit bucket ladder: sorted rungs plus the (impls, kinds)
-    the warm path compiles for.  Pure data — JSON round-trips."""
+    the warm path compiles for and the mesh sizes (device counts) the
+    sharded warm sweep covers.  Pure data — JSON round-trips; plans
+    saved before the mesh dimension existed load as mesh=(1,)."""
 
-    __slots__ = ("name", "rungs", "impls", "kinds")
+    __slots__ = ("name", "rungs", "impls", "kinds", "mesh")
 
     def __init__(self, rungs, *, impls=DEFAULT_IMPLS, kinds=DEFAULT_KINDS,
-                 name: str = "custom"):
+                 name: str = "custom", mesh=(1,)):
         rs = sorted({int(r) for r in rungs})
         if not rs or rs[0] < 1:
             raise ValueError(f"shape plan needs positive rungs, got {rungs!r}")
+        ms = sorted({int(m) for m in (mesh or (1,))})
+        if ms[0] < 1:
+            raise ValueError(f"shape plan needs positive mesh sizes, "
+                             f"got {mesh!r}")
         self.rungs = tuple(rs)
         self.impls = tuple(impls)
         self.kinds = tuple(kinds)
+        self.mesh = tuple(ms)
         self.name = name
 
     @property
@@ -149,7 +163,7 @@ class ShapePlan:
         return worst
 
     def entries(self, kinds=None, impls=None):
-        """[(kind, rung, impl)] the warm path compiles."""
+        """[(kind, rung, impl)] the single-device warm path compiles."""
         out = []
         for kind in (kinds or self.kinds):
             for impl in (impls or self.impls):
@@ -157,10 +171,24 @@ class ShapePlan:
                     out.append((kind, rung, impl))
         return out
 
+    def mesh_entries(self, rungs=None):
+        """[(rung, mesh_size)] the SHARDED warm path compiles: one
+        sharded per-row program per plan rung per mesh size > 1, skipping
+        rungs the mesh does not divide (parallel.sharding pads those up
+        to the next device multiple, i.e. a different rung)."""
+        out = []
+        for m in self.mesh:
+            if m <= 1:
+                continue
+            for rung in (rungs or self.rungs):
+                if rung % m == 0:
+                    out.append((rung, m))
+        return out
+
     def to_dict(self) -> dict:
         return {"version": PLAN_VERSION, "name": self.name,
                 "rungs": list(self.rungs), "impls": list(self.impls),
-                "kinds": list(self.kinds)}
+                "kinds": list(self.kinds), "mesh": list(self.mesh)}
 
     @classmethod
     def from_dict(cls, doc: dict) -> "ShapePlan":
@@ -170,7 +198,8 @@ class ShapePlan:
         return cls(doc["rungs"],
                    impls=tuple(doc.get("impls") or DEFAULT_IMPLS),
                    kinds=tuple(doc.get("kinds") or DEFAULT_KINDS),
-                   name=str(doc.get("name", "custom")))
+                   name=str(doc.get("name", "custom")),
+                   mesh=tuple(doc.get("mesh") or (1,)))
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=1)
@@ -316,18 +345,41 @@ def plan_for_warm(device_stats: dict | None = None) -> ShapePlan:
     validates them) becomes operational, so the resolved default impl is
     folded into the implicit plan and the AOT sweep compiles exactly the
     programs production dispatch will run.  XLA-CPU resolves to int64:
-    the warm grid there is unchanged."""
+    the warm grid there is unchanged.
+
+    Round 10: the CURRENT device topology is folded in the same way —
+    on a multi-device slice the plan's mesh dimension grows the visible
+    device count, so the warm sweep also compiles the sharded per-row
+    programs the mesh dispatcher routes large flushes to."""
     explicit = _resolve_explicit_plan()
     if explicit is not None:
-        return explicit
+        return _fold_mesh(explicit)
     plan = consolidated_plan(device_stats)
     from tendermint_tpu.ops import ed25519_jax as dev
 
     impl = dev.default_impl()
     if impl not in plan.impls:
         plan = ShapePlan(plan.rungs, impls=(impl,) + plan.impls,
-                         kinds=plan.kinds, name=plan.name)
-    return plan
+                         kinds=plan.kinds, name=plan.name, mesh=plan.mesh)
+    return _fold_mesh(plan)
+
+
+def _fold_mesh(plan: ShapePlan) -> ShapePlan:
+    """Grow a plan's mesh dimension with the visible device count, so a
+    warm on a slice covers the dispatcher's sharded route.  A plan that
+    already names mesh sizes > 1 is kept as-is (the operator chose)."""
+    if plan.mesh != (1,):
+        return plan
+    try:
+        import jax
+
+        n_dev = len(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend: single-device plan
+        return plan
+    if n_dev <= 1:
+        return plan
+    return ShapePlan(plan.rungs, impls=plan.impls, kinds=plan.kinds,
+                     name=plan.name, mesh=(1, n_dev))
 
 
 # ---------------------------------------------------------------------------
@@ -464,14 +516,50 @@ def _load_executable(blob: bytes):
     return se.deserialize_and_load(payload, in_tree, out_tree)
 
 
+@functools.lru_cache(maxsize=1)
+def host_signature() -> str:
+    """Fingerprint of the machine an AOT artifact was compiled ON:
+    platform triple + a hash of the CPU feature flags + the first
+    device's kind.  MULTICHIP_r05's tail showed cpu_aot_loader warning
+    "Compile machine features ... doesn't match the machine type for
+    execution ... could lead to SIGILL" — an executable serialized on a
+    machine with wider SIMD must never be deserialized on a narrower
+    one.  Folding this signature into the artifact KEY makes a
+    cross-machine load structurally impossible: on a different host the
+    path simply does not exist, so warm_entry recompiles cleanly."""
+    import platform
+
+    parts = [platform.system(), platform.machine(),
+             platform.processor() or ""]
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.lower().startswith(("flags", "features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    parts.append(
+                        hashlib.sha256(feats.encode()).hexdigest()[:12])
+                    break
+    except OSError:
+        pass
+    try:
+        import jax
+
+        parts.append(str(jax.devices()[0].device_kind))
+    except Exception:  # noqa: BLE001 — no backend: platform triple only
+        pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
 def _aot_path(kind: str, rung: int, impl: str, flags: dict) -> str:
     """Artifact path keyed on everything that makes an executable
-    non-portable: flags, jax version, backend platform, device count."""
+    non-portable: flags, jax version, backend platform, device count
+    (executables are topology-bound), and the host-machine signature
+    (CPU features — the SIGILL hazard; see host_signature)."""
     import jax
 
     sig = hashlib.sha256(repr((
         kind, rung, impl, _flag_key(flags), jax.__version__,
-        jax.default_backend(), len(jax.devices()),
+        jax.default_backend(), len(jax.devices()), host_signature(),
     )).encode()).hexdigest()[:16]
     return os.path.join(aot_dir(), f"{kind}_{impl}_r{rung}_{sig}.aotx")
 
@@ -582,14 +670,66 @@ def warm_rungs(*, kinds=DEFAULT_KINDS, rungs, impls=DEFAULT_IMPLS,
     return out
 
 
+def warm_mesh_entry(rung: int, m: int) -> dict:
+    """Warm the SHARDED per-row program for one (rung, mesh-size) by
+    executing it on zero rows through the exact dispatcher call path
+    (prepartition + sharded_verify_fn).  Sharded executables are never
+    serialized — they are topology-bound, and XLA-CPU cannot serialize
+    at all — but the execution compiles through jax's persistent HLO
+    cache, which is precisely what a mesh-enabled service start reuses.
+    The compile event lands in devmon via sharding's track_jit wrapper."""
+    import numpy as np
+
+    report: dict = {"kind": "verify_sharded", "rung": int(rung),
+                    "mesh": int(m), "serialized": False}
+    t0 = time.perf_counter()
+    try:
+        from tendermint_tpu.ops import ed25519_jax as dev
+        from tendermint_tpu.parallel import sharding as _sh
+
+        report["impl"] = dev.default_impl()
+        mesh = _sh.make_mesh(n_devices=m)
+        rows = tuple(np.zeros((rung, 32), np.uint8) for _ in range(4)) \
+            + (np.zeros((rung,), np.bool_),)
+        out = _sh.sharded_verify_fn(mesh)(*_sh.prepartition(mesh, rows))
+        np.asarray(out)  # block until the compile/execute completes
+        dt = time.perf_counter() - t0
+        report.update(
+            source=("persistent-cache" if dt < _cold_threshold() else "cold"),
+            seconds=round(dt, 3))
+    except Exception as e:  # noqa: BLE001 — per-entry failure isolation
+        _log.warning("mesh warm r%d x%d failed: %s", rung, m, e)
+        report.update(source="error", seconds=round(
+            time.perf_counter() - t0, 3), error=str(e)[-300:])
+    return report
+
+
+def _cold_threshold() -> float:
+    from tendermint_tpu.utils import devmon as _devmon
+
+    return _devmon._cold_compile_threshold_s()
+
+
 def warm_plan(plan: ShapePlan, *, kinds=None, impls=None,
               serialize: bool = True, save: bool = True) -> dict:
     """Warm every entry of a plan and (by default) save the plan next to
     the compile cache so restarts — and start_background_warm — pick it
-    up.  Returns the full report `tendermint-tpu warm --json` prints."""
+    up.  Returns the full report `tendermint-tpu warm --json` prints.
+    Plans with a mesh dimension (round 10) additionally warm the sharded
+    per-row program for every (rung, mesh-size) pair, clamped to the
+    devices actually visible right now."""
     t0 = time.perf_counter()
     entries = warm_rungs(kinds=kinds or plan.kinds, rungs=plan.rungs,
                          impls=impls or plan.impls, serialize=serialize)
+    try:
+        import jax
+
+        visible = len(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend: skip sharded warm
+        visible = 1
+    for rung, m in plan.mesh_entries():
+        if m <= visible:
+            entries.append(warm_mesh_entry(rung, m))
     sources: dict[str, int] = {}
     for e in entries:
         sources[e["source"]] = sources.get(e["source"], 0) + 1

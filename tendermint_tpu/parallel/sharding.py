@@ -36,6 +36,31 @@ def pad_to_multiple(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+def sharded_bucket(n: int, n_dev: int) -> int:
+    """Padded batch size for an n-row flush sharded over n_dev devices:
+    the single-chip bucket-ladder rung, rounded up to a device multiple
+    so every shard is equal-sized."""
+    b = max(_dev._bucket(n), pad_to_multiple(n, n_dev))
+    return pad_to_multiple(b, n_dev)
+
+
+def device_ids(mesh: Mesh) -> tuple:
+    """Stable per-device attribution key for devmon's per-device series."""
+    return tuple(int(d.id) for d in mesh.devices.flat)
+
+
+def prepartition(mesh: Mesh, rows) -> list:
+    """jax.device_put each packed row tensor against the mesh's
+    NamedSharding BEFORE dispatch, so the arrays arrive already laid out
+    exactly as the sharded jit's in_shardings declare and XLA never
+    inserts a reshard (the pjit exemplar contract: producer layout ==
+    consumer in_axis_resources)."""
+    batch = NamedSharding(mesh, P("batch"))
+    batch2 = NamedSharding(mesh, P("batch", None))
+    return [jax.device_put(a, batch2 if getattr(a, "ndim", 1) == 2 else batch)
+            for a in rows]
+
+
 import functools
 
 
@@ -124,15 +149,15 @@ def verify_batch_rlc_sharded(pubs, msgs, sigs, mesh: Mesh | None = None,
     n_dev = mesh.devices.size
     pub_rows, r_rows, s_rows, k_rows, valid = _dev.prepare_batch(pubs, msgs, sigs)
     z_rows, zk_rows, c_row = _dev.prepare_rlc_scalars(s_rows, k_rows, valid)
-    b = max(_dev._bucket(n), pad_to_multiple(n, n_dev))
-    b = pad_to_multiple(b, n_dev)
+    b = sharded_bucket(n, n_dev)
     pub_p, r_p, zk_p, z_p, valid_p = _dev._pad_rows(
         n, b, pub_rows, r_rows, zk_rows, z_rows, valid
     )
     if _devmon.STATS.enabled:
         _devmon.STATS.record_flush(
             "rlc_sharded", n, b,
-            nbytes=sum(a.nbytes for a in (pub_p, r_p, zk_p, z_p, valid_p)))
+            nbytes=sum(a.nbytes for a in (pub_p, r_p, zk_p, z_p, valid_p)),
+            devices=device_ids(mesh))
     acc, prevalid = sharded_rlc_fn(mesh, impl, _dev.rlc_reduce_lanes())(
         pub_p, r_p, zk_p, z_p, valid_p
     )
@@ -153,8 +178,7 @@ def _verify_rows_sharded(inputs, n: int, mesh: Mesh) -> np.ndarray:
     (pub_rows, r_rows, s_rows, k_rows, valid); pads to the bucket/mesh
     multiple here."""
     n_dev = mesh.devices.size
-    b = max(_dev._bucket(n), pad_to_multiple(n, n_dev))
-    b = pad_to_multiple(b, n_dev)
+    b = sharded_bucket(n, n_dev)
     if b != n:
         pad = b - n
         inputs = tuple(
@@ -162,8 +186,9 @@ def _verify_rows_sharded(inputs, n: int, mesh: Mesh) -> np.ndarray:
         )
     if _devmon.STATS.enabled:
         _devmon.STATS.record_flush(
-            "verify_sharded", n, b, nbytes=sum(a.nbytes for a in inputs))
-    ok = sharded_verify_fn(mesh)(*inputs)
+            "verify_sharded", n, b, nbytes=sum(a.nbytes for a in inputs),
+            devices=device_ids(mesh))
+    ok = sharded_verify_fn(mesh)(*prepartition(mesh, inputs))
     return np.asarray(ok)[:n]
 
 

@@ -108,9 +108,14 @@ class DeviceStats:
         self.transfer_bytes = 0   # host→device bytes, padded widths
         # (kind, rung) -> [flushes, rows_requested, padding_rows]
         self.rung_flushes: dict[tuple[str, int], list] = {}
+        # device id -> [flushes, padded rows placed, transfer bytes] —
+        # the mesh dispatcher (crypto/mesh_dispatch) attributes each
+        # flush to the devices it actually landed on: a pinned flush is
+        # one device's rows, a sharded flush is rung/n_dev rows per chip
+        self.device_flushes: dict[int, list] = {}
 
     def record_flush(self, kind: str, n: int, rung: int,
-                     nbytes: int = 0) -> None:
+                     nbytes: int = 0, devices: tuple | None = None) -> None:
         with self._lock:
             self.flushes += 1
             self.rows_requested += n
@@ -123,6 +128,16 @@ class DeviceStats:
             cell[0] += 1
             cell[1] += n
             cell[2] += rung - n
+            if devices:
+                share_rows = rung // len(devices)
+                share_bytes = nbytes // len(devices)
+                for did in devices:
+                    dcell = self.device_flushes.get(did)
+                    if dcell is None:
+                        dcell = self.device_flushes[did] = [0, 0, 0]
+                    dcell[0] += 1
+                    dcell[1] += share_rows
+                    dcell[2] += share_bytes
         self._hist.observe(n / rung if rung else 1.0, rung=rung)
 
     def snapshot(self) -> dict:
@@ -134,6 +149,10 @@ class DeviceStats:
                  if rows + pad else 1.0}
                 for (k, r), (f, rows, pad) in sorted(self.rung_flushes.items())
             ]
+            devices = [
+                {"device": d, "flushes": f, "rows": rows, "bytes": nb}
+                for d, (f, rows, nb) in sorted(self.device_flushes.items())
+            ]
             return {
                 "enabled": self.enabled,
                 "flushes_total": self.flushes,
@@ -142,6 +161,7 @@ class DeviceStats:
                 "padding_rows_total": self.padding_rows,
                 "transfer_bytes_total": self.transfer_bytes,
                 "rungs": rungs,
+                "devices": devices,
             }
 
     # -- scrape-time sample helpers (node/metrics.py) -------------------
@@ -151,6 +171,18 @@ class DeviceStats:
             return [({"kind": k, "rung": str(r)}, float(f))
                     for (k, r), (f, _rows, _pad)
                     in sorted(self.rung_flushes.items())]
+
+    def device_flush_samples(self) -> list:
+        with self._lock:
+            return [({"device": str(d)}, float(f))
+                    for d, (f, _rows, _nb)
+                    in sorted(self.device_flushes.items())]
+
+    def device_rows_samples(self) -> list:
+        with self._lock:
+            return [({"device": str(d)}, float(rows))
+                    for d, (_f, rows, _nb)
+                    in sorted(self.device_flushes.items())]
 
 
 # ---------------------------------------------------------------------------
@@ -422,6 +454,10 @@ def render_text() -> str:
             f"  {r['kind']:>14} rung {r['rung']:>6}: {r['flushes']} flushes, "
             f"{r['rows']} rows, {r['padding_rows']} padded, "
             f"occupancy {r['mean_occupancy']:.3f}")
+    for d in snap.get("devices", []):
+        lines.append(
+            f"  dev{d['device']}: {d['flushes']} flushes, "
+            f"{d['rows']} rows placed, {d['bytes']} bytes")
     comp = snap["compile"]
     stxt = " ".join(f"{k}={v}" for k, v in sorted(comp["sources"].items()))
     lines.append(

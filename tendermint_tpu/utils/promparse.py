@@ -189,7 +189,9 @@ def empty_snapshot() -> dict:
                    "device_batches": None, "cache_hit_ratio": None,
                    "backend": None, "device_ready": None,
                    "occupancy": {}, "padding_rows_total": None,
-                   "transfer_bytes_total": None},
+                   "transfer_bytes_total": None,
+                   "mesh_pinned_batches": None, "mesh_sharded_batches": None,
+                   "devices": {}},
         "compile": {"total": 0, "seconds_total": 0.0, "recompiles": 0,
                     "by_rung": {}, "sources": {}},
         "costs": {},
@@ -248,6 +250,22 @@ def fold_metrics(snap: dict, by_name: dict) -> None:
     verify["padding_rows_total"] = int(pad) if pad is not None else None
     xfer = scalar(by_name, "tendermint_crypto_verify_transfer_bytes_total")
     verify["transfer_bytes_total"] = int(xfer) if xfer is not None else None
+
+    # mesh dispatcher: routing counters plus the per-device flush/row
+    # series (crypto/mesh_dispatch attribution — which chips the flushes
+    # actually landed on)
+    mp = scalar(by_name, "tendermint_crypto_verify_mesh_pinned_batches_total")
+    verify["mesh_pinned_batches"] = int(mp) if mp is not None else None
+    ms = scalar(by_name, "tendermint_crypto_verify_mesh_sharded_batches_total")
+    verify["mesh_sharded_batches"] = int(ms) if ms is not None else None
+    per_dev: dict[str, dict] = {}
+    for labels, v in by_name.get(
+            "tendermint_crypto_verify_device_flushes_total", []):
+        per_dev.setdefault(labels.get("device", "?"), {})["flushes"] = int(v)
+    for labels, v in by_name.get(
+            "tendermint_crypto_verify_device_rows_total", []):
+        per_dev.setdefault(labels.get("device", "?"), {})["rows"] = int(v)
+    verify["devices"] = {k: per_dev[k] for k in sorted(per_dev, key=rung_key)}
 
     # per-rung mean occupancy from the histogram's sum/count series
     occ: dict[str, dict] = {}

@@ -117,6 +117,12 @@ def test_normalize_checked_in_artifacts_all_shapes():
     ("shootout_packed_hlo_bytes_per_row", "resource", "lower"),
     ("shootout_int64_flops_per_row", "resource", "lower"),
     ("shootout_packed_wall_p50_ms", "latency", "lower"),
+    # MULTICHIP stage (ISSUE 16): per-mesh-size dispatcher throughput
+    # in the 3% gate; scaling efficiency is a higher-is-better ratio;
+    # mesh topology is run metadata, never a regression
+    ("multichip_mesh1_sigs_per_sec", "throughput", "higher"),
+    ("multichip_mesh8_sigs_per_sec", "throughput", "higher"),
+    ("multichip_scaling_efficiency", "ratio", "higher"),
 ])
 def test_classify_matrix(key, cls, direction):
     assert classify(key) == (cls, direction)

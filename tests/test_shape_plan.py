@@ -409,3 +409,50 @@ def test_warm_cli_compiles_saves_and_reports(capsys, monkeypatch,
     assert rc == 2
     rc, _ = _cli(capsys, ["warm", "--rungs", "not-a-number"])
     assert rc == 2
+
+
+def test_plan_mesh_dimension_round_trips():
+    """Round 10: the mesh dimension serializes with the plan; JSON
+    saved before the dimension existed loads as the single-chip plan."""
+    plan = shape_plan.ShapePlan([8, 64], mesh=(8, 2, 1))
+    assert plan.mesh == (1, 2, 8)  # sorted, deduped
+    doc = plan.to_dict()
+    assert doc["mesh"] == [1, 2, 8]
+    assert shape_plan.ShapePlan.from_dict(doc).mesh == (1, 2, 8)
+    legacy = {k: v for k, v in doc.items() if k != "mesh"}
+    assert shape_plan.ShapePlan.from_dict(legacy).mesh == (1,)
+    with pytest.raises(ValueError):
+        shape_plan.ShapePlan([8], mesh=(0,))
+
+
+def test_plan_mesh_entries_skip_indivisible_rungs():
+    plan = shape_plan.ShapePlan([8, 64], mesh=(1, 2, 8))
+    assert plan.mesh_entries() == [(8, 2), (64, 2), (8, 8), (64, 8)]
+    # mesh=(1,) — the default — adds no sharded work at all
+    assert shape_plan.ShapePlan([8, 64]).mesh_entries() == []
+    # a rung the mesh size does not divide is skipped (sharding pads it
+    # up to a different rung; warming it here would be a novel program)
+    assert shape_plan.ShapePlan([8], mesh=(1, 16)).mesh_entries() == []
+
+
+def test_plan_for_warm_folds_visible_mesh():
+    """On the conftest's 8-device slice the default warm plan grows a
+    mesh dimension; a plan that already names mesh sizes is kept as-is
+    (the operator chose)."""
+    plan = shape_plan.plan_for_warm(None)
+    assert plan.mesh == (1, 8)
+    explicit = shape_plan.ShapePlan([8, 64], mesh=(1, 2))
+    assert shape_plan._fold_mesh(explicit).mesh == (1, 2)
+
+
+def test_aot_path_keys_on_host_signature(monkeypatch):
+    """Satellite 1 (the MULTICHIP_r05 SIGILL tail): AOT artifact paths
+    fold in the host-machine signature, so an artifact compiled on a
+    different machine is simply absent here — clean recompile, never a
+    deserialize of foreign machine code."""
+    sig = shape_plan.host_signature()
+    assert sig and sig == shape_plan.host_signature()
+    p1 = shape_plan._aot_path("verify", 64, "int64", {})
+    monkeypatch.setattr(shape_plan, "host_signature", lambda: "otherhost")
+    p2 = shape_plan._aot_path("verify", 64, "int64", {})
+    assert p1 != p2
