@@ -65,6 +65,9 @@ META_KEYS = {
     # mesh topology is run context, not a measurement: a different
     # device count between rounds must read as context, not regression
     "multichip_mesh_sizes", "n_devices",
+    # sampling rate is run context: comparing a 19 Hz round against a
+    # 97 Hz round must not read the rate change itself as a regression
+    "prof_hz",
 }
 
 # Ordered (pattern, class, direction) — first match wins.  direction

@@ -939,6 +939,27 @@ def cmd_health(args) -> int:
                       interval=args.interval, timeout=args.timeout)
 
 
+def cmd_prof(args) -> int:
+    """One node's statistical CPU profile over /debug/pprof/profile
+    (cli/prof.py): top functions by self/cumulative samples per
+    subsystem, `--seconds N` for a fresh delta capture, `--flame OUT`
+    for flamegraph-ready folded text, `--watch` refresh loop; `--diff
+    A.folded B.folded` is the function-level regression gate.  Exit 0
+    ok / 1 diff regression / 2 usage error / 3 when the node is
+    unreachable or the profiler is disabled
+    (docs/observability.md "Continuous profiling")."""
+    from tendermint_tpu.cli.prof import run_diff, run_prof
+
+    if args.diff:
+        return run_diff(args.diff[0], args.diff[1], as_json=args.json,
+                        abs_threshold=args.abs_threshold,
+                        rel_threshold=args.rel_threshold)
+    return run_prof(args.pprof_laddr, seconds=args.seconds,
+                    watch=args.watch, as_json=args.json, flame=args.flame,
+                    interval=args.interval, timeout=args.timeout,
+                    top_n=args.top)
+
+
 def cmd_lint(args) -> int:
     """Repo-aware static analysis (tendermint_tpu/lint): six rules, each
     grounded in a shipped bug or a hot-path invariant.  Exit 0 = clean,
@@ -1269,6 +1290,53 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--timeout", type=float, default=5.0,
                     help="per-request HTTP timeout")
     sp.set_defaults(fn=cmd_health)
+
+    sp = sub.add_parser(
+        "prof",
+        help="continuous statistical CPU profile over /debug/pprof/"
+             "profile, plus .folded regression diffing "
+             "(exit 0 ok / 1 diff regression / 2 usage / 3 unreachable "
+             "or disabled)")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr",
+                    default="http://127.0.0.1:6060",
+                    help="the node's pprof listener "
+                         "(config.rpc.pprof_laddr)")
+    sp.add_argument("--once", action="store_true",
+                    help="print one report and exit (the default; kept "
+                         "for scripting symmetry with top)")
+    sp.add_argument("--watch", action="store_true",
+                    help="refresh every --interval seconds until "
+                         "interrupted")
+    sp.add_argument("--seconds", type=float, default=None,
+                    help="run a fresh delta capture of this many seconds "
+                         "on the node (default: read the continuous ring)")
+    sp.add_argument("--flame", default="",
+                    help="write the folded profile text to this path "
+                         "(flamegraph.pl / speedscope / inferno input)")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the parsed profile (or diff result) as "
+                         "JSON")
+    sp.add_argument("--top", type=int, default=10,
+                    help="functions shown per subsystem (default 10)")
+    sp.add_argument("--diff", nargs=2, metavar=("BASE.folded", "NEW.folded"),
+                    default=None,
+                    help="compare two saved .folded profiles at function "
+                         "level; exit 1 when a function's self-time "
+                         "share regressed past the thresholds")
+    sp.add_argument("--abs-threshold", dest="abs_threshold", type=float,
+                    default=0.05,
+                    help="--diff: absolute share growth (fraction of "
+                         "samples) to flag (default 0.05)")
+    sp.add_argument("--rel-threshold", dest="rel_threshold", type=float,
+                    default=0.25,
+                    help="--diff: relative share growth to flag "
+                         "(default 0.25)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds for --watch")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-request HTTP timeout (a --seconds capture "
+                         "extends it)")
+    sp.set_defaults(fn=cmd_prof)
 
     sp = sub.add_parser(
         "warm",

@@ -1,0 +1,175 @@
+"""`tendermint-tpu prof` — one node's statistical CPU profile.
+
+Reads the folded/collapsed-stack text the continuous profiler
+(utils/profiler.py) serves on `/debug/pprof/profile` and renders the
+top-N functions by self/cumulative samples per subsystem bucket (or
+raw JSON with `--json`; `--flame OUT` writes the folded text itself —
+flamegraph.pl / speedscope / inferno input).  `--seconds N` runs a
+fresh delta capture on the node; the default reads the continuous
+ring.  `--watch` refreshes like `top`.
+
+`prof --diff A.folded B.folded` compares two saved profiles at
+function level with benchdiff's direction-aware threshold idiom (class:
+self-time share, lower is better) — the regression gate a perf PR runs
+to pin "the hot path did not gain Python overhead".
+
+Exit-code contract (scriptable, mirrors `tendermint-tpu health`):
+  0  profile served / diff clean
+  1  --diff found at least one function regression
+  2  usage error (unreadable/empty profile files)
+  3  node unreachable, or the profiler is disabled (TM_TPU_PROF=0)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from tendermint_tpu.utils import profiler as _profiler
+from tendermint_tpu.utils.promparse import get_text as _get_text
+
+
+def _pprof_base(addr: str) -> str:
+    if addr.startswith("tcp://"):
+        addr = "http://" + addr[len("tcp://"):]
+    if not addr.startswith("http"):
+        addr = "http://" + addr
+    return addr.rstrip("/")
+
+
+def fetch_folded(pprof_addr: str, seconds: float | None = None,
+                 timeout: float = 5.0) -> str | None:
+    """Folded profile text from the node, or None when unreachable.
+    A capture blocks the node for `seconds`, so the HTTP timeout rides
+    on top of it."""
+    url = f"{_pprof_base(pprof_addr)}/debug/pprof/profile"
+    if seconds is not None:
+        url += f"?seconds={seconds:g}"
+        timeout += seconds
+    try:
+        return _get_text(url, timeout)
+    except Exception as e:  # noqa: BLE001 — node down is a report, not a crash
+        print(f"cannot reach {pprof_addr}: {e}", file=sys.stderr)
+        return None
+
+
+def header_meta(text: str) -> dict:
+    """key=value tokens from the `# tendermint-tpu profile ...` header
+    (enabled / hz / samples / node...)."""
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            break
+        for tok in line[1:].split():
+            if "=" in tok:
+                k, _, v = tok.partition("=")
+                out[k] = v
+    return out
+
+
+def render_tables(stacks: dict, top_n: int = 10) -> str:
+    """Top-N functions by self samples per subsystem, with cumulative
+    counts alongside (self = on-CPU leaf, cum = anywhere on stack)."""
+    table = _profiler.function_table(stacks)
+    total = sum(blk["samples"] for blk in table.values())
+    lines = []
+    for sub in sorted(table, key=lambda s: -table[s]["samples"]):
+        blk = table[sub]
+        share = blk["samples"] / total if total else 0.0
+        lines.append(f"-- {sub}  {blk['samples']} samples "
+                     f"({share:.0%} of profile) --")
+        rows = sorted(blk["functions"].items(),
+                      key=lambda kv: (-kv[1]["self"], -kv[1]["cum"], kv[0]))
+        shown = [(f, r) for f, r in rows if r["self"]][:top_n]
+        for func, row in shown:
+            lines.append(f"  {row['self']:>6} self {row['cum']:>6} cum  "
+                         f"{func}")
+        if not shown:
+            lines.append("  (no leaf samples)")
+    return "\n".join(lines) + "\n"
+
+
+def render_once(text: str, top_n: int = 10) -> str:
+    meta = header_meta(text)
+    stacks = _profiler.parse_folded(text)
+    head = (f"prof — {meta.get('node', 'node')}  hz {meta.get('hz', '?')}  "
+            f"samples {sum(stacks.values())}")
+    return head + "\n" + render_tables(stacks, top_n=top_n)
+
+
+def run_prof(pprof_addr: str, *, seconds: float | None = None,
+             watch: bool = False, as_json: bool = False, flame: str = "",
+             interval: float = 2.0, timeout: float = 5.0,
+             top_n: int = 10) -> int:
+    while True:
+        text = fetch_folded(pprof_addr, seconds=seconds, timeout=timeout)
+        disabled = (text is not None
+                    and header_meta(text).get("enabled") == "0")
+        rc = 3 if text is None or disabled else 0
+        if text is None:
+            sys.stdout.write("no profile (node unreachable?)\n")
+        elif disabled:
+            sys.stdout.write("profiler disabled (TM_TPU_PROF=0)\n")
+        elif flame:
+            with open(flame, "w") as fh:
+                fh.write(text)
+            sys.stdout.write(
+                f"wrote {sum(_profiler.parse_folded(text).values())} "
+                f"samples -> {flame}\n")
+        elif as_json:
+            stacks = _profiler.parse_folded(text)
+            sys.stdout.write(json.dumps({
+                "meta": header_meta(text),
+                "samples": sum(stacks.values()),
+                "subsystems": _profiler.function_table(stacks),
+            }, default=str) + "\n")
+        else:
+            prefix = "\x1b[H\x1b[2J" if watch else ""
+            sys.stdout.write(prefix + render_once(text, top_n=top_n))
+        sys.stdout.flush()
+        if not watch:
+            return rc
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return rc
+
+
+def run_diff(base_path: str, new_path: str, *, as_json: bool = False,
+             abs_threshold: float = 0.05,
+             rel_threshold: float = 0.25) -> int:
+    """Function-level regression diff between two .folded files; exit 1
+    on any regression (self-diff is clean by construction), 2 when a
+    file is unreadable or holds no samples."""
+    profiles = []
+    for path in (base_path, new_path):
+        try:
+            with open(path) as fh:
+                stacks = _profiler.parse_folded(fh.read())
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        if not stacks:
+            print(f"{path}: no samples", file=sys.stderr)
+            return 2
+        profiles.append(stacks)
+    result = _profiler.diff_folded(profiles[0], profiles[1],
+                                   abs_threshold=abs_threshold,
+                                   rel_threshold=rel_threshold)
+    if as_json:
+        sys.stdout.write(json.dumps(result) + "\n")
+        return 0 if result["ok"] else 1
+    moved = [r for r in result["rows"] if r["verdict"] != "ok"]
+    lines = [f"prof diff — {base_path} -> {new_path}  "
+             f"(self-share, lower is better; "
+             f"+{result['abs_threshold']:.0%}pt and "
+             f"+{result['rel_threshold']:.0%} rel to flag)"]
+    for r in moved or result["rows"][:5]:
+        mark = {"regression": "!", "improvement": "+", "ok": " "}[r["verdict"]]
+        lines.append(f"  {mark} {r['base']:>7.1%} -> {r['new']:>7.1%}  "
+                     f"{r['func']}  [{r['verdict']}]")
+    lines.append("REGRESSED: " + ", ".join(result["regressions"])
+                 if result["regressions"] else "ok — no function regressed")
+    sys.stdout.write("\n".join(lines) + "\n")
+    return 0 if result["ok"] else 1

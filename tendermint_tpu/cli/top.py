@@ -64,6 +64,16 @@ def fold_status(snap: dict, st: dict) -> None:
             "by_action": dict(rb.get("by_action") or {}),
             "quarantined": len(rb.get("quarantined_peers") or []),
         }
+    pb = st.get("prof")
+    if isinstance(pb, dict) and pb.get("enabled"):
+        snap["prof"] = {
+            "enabled": True,
+            "hz": pb.get("hz"),
+            "samples": int(pb.get("samples", 0)),
+            "by_subsystem": dict(pb.get("by_subsystem") or {}),
+            "overhead_s": pb.get("overhead_s"),
+            "triggers": int(pb.get("triggers", 0)),
+        }
     gb = st.get("gateway")
     if isinstance(gb, dict) and gb.get("enabled"):
         snap["gateway"] = {
@@ -243,6 +253,20 @@ def render(snap: dict) -> str:
             f"remediate  shed {('ok', 'WARN', 'CRITICAL')[min(2, shed)]}"
             f"  quarantined {rl.get('quarantined', 0)}"
             + (f"  [{acts}]" if acts else ""))
+    pl = snap.get("prof") or {}
+    if pl.get("enabled") or pl.get("samples"):
+        by = pl.get("by_subsystem") or {}
+        total = sum(by.values()) or pl.get("samples") or 0
+        btxt = "  ".join(
+            f"{sub}:{round(100 * c / total, 1)}%"
+            for sub, c in sorted(by.items(), key=lambda kv: -kv[1])[:5]
+        ) if total else ""
+        ov = pl.get("overhead_s")
+        lines.append(
+            f"prof       samples {_v(pl.get('samples'))}"
+            f"  hz {_v(pl.get('hz'))}"
+            f"  overhead {_v(ov if ov is None else round(ov, 3), '{}s')}"
+            + (f"  [{btxt}]" if btxt else ""))
     gl = snap.get("gateway") or {}
     if gl.get("enabled"):
         hit = gl.get("cache_hit_ratio")

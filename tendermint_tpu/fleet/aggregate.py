@@ -228,6 +228,37 @@ def aggregate(rows: list[dict], prev: dict | None = None) -> dict:
             by_name, "tendermint_health_slo_burn_total"),
     }
 
+    # profiler rollup: per-subsystem sample counters sum exactly across
+    # nodes (where is the FLEET's Python time going), overhead seconds
+    # sum, and the per-node status blocks merge into one top-subsystem
+    # table so a single node burning its budget in an odd bucket shows
+    prof_by_sub: dict[str, int] = {}
+    for l, v in by_name.get("tendermint_prof_samples_total", []):
+        sub = l.get("subsystem", "?")
+        prof_by_sub[sub] = prof_by_sub.get(sub, 0) + int(v)
+    prof_by_node: dict[str, dict] = {}
+    for r in rows:
+        pb = ((r.get("snap") or {}).get("prof") or {})
+        by_sub = pb.get("by_subsystem") or {}
+        if pb.get("samples") or by_sub:
+            top = (max(sorted(by_sub), key=by_sub.get) if by_sub else None)
+            prof_by_node[r["name"]] = {
+                "samples": pb.get("samples"),
+                "top_subsystem": top,
+                "overhead_s": pb.get("overhead_s"),
+            }
+    prof_ov = promparse.scalar(
+        by_name, "tendermint_prof_overhead_seconds_total")
+    prof = {
+        "samples_total": sum(prof_by_sub.values()) if prof_by_sub else None,
+        "by_subsystem": dict(sorted(prof_by_sub.items())),
+        "top_subsystem": (max(sorted(prof_by_sub), key=prof_by_sub.get)
+                          if prof_by_sub else None),
+        "overhead_seconds_total": (round(prof_ov, 6)
+                                   if prof_ov is not None else None),
+        "by_node": prof_by_node,
+    }
+
     scrape_ms = [n["scrape_ms"] for n in nodes if n["scrape_ms"] is not None]
     return {
         "ts": now,
@@ -249,6 +280,7 @@ def aggregate(rows: list[dict], prev: dict | None = None) -> dict:
         "compile": compile_blk,
         "gateway": gateway,
         "health": health,
+        "prof": prof,
         "scrape": {
             "ms_max": max(scrape_ms) if scrape_ms else None,
             "ms_mean": round(sum(scrape_ms) / len(scrape_ms), 2)

@@ -67,8 +67,8 @@ RULES: dict[str, str] = {
     "ungated-observability":
         "observability sink whose disabled-path contract is one caller "
         "branch (STATS.record_flush, journal.log, lifecycle.stamp, "
-        "health.sample/record, remediate.act/record) called without an "
-        "`.enabled` guard",
+        "health.sample/record, remediate.act/record, prof.sample/"
+        "capture) called without an `.enabled` guard",
     "host-sync-in-jit":
         "host synchronization (.item/.tolist/np.asarray/jax.device_get/"
         ".block_until_ready) inside a jit-compiled function body",
@@ -101,6 +101,7 @@ JAX_ALLOWED_DIRS = {"ops", "parallel"}
 #: files define sinks, the mempool cache is a plain call site).
 OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
                            "txlife.py", "health.py", "remediate.py",
+                           "profiler.py",
                            "gateway/coalescer.py", "gateway/cache.py",
                            "gateway/service.py",
                            "fleet/slo.py", "fleet/aggregate.py",
@@ -551,26 +552,36 @@ class _Walker:
                         node, "ungated-observability",
                         "lifecycle.stamp() without an `if ...enabled:` "
                         "guard — the disabled path must cost one branch")
-            elif func.attr in ("sample", "record", "act") and not st.gated:
-                # health-watchdog sinks (utils/health.py) and
-                # remediation sinks (utils/remediate.py): explicit
-                # sampling, out-of-band observation pushes and
-                # transition dispatch cost one branch when the env gate
-                # routes to the NOP singleton
+            elif func.attr in ("sample", "record", "act", "capture") \
+                    and not st.gated:
+                # health-watchdog sinks (utils/health.py), remediation
+                # sinks (utils/remediate.py) and the continuous
+                # profiler (utils/profiler.py): explicit sampling,
+                # out-of-band observation pushes, transition dispatch
+                # and blocking delta captures cost one branch when the
+                # env gate routes to the NOP singleton
                 recv = func.value
                 recv_name = recv.attr if isinstance(recv, ast.Attribute) \
                     else (recv.id if isinstance(recv, ast.Name) else "")
                 if recv_name.endswith(("health", "HEALTH")) \
-                        and func.attr != "act":
+                        and func.attr in ("sample", "record"):
                     self._report(
                         node, "ungated-observability",
                         f"health.{func.attr}() without an "
                         "`if ...enabled:` guard — the disabled path "
                         "must cost one branch")
-                elif recv_name.endswith(("remediate", "REMEDIATE")):
+                elif recv_name.endswith(("remediate", "REMEDIATE")) \
+                        and func.attr in ("sample", "record", "act"):
                     self._report(
                         node, "ungated-observability",
                         f"remediate.{func.attr}() without an "
+                        "`if ...enabled:` guard — the disabled path "
+                        "must cost one branch")
+                elif recv_name.endswith(("prof", "PROF")) \
+                        and func.attr in ("sample", "capture"):
+                    self._report(
+                        node, "ungated-observability",
+                        f"prof.{func.attr}() without an "
                         "`if ...enabled:` guard — the disabled path "
                         "must cost one branch")
 

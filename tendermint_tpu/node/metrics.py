@@ -363,6 +363,27 @@ class NodeMetrics:
             fn=lambda: node.health.slo_burn_samples(),
         ))
 
+        # -- continuous profiler (utils/profiler.py) --------------------
+        # statistical sampler attribution + self-cost, read from the
+        # node's sampler at scrape time; empty (TYPE lines only) when
+        # disabled (TM_TPU_PROF=0 → the NOP singleton) — the scrape
+        # never instantiates a profiler.
+        self.prof_samples = reg.register(LabeledCallbackGauge(
+            "prof_samples_total",
+            "Statistical profiler thread-samples by subsystem bucket "
+            "(consensus | verify-service | gateway | rpc | health | ...)",
+            namespace=ns, kind="counter",
+            fn=lambda: node.prof.subsystem_samples(),
+        ))
+        self.prof_overhead = reg.register(LabeledCallbackGauge(
+            "prof_overhead_seconds_total",
+            "Cumulative wall seconds the sampler spent folding stacks "
+            "— the profiler's own cost, so its overhead budget is "
+            "itself observable",
+            namespace=ns, kind="counter",
+            fn=lambda: node.prof.overhead_samples(),
+        ))
+
         # -- remediation controller (utils/remediate.py) ----------------
         # actions executed per (action, triggering detector), and the
         # currently-active state per action (shed = admission level,

@@ -424,6 +424,22 @@ class Node:
         if self.health.enabled and self.remediate.enabled:
             self.health.remediate = self.remediate
 
+        # -- continuous profiler (TM_TPU_PROF, default on;
+        # utils/profiler.py): a ~19 Hz statistical sampler attributing
+        # CPU time to subsystem buckets; serves /debug/pprof/profile,
+        # feeds tendermint_prof_* metrics, and — wired as the health
+        # monitor's sink — arms rate-limited trigger captures on
+        # critical escalations / slo_burn and rides the flight-recorder
+        # bundle (profile.folded).  One branch per call site when off.
+        from tendermint_tpu.utils import profiler as _profiler
+
+        self.prof = _profiler.from_env(
+            node=config.base.moniker or self.node_key.node_id[:8],
+            root=config.home,
+        )
+        if self.health.enabled and self.prof.enabled:
+            self.health.prof = self.prof
+
         # -- RPC --------------------------------------------------------
         from tendermint_tpu.rpc.core import Environment
         from tendermint_tpu.rpc.server import RPCServer
@@ -470,6 +486,7 @@ class Node:
             health=self.health,
             remediate=self.remediate,
             gateway=self.gateway,
+            prof=self.prof,
         )
         self.grpc_server = None
         self.pprof_server = None
@@ -581,7 +598,8 @@ class Node:
             from tendermint_tpu.node.pprof import PprofServer
 
             self.pprof_server = PprofServer(logger=self.logger,
-                                            health=self.health)
+                                            health=self.health,
+                                            prof=self.prof)
             host, port = _parse_laddr(self.config.rpc.pprof_laddr, default_port=6060)
             self.pprof_addr = await self.pprof_server.start(host, port)
         if isinstance(self.transport, TCPTransport):
@@ -630,6 +648,8 @@ class Node:
         # watchdog last: everything it samples exists and is serving
         if self.health.enabled:
             self.health.start()
+        if self.prof.enabled:
+            self.prof.start()
 
         if self.config.base.fast_sync:
             await self.blocksync_reactor.start(sync=True)
@@ -743,6 +763,8 @@ class Node:
         self._started = False
         if self.health.enabled:
             self.health.stop()
+        if self.prof.enabled:
+            self.prof.stop()
         if self._dialer_task is not None:
             self._dialer_task.cancel()
             try:

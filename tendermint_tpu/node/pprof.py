@@ -20,6 +20,13 @@ The Python equivalents of goroutine/heap profiles:
     GET /debug/pprof/health    the health watchdog's per-detector
                                status + recent transitions
                                (utils.health)
+    GET /debug/pprof/profile   statistical CPU profile (utils.profiler):
+                               ?seconds=N runs a blocking delta capture
+                               (default 2s, folded/collapsed-stack
+                               text); ?fmt=chrome returns the capture
+                               as Perfetto-loadable trace-event JSON;
+                               without ?seconds the continuous ring is
+                               returned immediately
 
 Plain text responses, stdlib only.
 """
@@ -96,7 +103,8 @@ class PprofServer:
     """Diagnostics listener on the shared TextHTTPServer (independent of
     the RPC server: must answer when the RPC stack is wedged)."""
 
-    def __init__(self, logger: Logger | None = None, health=None):
+    def __init__(self, logger: Logger | None = None, health=None,
+                 prof=None):
         from tendermint_tpu.utils.httpserv import TextHTTPServer
 
         self.logger = logger or nop_logger()
@@ -107,6 +115,13 @@ class PprofServer:
 
             health = _health.NOP
         self.health = health
+        # the node's continuous Profiler (utils/profiler.py); defaults
+        # to the NOP singleton so /debug/pprof/profile always answers
+        if prof is None:
+            from tendermint_tpu.utils import profiler as _profiler
+
+            prof = _profiler.NOP
+        self.prof = prof
         self._http = TextHTTPServer(self._route)
 
     async def start(self, host: str, port: int) -> tuple[str, int]:
@@ -139,6 +154,35 @@ class PprofServer:
             fmt = urllib.parse.parse_qs(parsed.query).get("fmt", [""])[0]
             ctype, body = _trace_dump(fmt)
             return 200, ctype, body.encode()
+        elif route.startswith("/debug/pprof/profile"):
+            q = urllib.parse.parse_qs(parsed.query)
+            fmt = q.get("fmt", [""])[0]
+            raw = q.get("seconds", [""])[0]
+            if not self.prof.enabled:
+                body = "# tendermint-tpu profile enabled=0\n"
+                return 200, "text/plain", body.encode()
+            if raw or fmt == "chrome":
+                try:
+                    seconds = float(raw) if raw else 2.0
+                except ValueError:
+                    return 400, "text/plain", b"bad seconds\n"
+                # blocking delta capture, off the event loop: capture
+                # sleeps for `seconds` and the loop must keep serving
+                cap = await asyncio.to_thread(self.prof.capture, seconds)
+                from tendermint_tpu.utils import profiler as _profiler
+
+                if fmt == "chrome":
+                    return (200, "application/json",
+                            _profiler.export_chrome(cap).encode())
+                header = (f"tendermint-tpu profile capture "
+                          f"node={cap['node'] or 'node'} enabled=1 "
+                          f"hz={cap['hz']:g} seconds={cap['seconds']:g} "
+                          f"sweeps={cap['sweeps']} "
+                          f"samples={cap['samples']}")
+                body = _profiler.render_folded(cap["stacks"],
+                                               header=header)
+            else:
+                body = self.prof.folded_recent()
         elif route.startswith("/debug/pprof/device"):
             # device-layer accounting (utils/devmon): compile events,
             # batch occupancy/padding, device memory.  Never initializes
@@ -151,6 +195,7 @@ class PprofServer:
                     "/debug/pprof/goroutine\n/debug/pprof/stacks\n"
                     "/debug/pprof/heap\n"
                     "/debug/pprof/trace[?fmt=chrome]\n"
+                    "/debug/pprof/profile[?seconds=N&fmt=chrome]\n"
                     "/debug/pprof/device\n/debug/pprof/health\n")
         else:
             return None

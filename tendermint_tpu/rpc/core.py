@@ -53,6 +53,7 @@ class Environment:
         health=None,
         remediate=None,
         gateway=None,
+        prof=None,
     ):
         self.config = config
         self.genesis = genesis
@@ -88,6 +89,12 @@ class Environment:
         # node runs with TM_TPU_GATEWAY=1 — `status` then publishes the
         # serving block (clients, cache hit ratio, dedup, shed state)
         self.gateway = gateway
+        # continuous profiler (utils/profiler.py): `status` publishes
+        # its block so `tendermint-tpu top` gets hz/samples/overhead
+        # without a second listener; NOP when TM_TPU_PROF=0
+        from tendermint_tpu.utils import profiler as _profiler
+
+        self.prof = prof if prof is not None else _profiler.NOP
 
 
 def _latest_height(env: Environment) -> int:
@@ -197,6 +204,11 @@ def status(env: Environment) -> dict:
     gw = getattr(env, "gateway", None)
     if gw is not None:
         out["gateway"] = gw.status_block()
+    # profiler block, only when the sampler is on — TM_TPU_PROF=0
+    # leaves the status document bit-identical
+    prof = getattr(env, "prof", None)
+    if prof is not None and prof.enabled:
+        out["prof"] = prof.status_block()
     return out
 
 

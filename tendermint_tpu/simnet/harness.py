@@ -62,6 +62,7 @@ from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 from tendermint_tpu.utils import clock as clockmod
 from tendermint_tpu.utils import fail
 from tendermint_tpu.utils import health as tmhealth
+from tendermint_tpu.utils import profiler as tmprof
 from tendermint_tpu.utils import remediate as tmremediate
 from tendermint_tpu.utils.log import Logger, nop_logger
 from tendermint_tpu.utils.txlife import TxLifecycle
@@ -254,6 +255,16 @@ class SimNode:
             )
         if self.health.enabled and self.remediate.enabled:
             self.health.remediate = self.remediate
+        # continuous profiler (TM_TPU_PROF, default on): the sampler is
+        # a WALL-clock daemon thread, so it only runs in wall mode (see
+        # start()); in virtual mode the report stays empty rather than
+        # sampling a wall cadence against a virtual timeline.  Window
+        # boundaries ride the node clock so wall-mode folds line up
+        # with the journal.
+        self.prof = tmprof.from_env(node=self.name, root=home,
+                                    clock=self.clock.monotonic)
+        if self.health.enabled and self.prof.enabled:
+            self.health.prof = self.prof
         self.reactor = ConsensusReactor(
             self.cs, self.router, self.block_store,
             gossip_sleep_ms=gossip_sleep_ms, maj23_sleep_ms=500,
@@ -301,9 +312,18 @@ class SimNode:
             # timeline and a nondeterministic one); the runner's
             # _health_ticker task drives sample() instead
             self.health.start()
+        if self.prof.enabled and not self.clock.virtual:
+            # same contract as the health ticker: the sampler blocks a
+            # real thread between sweeps, so virtual mode skips it
+            # entirely (no task drives it — stack sampling of a paused
+            # virtual timeline would attribute everything to the
+            # scheduler)
+            self.prof.start()
 
     async def stop(self) -> None:
         """Clean shutdown (end of run)."""
+        if self.prof.enabled:
+            self.prof.stop()
         if self.health.enabled:
             self.health.stop()
         await self.cs.stop()
@@ -317,6 +337,8 @@ class SimNode:
         clean-shutdown work beyond releasing file handles (their content
         is already on disk — the WAL flushes per write)."""
         self.crashed = True
+        if self.prof.enabled:
+            self.prof.stop()
         if self.health.enabled:
             self.health.stop(timeout=0.2)
         fail.uninstall(self.name)
@@ -591,6 +613,12 @@ class SimnetRunner:
                         else {"enabled": False})
             for node in self.nodes
         }
+        profile_reports = {
+            node.name: (node.prof.report()
+                        if node.prof.enabled and not self.clock.virtual
+                        else {"enabled": False})
+            for node in self.nodes
+        }
 
         fleet_block = None
         if self._slo_objectives:
@@ -608,6 +636,7 @@ class SimnetRunner:
             "fleet": fleet_block,
             "health": health_reports,
             "remediation": remediation_reports,
+            "profile": profile_reports,
             "duration_s": duration_s,
             "timed_out": timed_out,
             "timeout_commit_ms": self._ccfg.timeout_commit_ms,

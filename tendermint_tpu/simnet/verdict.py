@@ -328,6 +328,34 @@ def _health_block(run_info: dict) -> dict:
     return {"per_node": per_node, "first_critical": first_critical}
 
 
+def _profile_block(run_info: dict) -> dict:
+    """Per-node sampler summary from the runners' Profiler reports
+    (utils/profiler.py): sample counts, the dominant subsystem by
+    self-time, and the hottest function — so a stalling scenario's
+    verdict says WHERE the node spent the stall, not only that it
+    stalled.  Virtual-time runs report {"enabled": False}: the sampler
+    is wall-clock-only (see simnet harness)."""
+    per_node: dict[str, dict] = {}
+    hottest = None
+    for name, rep in sorted((run_info.get("profile") or {}).items()):
+        if not rep.get("enabled"):
+            per_node[name] = {"enabled": False}
+            continue
+        top = rep.get("top") or []
+        per_node[name] = {
+            "enabled": True,
+            "samples": rep.get("samples", 0),
+            "top_subsystem": rep.get("top_subsystem"),
+            "by_subsystem": rep.get("by_subsystem") or {},
+            "top_function": top[0]["func"] if top else None,
+            "overhead_s": rep.get("overhead_s", 0.0),
+            "triggers": rep.get("triggers", 0),
+        }
+        if top and (hottest is None or top[0]["self"] > hottest["self"]):
+            hottest = {"node": name, **top[0]}
+    return {"per_node": per_node, "hottest_function": hottest}
+
+
 def evaluate(scenario: Scenario, report: TimelineReport,
              run_info: dict) -> dict:
     violations: list[dict] = []
@@ -465,6 +493,7 @@ def evaluate(scenario: Scenario, report: TimelineReport,
         "diagnosis": diagnosis,
         "health": health,
         "remediation": remediation,
+        "profile": _profile_block(run_info),
         "fleet": fleet,
         "scenario": {
             "name": scenario.name,

@@ -508,7 +508,7 @@ class FlightRecorder:
 
             return json.dumps(_av.service_stats(), indent=2, default=str)
 
-        return [
+        sources = [
             ("stacks.txt", format_thread_stacks),
             ("health.json", lambda: json.dumps(monitor.report(), indent=2,
                                                default=str)),
@@ -516,6 +516,13 @@ class FlightRecorder:
             ("device_stats.json", _device),
             ("service_stats.json", _service),
         ]
+        # continuous-profiler window (utils/profiler.py): the folded
+        # pre-critical ring, next to the one-shot stack dump — same
+        # per-source containment as every other member
+        prof = getattr(monitor, "prof", None)
+        if prof is not None and prof.enabled:
+            sources.append(("profile.folded", prof.folded_recent))
+        return sources
 
     def _journal_tail(self) -> bytes | None:
         if not self.journal_path or not os.path.exists(self.journal_path):
@@ -625,6 +632,24 @@ class _NopRemediate:
 _NOP_REMEDIATE = _NopRemediate()
 
 
+class _NopProfSink:
+    """Default profiler sink: disabled.  The node/SimNode assigns a
+    real `utils/profiler.Profiler` (defined there, not here, so health
+    carries no profiler imports); critical escalations and slo_burn
+    records pay one branch when off."""
+
+    enabled = False
+
+    def trigger(self, reason: str = "") -> bool:
+        return False
+
+    def folded_recent(self) -> str:
+        return ""
+
+
+_NOP_PROF = _NopProfSink()
+
+
 class HealthMonitor:
     """One node's watchdog.  `enabled` is True so the one-branch guard
     at call sites passes; `NOP` is the disabled twin.
@@ -658,6 +683,11 @@ class HealthMonitor:
         # RemediationController after construction; transitions flow
         # through `.act()` under the one-branch guard below
         self.remediate = _NOP_REMEDIATE
+        # profiler sink (utils/profiler.py): the node assigns its
+        # Profiler after construction; critical escalations and
+        # slo_burn records arm a rate-limited trigger capture, and the
+        # flight recorder bundles the folded pre-critical ring
+        self.prof = _NOP_PROF
         self.fault_grace_s = fault_grace_s
         self._clock = clock
         self._lock = threading.Lock()
@@ -710,6 +740,10 @@ class HealthMonitor:
             if name == "slo_burn":
                 self.slo_burns += 1
                 self._last_slo_burn = value
+        # fleet-scope pressure wants a profile: arm a rate-limited
+        # trigger capture (outside the lock — the profiler has its own)
+        if name == "slo_burn" and self.prof.enabled:
+            self.prof.trigger("slo_burn")
 
     # -- sampling -------------------------------------------------------
 
@@ -781,9 +815,15 @@ class HealthMonitor:
                     self.remediate.act(tr)
                 except Exception as e:  # noqa: BLE001 — watchdog survives
                     _log.warning("remediation act failed: %r", e)
-            if (tr["to"] == CRITICAL and tr["from"] < CRITICAL
-                    and self.recorder is not None):
-                tr["bundle"] = self.recorder.record(self, d, transition=tr)
+            if tr["to"] == CRITICAL and tr["from"] < CRITICAL:
+                # profile the escalation: arm the (rate-limited)
+                # trigger BEFORE the bundle snapshot so the bundle's
+                # profile.folded and any device capture share the event
+                if self.prof.enabled:
+                    self.prof.trigger(f"health-critical:{d.name}")
+                if self.recorder is not None:
+                    tr["bundle"] = self.recorder.record(self, d,
+                                                        transition=tr)
         if self.remediate.enabled:
             for name, level in steady:
                 try:
@@ -929,6 +969,7 @@ class _NopMonitor:
     enabled = False
     detectors: tuple = ()
     recorder = None
+    prof = _NOP_PROF
 
     def sample(self) -> dict:
         return {}

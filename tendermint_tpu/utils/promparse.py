@@ -197,6 +197,8 @@ def empty_snapshot() -> dict:
         "costs": {},
         "txlife": {"finality": None, "residency": None, "quorum_wait": {}},
         "health": {"level": None, "detectors": {}},
+        "prof": {"enabled": None, "hz": None, "samples": None,
+                 "by_subsystem": {}, "overhead_s": None, "triggers": None},
         "remediation": {"enabled": None, "shed_level": None,
                         "by_action": {}, "quarantined": 0},
         "gateway": {"enabled": None, "clients": None,
@@ -360,6 +362,21 @@ def fold_metrics(snap: dict, by_name: dict) -> None:
         if dets:
             hl["detectors"] = dets
             hl["level"] = max(dets.values())
+
+    # continuous profiler: the per-subsystem sample counter is the
+    # metrics-side twin of the RPC status prof block
+    pl = snap.setdefault(
+        "prof", {"enabled": None, "hz": None, "samples": None,
+                 "by_subsystem": {}, "overhead_s": None, "triggers": None})
+    if pl["samples"] is None:
+        by_sub = {labels.get("subsystem", "?"): int(v) for labels, v in
+                  by_name.get("tendermint_prof_samples_total", [])}
+        if by_sub:
+            pl["by_subsystem"] = by_sub
+            pl["samples"] = sum(by_sub.values())
+        ov = scalar(by_name, "tendermint_prof_overhead_seconds_total")
+        if ov is not None:
+            pl["overhead_s"] = ov
 
     # remediation controller: the active-state gauge is the metrics-side
     # twin of status.health.remediation

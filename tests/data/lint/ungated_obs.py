@@ -5,11 +5,12 @@ from tendermint_tpu.utils import devmon
 
 
 class Site:
-    def __init__(self, journal, lifecycle, health, remediate):
+    def __init__(self, journal, lifecycle, health, remediate, prof):
         self.journal = journal
         self.lifecycle = lifecycle
         self.health = health
         self.remediate = remediate
+        self.prof = prof
         self.replay_mode = False
 
     def flush_ungated(self, n, rung):
@@ -43,6 +44,15 @@ class Site:
     def act_ungated_upper(self, REMEDIATE, tr):
         REMEDIATE.act(tr)  # LINT: ungated-observability
 
+    def prof_sample_ungated(self):
+        self.prof.sample()  # LINT: ungated-observability
+
+    def prof_capture_ungated(self):
+        self.prof.capture(2.0)  # LINT: ungated-observability
+
+    def prof_capture_ungated_upper(self, PROF):
+        PROF.capture(1.0)  # LINT: ungated-observability
+
     def act_gated(self, tr):
         if self.remediate.enabled:
             self.remediate.act(tr)
@@ -68,6 +78,19 @@ class Site:
     def sample_other_receiver(self, rng, population):
         # random.sample is not a health sink: no finding
         return rng.sample(population, 2)
+
+    def prof_sample_gated(self):
+        if self.prof.enabled:
+            self.prof.sample()
+
+    def prof_capture_early_exit(self):
+        if not self.prof.enabled:
+            return
+        self.prof.capture(2.0)
+
+    def capture_other_receiver(self, image):
+        # camera capture is not a profiler sink: no finding
+        return image.capture()
 
     def stamp_gated(self, key):
         if self.lifecycle.enabled:

@@ -273,6 +273,34 @@ def test_aggregate_merges_and_degrades():
     assert fleet["errors"] == ["node2: status: down"]
 
 
+def test_aggregate_prof_rollup():
+    def _prof_samples(consensus, other):
+        return promparse.parse_exposition("\n".join([
+            "tendermint_prof_samples_total"
+            f'{{subsystem="consensus"}} {consensus}',
+            f'tendermint_prof_samples_total{{subsystem="other"}} {other}',
+            "tendermint_prof_overhead_seconds_total 0.5",
+        ]))
+
+    rows = [_row("node0", samples=_prof_samples(40, 10)),
+            _row("node1", samples=_prof_samples(5, 30))]
+    for r, by_sub in zip(rows, ({"consensus": 40, "other": 10},
+                                {"consensus": 5, "other": 30})):
+        r["snap"]["prof"] = {"enabled": True,
+                             "samples": sum(by_sub.values()),
+                             "by_subsystem": by_sub, "overhead_s": 0.5}
+    prof = aggregate(rows)["prof"]
+    assert prof["samples_total"] == 85
+    assert prof["by_subsystem"] == {"consensus": 45, "other": 40}
+    assert prof["top_subsystem"] == "consensus"
+    assert prof["overhead_seconds_total"] == pytest.approx(1.0)
+    assert prof["by_node"]["node0"]["top_subsystem"] == "consensus"
+    assert prof["by_node"]["node1"]["top_subsystem"] == "other"
+    # no prof series anywhere: nulls, never a crash
+    empty = aggregate([_row("n0", samples=_fin_samples(1))])["prof"]
+    assert empty["samples_total"] is None and empty["by_node"] == {}
+
+
 def test_aggregate_sigs_per_s_from_prev():
     rows1 = [_row("n0", samples=_fin_samples(2))]
     prev = aggregate(rows1)

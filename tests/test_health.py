@@ -11,6 +11,7 @@ before the heal and clear after it, with exactly one forensic bundle.
 import asyncio
 import json
 import os
+import threading
 import urllib.request
 
 import pytest
@@ -365,6 +366,21 @@ def test_flight_recorder_bundle_roundtrip(tmp_path):
     rec = FlightRecorder(str(tmp_path), keep=5, min_interval_s=0.0,
                          journal_path=str(jr_path))
     mon, box = _stall_monitor(recorder=rec)
+    # a live profiler with at least one sweep: the bundle must carry
+    # the recent profile as profile.folded
+    from tendermint_tpu.utils import profiler as profmod
+
+    prof = profmod.Profiler(node="t", trigger_min_s=0.0)
+    evt = threading.Event()
+    helper = threading.Thread(target=evt.wait, name="tm-verify-service-0",
+                              daemon=True)
+    helper.start()
+    try:
+        prof.sample()   # sweeps the helper (the caller excludes itself)
+    finally:
+        evt.set()
+    assert prof.samples >= 1
+    mon.prof = prof
     mon.sample()
     box["t"] = 10.0
     mon.sample()    # critical -> bundle
@@ -375,7 +391,13 @@ def test_flight_recorder_bundle_roundtrip(tmp_path):
     names = set(os.listdir(bdir))
     assert {"manifest.json", "stacks.txt", "health.json",
             "service_stats.json", "device_stats.json", "trace.jsonl",
-            "journal_tail.jsonl"} <= names
+            "journal_tail.jsonl", "profile.folded"} <= names
+    folded = (bdir / "profile.folded").read_text()
+    assert "enabled=1" in folded
+    assert sum(profmod.parse_folded(folded).values()) >= 1
+    # the critical transition also fired the profiler's trigger path
+    assert prof.triggers == 1
+    assert prof.report()["last_trigger"] == "health-critical:height_stall"
     manifest = json.loads((bdir / "manifest.json").read_text())
     assert manifest["detector"] == "height_stall"
     assert manifest["level"] == CRITICAL

@@ -164,6 +164,9 @@ def test_fold_metrics_fills_empty_snapshot():
         "tendermint_crypto_verify_queue_depth 3",
         'tendermint_health_status{detector="height_stall"} 2',
         'tendermint_health_status{detector="peer_flap"} 0',
+        'tendermint_prof_samples_total{subsystem="consensus"} 40',
+        'tendermint_prof_samples_total{subsystem="other"} 10',
+        "tendermint_prof_overhead_seconds_total 0.25",
     ])
     by = promparse.index_samples(promparse.parse_exposition(text))
     promparse.fold_metrics(snap, by)
@@ -171,3 +174,6 @@ def test_fold_metrics_fills_empty_snapshot():
     assert snap["verify"]["queue_depth"] == 3
     assert snap["health"]["level"] == 2
     assert snap["health"]["detectors"]["height_stall"] == 2
+    assert snap["prof"]["samples"] == 50
+    assert snap["prof"]["by_subsystem"] == {"consensus": 40, "other": 10}
+    assert snap["prof"]["overhead_s"] == 0.25
