@@ -878,6 +878,73 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["prof_overhead_error"] = str(e)[-300:]
 
+        # Flight-data history overhead (ISSUE 19): the recorder's cost
+        # contract, measured BEFORE the device stages like the other
+        # observability gates — the DISABLED path is one attribute-load
+        # + branch against the NOP singleton, one ENABLED sample
+        # (source render + parse + delta-encode + disk append) stays
+        # under a stated budget, and the segment growth at the default
+        # cadence is reported as bytes/hour so retention math stays an
+        # artifact fact, not a doc promise.
+        _stage_set("history-overhead")
+        try:
+            import shutil as _sh
+            import tempfile as _tf
+
+            from tendermint_tpu.utils import history as _hist
+
+            N_EV = 20_000
+            nop = _hist.NOP
+            t0 = time.perf_counter()
+            for _ in range(N_EV):
+                # measured exactly as call sites write it
+                if nop.enabled:
+                    nop.sample()
+            disabled_ns = (time.perf_counter() - t0) / N_EV * 1e9
+
+            # ~30-series synthetic exposition (a small node's /metrics),
+            # two of them moving per sample so deltas stay non-trivial;
+            # the static block is pre-rendered so the measurement
+            # charges the RECORDER (parse + delta + append), not
+            # synthetic string construction
+            static_h = "\n".join(f"tendermint_bench_gauge_{i} {i * 1.5}"
+                                 for i in range(28))
+            state_h = {"n": 0}
+
+            def _src() -> str:
+                state_h["n"] += 1
+                n = state_h["n"]
+                return (f"{static_h}\n"
+                        f"tendermint_bench_commits_total {n}\n"
+                        f"tendermint_bench_height {n // 2}\n")
+
+            hist_dir = _tf.mkdtemp(prefix="bench-history-")
+            rec = _hist.HistoryRecorder(node="bench", root=hist_dir,
+                                        source=_src)
+            N_S = 2_000
+            t0 = time.perf_counter()
+            for _ in range(N_S):
+                if rec.enabled:
+                    rec.sample()
+            enabled_us = (time.perf_counter() - t0) / N_S * 1e6
+            budget_us = 50.0  # per sample; default cadence is 0.1 Hz
+            bytes_per_hour = (rec.bytes_written / N_S
+                              * 3600.0 / _hist.DEFAULT_INTERVAL_S)
+            rec.stop()
+            _sh.rmtree(hist_dir, ignore_errors=True)
+            _partial.update({
+                "history_disabled_ns_per_sample": round(disabled_ns, 1),
+                "history_enabled_us_per_sample": round(enabled_us, 2),
+                "history_budget_us_per_sample": budget_us,
+                "history_within_budget": bool(enabled_us <= budget_us),
+                "history_bytes_per_hour": round(bytes_per_hour, 1),
+                "history_interval_s": _hist.DEFAULT_INTERVAL_S,
+            })
+            assert enabled_us <= budget_us, (
+                f"history {enabled_us:.1f}us/sample exceeds {budget_us}us")
+        except Exception as e:  # noqa: BLE001
+            _partial["history_overhead_error"] = str(e)[-300:]
+
         if platform == "cpu":
             # XLA-CPU device path: diagnostic only (trend tracking), at a
             # reduced batch; NOTHING here — including the import and the

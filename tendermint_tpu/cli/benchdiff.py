@@ -68,6 +68,9 @@ META_KEYS = {
     # sampling rate is run context: comparing a 19 Hz round against a
     # 97 Hz round must not read the rate change itself as a regression
     "prof_hz",
+    # history cadence is run context for the same reason: a different
+    # TM_TPU_HISTORY_INTERVAL_S changes bytes/hour by construction
+    "history_interval_s",
 }
 
 # Ordered (pattern, class, direction) — first match wins.  direction
@@ -95,7 +98,8 @@ _CLASS_RULES = (
                 r"|_deterministic)$"),
      "boolean", "higher"),
     (re.compile(r"(_p50_ms|_ms)$"), "latency", "lower"),
-    (re.compile(r"(_bytes_per_row|_flops_per_row)$"), "resource", "lower"),
+    (re.compile(r"(_bytes_per_row|_flops_per_row|_bytes_per_hour)$"),
+     "resource", "lower"),
     (re.compile(r"(_ns_per_event|_us_per_event|_ns_per_flush"
                 r"|_us_per_flush|_ns_per_stamp|_us_per_stamp"
                 r"|_ns_per_sample|_us_per_sample"

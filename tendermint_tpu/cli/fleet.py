@@ -15,6 +15,16 @@ scripting.  Exit-code contract (cron/CI gates):
   0  every objective ok (or no-data without require_data)
   1  at least one objective at warn
   2  at least one objective BURNING (or required data missing)
+
+History backfill (utils/history.py): a target with a third address
+field (`[name=]rpc[,metrics[,pprof]]`) exposes its recorded metric
+history over `/debug/pprof/history`.  Before the first frame the
+recorded range is replayed through the burn engine
+(`fleet.evaluate_history`), so `--once` gates on REAL dual-window burn
+rates instead of the collapsed single-point verdict, and a restarted
+`--watch` scraper starts with its windows already full.  No pprof
+address, history off, or an unreachable listener all degrade to the
+old collapsed semantics — never an error.
 """
 
 from __future__ import annotations
@@ -28,6 +38,8 @@ from tendermint_tpu.fleet import (
     aggregate,
     default_objectives,
     evaluate,
+    evaluate_history,
+    fetch_fleet_history,
     load_slo,
     parse_target,
     scrape_fleet,
@@ -146,14 +158,33 @@ def run_fleet(node_specs: list[str], *, slo_path: str = "",
     except (OSError, ValueError, ImportError, TypeError) as e:
         print(f"fleet: {e}", file=sys.stderr)
         return 3
-    engine = BurnEngine()
+    # wall-clock engine: backfilled history points carry wall stamps,
+    # and live feeds must share their timeline
+    engine = BurnEngine(clock=time.time)
     prev = None
     rc = 0
+    backfill = None
+    if any(t.pprof for t in targets):
+        lookback = max((o.slow_window_s for o in objectives),
+                       default=3600.0)
+        histories = fetch_fleet_history(
+            targets, since_s=max(0.0, time.time() - lookback),
+            timeout=max(timeout, 5.0))
+        if any(histories.values()):
+            backfill = evaluate_history(objectives, histories,
+                                        engine=engine)
     try:
         while True:
             rows = scrape_fleet(targets, timeout=timeout)
             fleet = aggregate(rows, prev=prev)
             fleet["slo"] = evaluate(objectives, fleet, engine=engine)
+            if backfill is not None:
+                fleet["slo"]["source"] = "history"
+                fleet["slo"]["history"] = {
+                    "points": backfill["points"],
+                    "span_s": backfill["span_s"],
+                    "nodes": backfill["nodes"],
+                }
             rc = fleet["slo"]["exit_code"]
             prev = fleet
             if as_json:

@@ -1,0 +1,177 @@
+"""`tendermint-tpu history` — one node's recorded metric time-series.
+
+Reads the flight-data history the embedded recorder
+(utils/history.py) keeps under `<home>/history/` — either straight
+from disk with `--home` (works on a dead node's home; torn segment
+tails degrade to their valid prefix) or over a live node's
+`/debug/pprof/history` endpoint with `--pprof-laddr` — and renders a
+terminal sparkline per metric, counter rates with `--rate`,
+histogram quantiles-over-time with `--quantiles`, or the raw
+structured document with `--json`.
+
+`--since N` restricts the range to the last N seconds; `--list`
+prints the recorded metric names.  Exit-code contract (mirrors
+`tendermint-tpu prof`):
+  0  history served and the selected range is non-empty
+  1  history served but the range (or selected metric) is empty
+  2  usage error
+  3  node unreachable, or the recorder is disabled (TM_TPU_HISTORY=0)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from tendermint_tpu.utils import history as _history
+from tendermint_tpu.utils.promparse import get_text as _get_text
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _pprof_base(addr: str) -> str:
+    if addr.startswith("tcp://"):
+        addr = "http://" + addr[len("tcp://"):]
+    if not addr.startswith("http"):
+        addr = "http://" + addr
+    return addr.rstrip("/")
+
+
+def sparkline(values, width: int = 60) -> str:
+    """Unicode block sparkline, resampled to `width` cells by bucket
+    means; a flat series renders as its floor block."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = len(vals) / width
+        vals = [sum(chunk) / len(chunk) for chunk in
+                (vals[int(i * step):max(int(i * step) + 1,
+                                        int((i + 1) * step))]
+                 for i in range(width))]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_BLOCKS[int((len(_BLOCKS) - 1) * (v - lo) / span)]
+                   for v in vals)
+
+
+def fetch_remote(pprof_addr: str, since_w: int = 0,
+                 timeout: float = 5.0) -> dict | None:
+    """The node's history export document, or None when unreachable."""
+    url = f"{_pprof_base(pprof_addr)}/debug/pprof/history"
+    if since_w:
+        url += f"?since={since_w / 1e9:.3f}"
+    try:
+        return json.loads(_get_text(url, timeout))
+    except Exception as e:  # noqa: BLE001 — node down is a report, not a crash
+        print(f"cannot reach {pprof_addr}: {e}", file=sys.stderr)
+        return None
+
+
+def load_records(*, home: str = "", pprof_addr: str = "",
+                 since_w: int = 0, timeout: float = 5.0):
+    """`(records, node, enabled)` from disk (`home`) or over HTTP.
+    records is None only when the remote node is unreachable."""
+    if home:
+        recs = _history.read_dir(os.path.join(home, "history"))
+        if since_w:
+            recs = [(w, s) for w, s in recs if w >= since_w]
+        return recs, os.path.basename(os.path.abspath(home)), True
+    doc = fetch_remote(pprof_addr, since_w=since_w, timeout=timeout)
+    if doc is None:
+        return None, "", True
+    recs = _history.decode_lines(doc.get("lines") or [])
+    return recs, str(doc.get("node") or "node"), bool(doc.get("enabled"))
+
+
+def render(records, node: str, *, metric: str = "", rate: bool = False,
+           quantiles: bool = False, list_only: bool = False,
+           width: int = 60) -> str:
+    span_s = (records[-1][0] - records[0][0]) / 1e9 if len(records) > 1 else 0.0
+    lines = [f"history — {node}  points {len(records)}"
+             f"  span {span_s:.0f}s"
+             f"  series {len(records[-1][1]) if records else 0}"]
+    names = _history.metric_names_of(records)
+    if list_only or not metric:
+        for name in names:
+            pts = _history.points_for(records, name)
+            last = pts[-1][1] if pts else 0.0
+            lines.append(f"  {name:<44} points {len(pts):>5}  last {last:g}")
+        return "\n".join(lines) + "\n"
+    if quantiles:
+        qpts = _history.quantile_points(records, metric)
+        if not qpts:
+            lines.append(f"  {metric}: no histogram samples in range")
+            return "\n".join(lines) + "\n"
+        for key in sorted(qpts[0][1]):
+            vals = [cell.get(key) for _, cell in qpts]
+            vals = [v for v in vals if v is not None]
+            if not vals:
+                continue
+            lines.append(f"  {metric} {key:<8} {sparkline(vals, width)}"
+                         f"  min {min(vals):g} max {max(vals):g}"
+                         f" last {vals[-1]:g}")
+        return "\n".join(lines) + "\n"
+    pts = _history.points_for(records, metric)
+    if rate:
+        pts = _history.rate_points(pts)
+        unit = "/s"
+    else:
+        unit = ""
+    if not pts:
+        lines.append(f"  {metric}: no points in range")
+        return "\n".join(lines) + "\n"
+    vals = [v for _, v in pts]
+    lines.append(f"  {metric}{unit}  {sparkline(vals, width)}")
+    lines.append(f"  min {min(vals):g}  max {max(vals):g}"
+                 f"  last {vals[-1]:g}  ({len(vals)} points)")
+    return "\n".join(lines) + "\n"
+
+
+def run_history(pprof_addr: str = "", *, home: str = "", metric: str = "",
+                since: float = 0.0, rate: bool = False,
+                quantiles: bool = False, list_metrics: bool = False,
+                as_json: bool = False, width: int = 60,
+                timeout: float = 5.0) -> int:
+    if not home and not pprof_addr:
+        print("history: need --home or --pprof-laddr", file=sys.stderr)
+        return 2
+    if (rate or quantiles) and not metric:
+        print("history: --rate/--quantiles need --metric", file=sys.stderr)
+        return 2
+    since_w = int((time.time() - since) * 1e9) if since > 0 else 0
+    records, node, enabled = load_records(
+        home=home, pprof_addr=pprof_addr, since_w=since_w, timeout=timeout)
+    if records is None:
+        sys.stdout.write("no history (node unreachable?)\n")
+        return 3
+    if not enabled:
+        sys.stdout.write("history recorder disabled (TM_TPU_HISTORY=0)\n")
+        return 3
+    if as_json:
+        doc = {
+            "node": node,
+            "points": len(records),
+            "first_w": records[0][0] if records else None,
+            "last_w": records[-1][0] if records else None,
+            "metrics": _history.metric_names_of(records),
+        }
+        if metric:
+            doc["metric"] = metric
+            doc["series"] = _history.points_for(records, metric)
+            doc["rate"] = _history.rate_points(doc["series"])
+            if quantiles:
+                doc["quantiles"] = _history.quantile_points(records, metric)
+        sys.stdout.write(json.dumps(doc) + "\n")
+    else:
+        sys.stdout.write(render(records, node, metric=metric, rate=rate,
+                                quantiles=quantiles,
+                                list_only=list_metrics, width=width))
+    sys.stdout.flush()
+    if not records:
+        return 1
+    if metric and not _history.points_for(records, metric):
+        return 1
+    return 0

@@ -960,6 +960,22 @@ def cmd_prof(args) -> int:
                     top_n=args.top)
 
 
+def cmd_history(args) -> int:
+    """One node's recorded metric time-series (cli/history.py): per-
+    metric terminal sparklines, counter rates, quantiles-over-time —
+    from `<home>/history/` segments on disk or a live node's
+    `/debug/pprof/history`.  Exit 0 data / 1 empty range / 2 usage /
+    3 unreachable or recorder disabled (docs/observability.md
+    "Metric history")."""
+    from tendermint_tpu.cli.history import run_history
+
+    return run_history(args.pprof_laddr, home=args.home_dir,
+                       metric=args.metric, since=args.since,
+                       rate=args.rate, quantiles=args.quantiles,
+                       list_metrics=args.list, as_json=args.json,
+                       width=args.width, timeout=args.timeout)
+
+
 def cmd_lint(args) -> int:
     """Repo-aware static analysis (tendermint_tpu/lint): six rules, each
     grounded in a shipped bug or a hot-path invariant.  Exit 0 = clean,
@@ -1337,6 +1353,44 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-request HTTP timeout (a --seconds capture "
                          "extends it)")
     sp.set_defaults(fn=cmd_prof)
+
+    sp = sub.add_parser(
+        "history",
+        help="recorded metric time-series from the node's embedded "
+             "flight-data recorder: sparklines, counter rates, "
+             "quantiles-over-time (exit 0 data / 1 empty range / "
+             "2 usage / 3 unreachable or disabled)")
+    sp.add_argument("--pprof-laddr", dest="pprof_laddr",
+                    default="http://127.0.0.1:6060",
+                    help="the node's pprof listener serving "
+                         "/debug/pprof/history")
+    sp.add_argument("--home-dir", dest="home_dir", default="",
+                    help="read <home>/history/ segments straight from "
+                         "disk instead of over HTTP (works on a "
+                         "stopped node)")
+    sp.add_argument("--metric", default="",
+                    help="base metric name to plot (default: list "
+                         "recorded metrics)")
+    sp.add_argument("--since", type=float, default=0.0,
+                    help="restrict to the last N seconds (default: "
+                         "the whole recorded range)")
+    sp.add_argument("--rate", action="store_true",
+                    help="plot the per-second rate of a counter "
+                         "instead of its level")
+    sp.add_argument("--quantiles", action="store_true",
+                    help="plot p50/p95-over-time re-read from the "
+                         "metric's recorded histogram buckets")
+    sp.add_argument("--list", action="store_true",
+                    help="print recorded metric names with point "
+                         "counts and exit")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the decoded range (and selected "
+                         "series) as JSON")
+    sp.add_argument("--width", type=int, default=60,
+                    help="sparkline width in cells (default 60)")
+    sp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-request HTTP timeout")
+    sp.set_defaults(fn=cmd_history)
 
     sp = sub.add_parser(
         "warm",

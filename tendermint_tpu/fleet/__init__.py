@@ -22,19 +22,28 @@ over its SimNodes).  docs/fleet.md has the schema and worked examples.
 """
 
 from .aggregate import aggregate
-from .scrape import NodeTarget, parse_target, scrape_fleet, scrape_node
+from .scrape import (
+    NodeTarget,
+    fetch_fleet_history,
+    fetch_history,
+    parse_target,
+    scrape_fleet,
+    scrape_node,
+)
 from .slo import (
     BurnEngine,
     Objective,
     default_objectives,
     evaluate,
+    evaluate_history,
     load_slo,
     objectives_from_doc,
 )
 
 __all__ = [
     "NodeTarget", "parse_target", "scrape_node", "scrape_fleet",
+    "fetch_history", "fetch_fleet_history",
     "aggregate",
     "Objective", "BurnEngine", "load_slo", "objectives_from_doc",
-    "default_objectives", "evaluate",
+    "default_objectives", "evaluate", "evaluate_history",
 ]

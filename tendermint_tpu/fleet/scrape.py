@@ -29,26 +29,32 @@ from tendermint_tpu.utils import promparse
 @dataclass(frozen=True)
 class NodeTarget:
     """One node's scrape endpoints (normalized http bases).  An empty
-    `metrics` skips the exposition scrape for this node (RPC-only row)."""
+    `metrics` skips the exposition scrape for this node (RPC-only
+    row); an empty `pprof` skips history backfill (the diagnostics
+    listener serving /debug/pprof/history)."""
 
     name: str
     rpc: str
     metrics: str = ""
+    pprof: str = ""
 
 
 def parse_target(spec: str, index: int = 0) -> NodeTarget:
-    """`[name=]rpc_addr[,metrics_addr]` → NodeTarget.  The default name
-    is node<index> (testnet layout order)."""
+    """`[name=]rpc_addr[,metrics_addr[,pprof_addr]]` → NodeTarget.  The
+    default name is node<index> (testnet layout order)."""
     name, sep, rest = spec.partition("=")
     if not sep:
         name, rest = f"node{index}", spec
-    rpc, _, metrics = rest.partition(",")
+    rpc, _, rest = rest.partition(",")
+    metrics, _, pprof = rest.partition(",")
     if not rpc:
         raise ValueError(f"target {spec!r}: empty rpc address")
     return NodeTarget(name=name.strip(),
                       rpc=promparse.http_base(rpc.strip()),
                       metrics=promparse.http_base(metrics.strip())
-                      if metrics.strip() else "")
+                      if metrics.strip() else "",
+                      pprof=promparse.http_base(pprof.strip())
+                      if pprof.strip() else "")
 
 
 def scrape_node(target: NodeTarget, timeout: float = 2.0) -> dict:
@@ -98,6 +104,49 @@ def scrape_node(target: NodeTarget, timeout: float = 2.0) -> dict:
         "samples": samples,
         "errors": errors,
     }
+
+
+def fetch_history(target: NodeTarget, since_s: float = 0.0,
+                  timeout: float = 5.0) -> list:
+    """Pull one node's recorded history range over its diagnostics
+    listener (`GET /debug/pprof/history?since=`) and decode the codec
+    lines back into `[(wall_ns, state)]` records — the backfill path
+    that refills the SLO engine's windows after a scraper restart.
+    An unreachable or history-disabled node yields [] (no data, never
+    a crash)."""
+    if not target.pprof:
+        return []
+    from tendermint_tpu.utils import history as _histmod
+
+    url = f"{target.pprof}/debug/pprof/history"
+    if since_s:
+        # full precision: %g would round an epoch-seconds cutoff by
+        # thousands of seconds (6 significant digits)
+        url += f"?since={since_s:.3f}"
+    try:
+        import json
+
+        doc = json.loads(promparse.get_text(url, timeout))
+    except Exception:  # noqa: BLE001 — degraded to no data
+        return []
+    if not doc.get("enabled"):
+        return []
+    return _histmod.decode_lines(doc.get("lines") or [])
+
+
+def fetch_fleet_history(targets: list[NodeTarget], since_s: float = 0.0,
+                        timeout: float = 5.0, workers: int = 8) -> dict:
+    """`{node name: records}` for every target with a pprof base, the
+    `evaluate_history` input — fetched concurrently like the scrapes."""
+    with_pprof = [t for t in targets if t.pprof]
+    if not with_pprof:
+        return {}
+    with ThreadPoolExecutor(max_workers=min(workers, len(with_pprof)),
+                            thread_name_prefix="fleet-history") as pool:
+        recs = list(pool.map(
+            lambda t: fetch_history(t, since_s=since_s, timeout=timeout),
+            with_pprof))
+    return {t.name: r for t, r in zip(with_pprof, recs)}
 
 
 def scrape_fleet(targets: list[NodeTarget], timeout: float = 2.0,

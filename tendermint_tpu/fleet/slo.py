@@ -262,6 +262,105 @@ def evaluate(objectives: list[Objective], snapshot: dict,
 
 
 # ---------------------------------------------------------------------------
+# retrospective evaluation over recorded history
+# ---------------------------------------------------------------------------
+
+#: replayed-bin bound: 4096 bins at the default 10 s cadence is over
+#: 11 hours — past any window the engine evaluates
+MAX_HISTORY_BINS = 4096
+
+#: a node with no record within `staleness x its median sample gap` of
+#: a bin is down for that bin — the same "stopped reporting = stopped
+#: serving" rule the live sampler applies to stalled heights
+STALENESS_FACTOR = 2.5
+
+
+def evaluate_history(objectives: list[Objective], histories: dict,
+                     engine: BurnEngine | None = None,
+                     staleness_factor: float = STALENESS_FACTOR,
+                     max_bins: int = MAX_HISTORY_BINS) -> dict:
+    """Replay recorded metric history through the TRUE dual-window
+    engine: the retrospective path that gives `fleet --once` and CI
+    gates real burn verdicts instead of collapsed ones.
+
+    `histories` maps node name -> `[(wall_ns, state)]` records from
+    `utils/history` (a local recorder's `records()` or a remote
+    fetch).  Every recorded instant becomes one evaluation bin: each
+    node's latest state within its staleness horizon is rendered back
+    into a scrape-shaped row (exposition samples + folded snapshot —
+    the exact food `aggregate()` eats live), a node with no fresh
+    record reads as down, and `evaluate()` feeds the engine at the
+    bin's recorded time.  The returned dict is the LAST bin's verdict
+    — the burn state at the end of the recorded range, with the whole
+    range in its windows — tagged `source: "history"`.
+
+    Deterministic by construction: same records -> same verdict (the
+    simnet verdict block asserts exactly that across same-seed runs).
+    Empty histories produce the no-data verdict, so a gate with
+    history off skips rather than fails."""
+    from tendermint_tpu.fleet.aggregate import aggregate
+    from tendermint_tpu.utils import history as _histmod
+    from tendermint_tpu.utils import promparse
+
+    engine = engine if engine is not None else BurnEngine()
+    names = sorted(histories)
+    series = {n: sorted(histories[n] or []) for n in names}
+    times = sorted({w for recs in series.values() for w, _s in recs})
+    if max_bins and len(times) > max_bins:
+        times = times[-max_bins:]
+    if not times:
+        out = evaluate(objectives, {}, engine=engine, now=0.0)
+        out.update({"source": "history", "points": 0, "span_s": 0.0,
+                    "nodes": names})
+        return out
+    horizon = {}
+    for n in names:
+        recs = series[n]
+        gaps = sorted((recs[i + 1][0] - recs[i][0]) / 1e9
+                      for i in range(len(recs) - 1))
+        med = gaps[len(gaps) // 2] if gaps else 1.0
+        horizon[n] = max(0.05, staleness_factor * med)
+    cursors = {n: 0 for n in names}
+    latest: dict = {n: None for n in names}
+    result: dict = {}
+    for w in times:
+        t = w / 1e9
+        rows = []
+        for n in names:
+            recs = series[n]
+            i = cursors[n]
+            while i < len(recs) and recs[i][0] <= w:
+                latest[n] = recs[i]
+                i += 1
+            cursors[n] = i
+            got = latest[n]
+            if got is None or t - got[0] / 1e9 > horizon[n]:
+                rows.append({"name": n, "ok": False, "rpc_ok": False,
+                             "scrape_ms": None, "snap": {}, "samples": [],
+                             "errors": []})
+                continue
+            state = got[1]
+            samples = promparse.parse_exposition(
+                _histmod.render_state(state))
+            snap = promparse.empty_snapshot()
+            promparse.fold_metrics(snap, promparse.index_samples(samples))
+            serving = state.get("tendermint_node_serving")
+            rows.append({"name": n, "ok": True,
+                         "rpc_ok": (bool(serving) if serving is not None
+                                    else True),
+                         "scrape_ms": None, "snap": snap,
+                         "samples": samples, "errors": []})
+        result = evaluate(objectives, aggregate(rows), engine=engine, now=t)
+    result.update({
+        "source": "history",
+        "points": len(times),
+        "span_s": round((times[-1] - times[0]) / 1e9, 3),
+        "nodes": names,
+    })
+    return result
+
+
+# ---------------------------------------------------------------------------
 # loading
 # ---------------------------------------------------------------------------
 

@@ -68,7 +68,8 @@ RULES: dict[str, str] = {
         "observability sink whose disabled-path contract is one caller "
         "branch (STATS.record_flush, journal.log, lifecycle.stamp, "
         "health.sample/record, remediate.act/record, prof.sample/"
-        "capture) called without an `.enabled` guard",
+        "capture, history.sample/record) called without an `.enabled` "
+        "guard",
     "host-sync-in-jit":
         "host synchronization (.item/.tolist/np.asarray/jax.device_get/"
         ".block_until_ready) inside a jit-compiled function body",
@@ -101,7 +102,7 @@ JAX_ALLOWED_DIRS = {"ops", "parallel"}
 #: files define sinks, the mempool cache is a plain call site).
 OBSERVABILITY_DEF_FILES = {"devmon.py", "eventlog.py", "trace.py",
                            "txlife.py", "health.py", "remediate.py",
-                           "profiler.py",
+                           "profiler.py", "history.py",
                            "gateway/coalescer.py", "gateway/cache.py",
                            "gateway/service.py",
                            "fleet/slo.py", "fleet/aggregate.py",
@@ -582,6 +583,13 @@ class _Walker:
                     self._report(
                         node, "ungated-observability",
                         f"prof.{func.attr}() without an "
+                        "`if ...enabled:` guard — the disabled path "
+                        "must cost one branch")
+                elif recv_name.endswith(("history", "HISTORY")) \
+                        and func.attr in ("sample", "record"):
+                    self._report(
+                        node, "ungated-observability",
+                        f"history.{func.attr}() without an "
                         "`if ...enabled:` guard — the disabled path "
                         "must cost one branch")
 

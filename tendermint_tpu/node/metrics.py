@@ -384,6 +384,25 @@ class NodeMetrics:
             fn=lambda: node.prof.overhead_samples(),
         ))
 
+        # -- metric history (utils/history.py) --------------------------
+        # the flight-data recorder's self-accounting, read from the
+        # node's recorder at scrape time; empty (TYPE lines only) when
+        # disabled (TM_TPU_HISTORY=0 → the NOP singleton).
+        self.history_samples = reg.register(LabeledCallbackGauge(
+            "history_samples_total",
+            "Metric-history samples recorded since start "
+            "(one per TM_TPU_HISTORY_INTERVAL_S scrape of the registry)",
+            namespace=ns, kind="counter",
+            fn=lambda: node.history.sample_counts(),
+        ))
+        self.history_bytes = reg.register(LabeledCallbackGauge(
+            "history_bytes_total",
+            "Bytes appended to on-disk history segments — the "
+            "recorder's own footprint, so retention math is observable",
+            namespace=ns, kind="counter",
+            fn=lambda: node.history.byte_counts(),
+        ))
+
         # -- remediation controller (utils/remediate.py) ----------------
         # actions executed per (action, triggering detector), and the
         # currently-active state per action (shed = admission level,

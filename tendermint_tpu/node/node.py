@@ -440,6 +440,26 @@ class Node:
         if self.health.enabled and self.prof.enabled:
             self.health.prof = self.prof
 
+        # -- metric history (TM_TPU_HISTORY, default on;
+        # utils/history.py): samples this node's own metrics registry
+        # on a cadence into delta-compressed segments under
+        # <home>/history/ — serves /debug/pprof/history and the
+        # `tendermint-tpu history` CLI, backfills the fleet SLO
+        # engine's burn windows, rides the flight-recorder bundle
+        # (history.jsonl) and feeds the metric_drift detector.  No
+        # registry (prometheus off) = nothing to record.
+        from tendermint_tpu.utils import history as _history
+
+        self.history = _history.from_env(
+            node=config.base.moniker or self.node_key.node_id[:8],
+            root=config.home,
+            source=(self.metrics.registry.expose
+                    if self.metrics is not None else None),
+        )
+        if self.health.enabled and self.history.enabled:
+            self.health.history = self.history
+            self.health.probes["history"] = self.history.drift_probe
+
         # -- RPC --------------------------------------------------------
         from tendermint_tpu.rpc.core import Environment
         from tendermint_tpu.rpc.server import RPCServer
@@ -599,7 +619,8 @@ class Node:
 
             self.pprof_server = PprofServer(logger=self.logger,
                                             health=self.health,
-                                            prof=self.prof)
+                                            prof=self.prof,
+                                            history=self.history)
             host, port = _parse_laddr(self.config.rpc.pprof_laddr, default_port=6060)
             self.pprof_addr = await self.pprof_server.start(host, port)
         if isinstance(self.transport, TCPTransport):
@@ -650,6 +671,8 @@ class Node:
             self.health.start()
         if self.prof.enabled:
             self.prof.start()
+        if self.history.enabled:
+            self.history.start()
 
         if self.config.base.fast_sync:
             await self.blocksync_reactor.start(sync=True)
@@ -765,6 +788,8 @@ class Node:
             self.health.stop()
         if self.prof.enabled:
             self.prof.stop()
+        if self.history.enabled:
+            self.history.stop()
         if self._dialer_task is not None:
             self._dialer_task.cancel()
             try:

@@ -27,6 +27,12 @@ The Python equivalents of goroutine/heap profiles:
                                as Perfetto-loadable trace-event JSON;
                                without ?seconds the continuous ring is
                                returned immediately
+    GET /debug/pprof/history   recorded metric history (utils.history)
+                               as JSON: ?metric=NAME returns one
+                               series' points + rates, without it the
+                               delta-codec lines for the whole range
+                               (the fleet scraper's backfill food);
+                               ?since=UNIX_SECONDS bounds the range
 
 Plain text responses, stdlib only.
 """
@@ -104,7 +110,7 @@ class PprofServer:
     the RPC server: must answer when the RPC stack is wedged)."""
 
     def __init__(self, logger: Logger | None = None, health=None,
-                 prof=None):
+                 prof=None, history=None):
         from tendermint_tpu.utils.httpserv import TextHTTPServer
 
         self.logger = logger or nop_logger()
@@ -122,6 +128,13 @@ class PprofServer:
 
             prof = _profiler.NOP
         self.prof = prof
+        # the node's HistoryRecorder (utils/history.py); defaults to
+        # the NOP singleton so /debug/pprof/history always answers
+        if history is None:
+            from tendermint_tpu.utils import history as _history
+
+            history = _history.NOP
+        self.history = history
         self._http = TextHTTPServer(self._route)
 
     async def start(self, host: str, port: int) -> tuple[str, int]:
@@ -183,6 +196,20 @@ class PprofServer:
                                                header=header)
             else:
                 body = self.prof.folded_recent()
+        elif route.startswith("/debug/pprof/history"):
+            q = urllib.parse.parse_qs(parsed.query)
+            metric = q.get("metric", [""])[0]
+            raw = q.get("since", [""])[0]
+            try:
+                since_w = int(float(raw) * 1e9) if raw else 0
+            except ValueError:
+                return 400, "text/plain", b"bad since\n"
+            # reading the range decodes on-disk segments: off the loop
+            doc = await asyncio.to_thread(self.history.export, metric,
+                                          since_w)
+            import json as _json
+
+            return 200, "application/json", _json.dumps(doc).encode()
         elif route.startswith("/debug/pprof/device"):
             # device-layer accounting (utils/devmon): compile events,
             # batch occupancy/padding, device memory.  Never initializes
@@ -196,6 +223,7 @@ class PprofServer:
                     "/debug/pprof/heap\n"
                     "/debug/pprof/trace[?fmt=chrome]\n"
                     "/debug/pprof/profile[?seconds=N&fmt=chrome]\n"
+                    "/debug/pprof/history[?metric=NAME&since=UNIX_S]\n"
                     "/debug/pprof/device\n/debug/pprof/health\n")
         else:
             return None

@@ -62,6 +62,7 @@ from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 from tendermint_tpu.utils import clock as clockmod
 from tendermint_tpu.utils import fail
 from tendermint_tpu.utils import health as tmhealth
+from tendermint_tpu.utils import history as tmhistory
 from tendermint_tpu.utils import profiler as tmprof
 from tendermint_tpu.utils import remediate as tmremediate
 from tendermint_tpu.utils.log import Logger, nop_logger
@@ -265,6 +266,23 @@ class SimNode:
                                     clock=self.clock.monotonic)
         if self.health.enabled and self.prof.enabled:
             self.health.prof = self.prof
+        # flight-data history (TM_TPU_HISTORY, default on): memory-mode
+        # recorder (no root — simnet homes are throwaway; the in-memory
+        # tail covers drift detection and the verdict's retrospective
+        # SLO replay) over a synthetic exposition of this node's core
+        # series.  Wall stamps ride the clock seam, so virtual runs
+        # record at deterministic virtual instants and the verdict's
+        # history block is byte-identical across same-seed runs.  Test-
+        # scale cadence matches the health monitor's.
+        self.history = tmhistory.from_env(
+            node=self.name,
+            source=self._expose_history,
+            clock=self.clock.monotonic,
+            interval_s=0.25,
+        )
+        if self.health.enabled and self.history.enabled:
+            self.health.history = self.history
+            self.health.probes["history"] = self.history.drift_probe
         self.reactor = ConsensusReactor(
             self.cs, self.router, self.block_store,
             gossip_sleep_ms=gossip_sleep_ms, maj23_sleep_ms=500,
@@ -294,6 +312,17 @@ class SimNode:
             self.evpool, self.router,
             gossip_sleep_ms=max(50, 5 * gossip_sleep_ms))
 
+    def _expose_history(self) -> str:
+        """Synthetic exposition for the history recorder: the node's
+        own core series in the live `/metrics` shape, so recorded
+        states replay through the same promparse path as real scrapes
+        (commits doubles as height — a monotone counter the drift
+        probe can rate)."""
+        h = self.block_store.height()
+        return (f"tendermint_consensus_height {h}\n"
+                f"tendermint_p2p_peers {len(self.router.peers)}\n"
+                f"tendermint_sim_commits_total {h}\n")
+
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
         # bind every task this node creates to its fail-point scope
@@ -319,9 +348,15 @@ class SimNode:
             # virtual timeline would attribute everything to the
             # scheduler)
             self.prof.start()
+        if self.history.enabled and not self.clock.virtual:
+            # virtual mode: the runner's _history_ticker drives
+            # sample() at virtual instants instead of a wall thread
+            self.history.start()
 
     async def stop(self) -> None:
         """Clean shutdown (end of run)."""
+        if self.history.enabled:
+            self.history.stop()
         if self.prof.enabled:
             self.prof.stop()
         if self.health.enabled:
@@ -337,6 +372,8 @@ class SimNode:
         clean-shutdown work beyond releasing file handles (their content
         is already on disk — the WAL flushes per write)."""
         self.crashed = True
+        if self.history.enabled:
+            self.history.stop(timeout=0.2)
         if self.prof.enabled:
             self.prof.stop()
         if self.health.enabled:
@@ -551,6 +588,7 @@ class SimnetRunner:
             self._aux.append(loop.create_task(self._fleet_sampler()))
         if self.virtual:
             self._aux.append(loop.create_task(self._health_ticker()))
+            self._aux.append(loop.create_task(self._history_ticker()))
 
         try:
             await asyncio.wait_for(
@@ -619,10 +657,16 @@ class SimnetRunner:
                         else {"enabled": False})
             for node in self.nodes
         }
+        history_reports = {
+            node.name: (node.history.report() if node.history.enabled
+                        else {"enabled": False})
+            for node in self.nodes
+        }
 
         fleet_block = None
         if self._slo_objectives:
             from tendermint_tpu.fleet.slo import evaluate as slo_evaluate
+            from tendermint_tpu.fleet.slo import evaluate_history
 
             snap = self._fleet_snapshot(report)
             fleet_block = {
@@ -630,6 +674,15 @@ class SimnetRunner:
                 "slo": slo_evaluate(self._slo_objectives, snap,
                                     engine=self._slo_engine),
             }
+            # retrospective twin: replay each node's RECORDED series
+            # through a fresh dual-window engine.  With history on this
+            # must agree with the live verdict above (the verdict block
+            # asserts it); with history off it degrades to no-data and
+            # the gate skips rather than fails.
+            histories = {node.name: node.history.records()
+                         for node in self.nodes if node.history.enabled}
+            fleet_block["slo_history"] = evaluate_history(
+                self._slo_objectives, histories)
 
         run_info = {
             "t_start_ns": t_start_ns,
@@ -637,6 +690,7 @@ class SimnetRunner:
             "health": health_reports,
             "remediation": remediation_reports,
             "profile": profile_reports,
+            "history": history_reports,
             "duration_s": duration_s,
             "timed_out": timed_out,
             "timeout_commit_ms": self._ccfg.timeout_commit_ms,
@@ -821,6 +875,30 @@ class SimnetRunner:
                     self.logger.warning("health tick failed",
                                         node=node.name, err=repr(e))
 
+    async def _history_ticker(self) -> None:
+        """The history recorders' virtual-time drive, same contract as
+        _health_ticker: sample every live node's recorder from inside
+        the event loop at deterministic virtual instants, so recorded
+        wall stamps (and everything derived from them — drift probes,
+        the verdict's retrospective SLO replay) are byte-identical
+        across same-seed runs."""
+        interval = min((n.history.interval_s for n in self.nodes
+                        if n is not None and n.history.enabled),
+                       default=0.25)
+        while True:
+            await asyncio.sleep(interval)
+            for node in self.nodes:
+                if node is None or node.crashed or not node.history.enabled:
+                    continue
+                try:
+                    # guarded by the compound continue above (enabled
+                    # checked there); the analyzer only models the
+                    # single-condition guard shape
+                    node.history.sample()  # tmlint: disable=ungated-observability
+                except Exception as e:  # noqa: BLE001 — recorder survives
+                    self.logger.warning("history tick failed",
+                                        node=node.name, err=repr(e))
+
     # -- fleet SLO sampling ----------------------------------------------
     def _round_ms(self) -> int:
         return (self._ccfg.timeout_propose_ms + self._ccfg.timeout_prevote_ms
@@ -861,8 +939,15 @@ class SimnetRunner:
                 if h != last_height.get(node.index):
                     last_height[node.index] = h
                     last_advance[node.index] = now
-                if now - last_advance.get(node.index, now) <= horizon:
+                ok = now - last_advance.get(node.index, now) <= horizon
+                if ok:
                     serving += 1
+                if node.history.enabled:
+                    # the serving bit rides the node's own history as a
+                    # sticky gauge, so the retrospective SLO replay
+                    # (fleet.evaluate_history) reads availability from
+                    # the record exactly as the live scraper reads RPC
+                    node.history.record("serving", 1.0 if ok else 0.0)
             ratio = serving / len(self.nodes) if self.nodes else 0.0
             self._avail_ticks.append(ratio)
             for obj in avail_objs:

@@ -67,7 +67,7 @@ REMEDIATION_ACTIONS = ("shed", "rewarm", "retune", "evict", "pardon")
 # (utils/health default_detectors; literal for the same reason)
 HEALTH_DETECTORS = ("height_stall", "round_thrash",
                     "verify_queue_saturation", "compile_storm",
-                    "memory_growth", "peer_flap")
+                    "memory_growth", "peer_flap", "metric_drift")
 
 TIME_MODES = ("wall", "virtual")
 

@@ -46,6 +46,20 @@ Invariants (each names itself in `violations` on failure):
                block load-bearing).  The runner's sampler feeds the
                burn engine with per-tick serving ratios and the report
                carries the full `fleet` block either way.
+  slo_history  the retrospective twin of `slo` (utils/history.py +
+               fleet.evaluate_history): each SimNode's RECORDED metric
+               series — including the sampler's own per-node serving
+               bit — replays through a fresh dual-window engine, and
+               the replayed verdict must AGREE with the live one at
+               the page boundary (neither engine may read burning
+               while the other reads fully ok — warn is the tolerated
+               one-bin-apart middle, since the recorder's cadence and
+               the runner's tick sample the same run differently), and
+               an `expect_slo` of "violated" must hold retrospectively
+               too ("ok" tolerates a retro warn but never burning).
+               With history off (TM_TPU_HISTORY=0) the replay is
+               no-data and every slo_history check skips — the gate
+               degrades to a pass, never a false alarm.
 
 Beyond the invariants, the report carries the BENCH metrics (accepted
 tx/s, heights/min, rounds>0 streaks, recovery-after-heal) and — from the
@@ -356,6 +370,32 @@ def _profile_block(run_info: dict) -> dict:
     return {"per_node": per_node, "hottest_function": hottest}
 
 
+def _history_block(run_info: dict) -> dict:
+    """Per-node flight-data recorder summary (utils/history.py
+    reports — deterministic by construction, so the whole block is
+    byte-identical across same-seed virtual runs): recorded point /
+    series counts plus any metric-drift probe result, and the run's
+    worst drift z anywhere on the net."""
+    per_node: dict[str, dict] = {}
+    worst_drift = None
+    for name, rep in sorted((run_info.get("history") or {}).items()):
+        if not rep.get("enabled"):
+            per_node[name] = {"enabled": False}
+            continue
+        per_node[name] = {
+            "enabled": True,
+            "points": rep.get("points", 0),
+            "samples": rep.get("samples", 0),
+            "series": rep.get("series", 0),
+        }
+        drift = rep.get("drift")
+        if drift:
+            per_node[name]["drift"] = drift
+            if worst_drift is None or drift.get("z", 0) > worst_drift["z"]:
+                worst_drift = {"node": name, **drift}
+    return {"per_node": per_node, "worst_drift": worst_drift}
+
+
 def evaluate(scenario: Scenario, report: TimelineReport,
              run_info: dict) -> dict:
     violations: list[dict] = []
@@ -477,6 +517,41 @@ def evaluate(scenario: Scenario, report: TimelineReport,
                           "never dented the fleet objective",
             })
 
+    # -- retrospective SLO over recorded history -------------------------
+    # no-data (history off or nothing recorded) skips every check: the
+    # retrospective gate degrades to a pass, never a false alarm
+    retro = (fleet or {}).get("slo_history") or {}
+    if retro.get("points"):
+        # the two engines sample the same run on different cadences
+        # (the recorder's fixed interval vs the runner's tick), so a
+        # borderline verdict can legitimately land one warn-bin apart;
+        # the agreement contract is the PAGE boundary — neither side
+        # may read burning while the other reads fully ok
+        states = (retro["state"], fleet["slo"]["state"])
+        if "burning" in states and "ok" in states:
+            violations.append({
+                "invariant": "slo_history",
+                "detail": (f"retrospective replay of {retro['points']} "
+                           f"recorded points ended {retro['state']} but "
+                           f"the live engine ended "
+                           f"{fleet['slo']['state']} — history-derived "
+                           "series disagree with the fleet sampler"),
+            })
+        if scenario.expect_slo == "violated" and retro["ok"]:
+            violations.append({
+                "invariant": "slo_history",
+                "detail": "scenario expects an SLO violation but the "
+                          "retrospective replay of recorded history "
+                          "shows every objective ok",
+            })
+        elif scenario.expect_slo == "ok" and retro["state"] == "burning":
+            violations.append({
+                "invariant": "slo_history",
+                "detail": (f"retrospective replay ended "
+                           f"{retro['state']} where the scenario "
+                           "expects ok"),
+            })
+
     health = _health_block(run_info)
     _check_health(scenario, health, violations)
     diagnosis = None
@@ -494,6 +569,7 @@ def evaluate(scenario: Scenario, report: TimelineReport,
         "health": health,
         "remediation": remediation,
         "profile": _profile_block(run_info),
+        "history": _history_block(run_info),
         "fleet": fleet,
         "scenario": {
             "name": scenario.name,

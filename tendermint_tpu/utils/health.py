@@ -16,9 +16,11 @@ silently lost its tail).  This module is the active layer:
     devmon compile counters;
   * a small set of explicit, individually-testable detectors —
     height-stall, round-thrash, verify-queue saturation, compile-storm
-    (the PR 7 zero-cold invariant as a live alarm), memory-growth and
-    peer-flap — each with escalate-immediately / clear-after-N
-    hysteresis so a single noisy sample cannot flap the alarm;
+    (the PR 7 zero-cold invariant as a live alarm), memory-growth,
+    peer-flap and metric-drift (current counter rates vs the node's own
+    recorded baseline, utils/history.py) — each with
+    escalate-immediately / clear-after-N hysteresis so a single noisy
+    sample cannot flap the alarm;
   * on each detector transition: a `tendermint_health_status{detector}`
     gauge step (0 ok / 1 warn / 2 critical),
     `tendermint_health_transitions_total{detector}`, a `health_*`
@@ -41,7 +43,7 @@ Cost contract (the PR 2 sink idiom, enforced by tmlint's
 and by bench's `health-overhead` stage): call sites guard with
 `if <health>.enabled:` so the disabled path costs one attribute load +
 branch against the module `NOP` singleton.  The enabled per-sample cost
-is dict merges plus six detector updates — budgeted at <=50us/sample,
+is dict merges plus seven detector updates — budgeted at <=50us/sample,
 at a default cadence of one sample per 2 s.
 
 Clocks: all detector logic runs on an injectable MONOTONIC clock
@@ -427,13 +429,50 @@ class PeerFlapDetector(Detector):
         return OK, ""
 
 
+class MetricDriftDetector(Detector):
+    """Counter-rate drift against the node's own recorded baseline
+    (utils/history.py): the recorder's `drift_probe` feeds the worst
+    series' robust z-score — current fixed-width rate window vs the
+    median of the trailing baseline windows, MAD-scaled.  Severity is
+    one-sided on purpose: only a DOWNWARD drift (a rate collapsing —
+    the commit counter stalling, verifies drying up) alarms, warning at
+    `warn_z` and escalating to critical at `crit_z`.  An UPWARD drift
+    never fires at all: a rate surging past its baseline is catch-up
+    after a healed fault or a legitimate load increase, and alarming on
+    it would punish exactly the runs that recovered."""
+
+    name = "metric_drift"
+
+    def __init__(self, warn_z: float = 4.0, crit_z: float = 8.0,
+                 clear_after: int = 2):
+        super().__init__(clear_after=clear_after)
+        self.warn_z = warn_z
+        self.crit_z = crit_z
+
+    def observe(self, sample: dict) -> tuple[int, str]:
+        d = sample.get("history_drift")
+        if not d:
+            return OK, ""
+        z = d.get("z", 0.0)
+        cur = d.get("current_per_s", 0.0)
+        base = d.get("baseline_per_s", 0.0)
+        if z < self.warn_z or cur >= base:
+            return OK, ""
+        detail = (f"{d.get('series', '?')} rate {cur:g}/s vs baseline "
+                  f"{base:g}/s over {d.get('windows', '?')} windows "
+                  f"(z={z:g})")
+        if z >= self.crit_z:
+            return CRITICAL, detail
+        return WARN, detail
+
+
 def default_detectors(expected_block_s: float = 1.0,
                       queue_high_water: int = 512,
                       compile_grace_s: float | None = None,
                       compile_window_s: float | None = None,
                       flap_window_s: float | None = None,
                       flap_min_span_s: float | None = None) -> list[Detector]:
-    """The six standard detectors.  The optional window overrides exist
+    """The seven standard detectors.  The optional window overrides exist
     for fast-cadence monitors (simnet's 0.25s sampling): the production
     compile-storm grace (180s) and peer-flap minimum span (30s) would
     otherwise mask any fault a test-scale run can inject."""
@@ -454,6 +493,7 @@ def default_detectors(expected_block_s: float = 1.0,
         CompileStormDetector(**storm_kw),
         MemoryGrowthDetector(),
         PeerFlapDetector(**flap_kw),
+        MetricDriftDetector(),
     ]
 
 
@@ -522,6 +562,12 @@ class FlightRecorder:
         prof = getattr(monitor, "prof", None)
         if prof is not None and prof.enabled:
             sources.append(("profile.folded", prof.folded_recent))
+        # metric-history window (utils/history.py): the last-N-minutes
+        # flight data next to the journal tail — the bundle finally
+        # carries the series, not just the events
+        history = getattr(monitor, "history", None)
+        if history is not None and history.enabled:
+            sources.append(("history.jsonl", history.window_text))
         return sources
 
     def _journal_tail(self) -> bytes | None:
@@ -650,6 +696,22 @@ class _NopProfSink:
 _NOP_PROF = _NopProfSink()
 
 
+class _NopHistorySink:
+    """Default history sink: disabled.  The node/SimNode assigns a
+    real `utils/history.HistoryRecorder` (defined there, not here, so
+    health carries no history imports); the flight recorder bundles
+    the recorded window when on, and the `metric_drift` detector's
+    probe is wired by the owner, not the monitor."""
+
+    enabled = False
+
+    def window_text(self, seconds: float = 900.0) -> str:
+        return ""
+
+
+_NOP_HISTORY = _NopHistorySink()
+
+
 class HealthMonitor:
     """One node's watchdog.  `enabled` is True so the one-branch guard
     at call sites passes; `NOP` is the disabled twin.
@@ -688,6 +750,10 @@ class HealthMonitor:
         # slo_burn records arm a rate-limited trigger capture, and the
         # flight recorder bundles the folded pre-critical ring
         self.prof = _NOP_PROF
+        # history sink (utils/history.py): the node assigns its
+        # HistoryRecorder after construction; the flight recorder
+        # embeds the last-N-minutes window next to the journal tail
+        self.history = _NOP_HISTORY
         self.fault_grace_s = fault_grace_s
         self._clock = clock
         self._lock = threading.Lock()
@@ -970,6 +1036,7 @@ class _NopMonitor:
     detectors: tuple = ()
     recorder = None
     prof = _NOP_PROF
+    history = _NOP_HISTORY
 
     def sample(self) -> dict:
         return {}

@@ -1,0 +1,738 @@
+"""Embedded on-node metric history (ISSUE 19): the flight-data layer
+under health bundles, the fleet SLO gate and incident forensics.
+
+Every observability surface before this one read the *present*:
+``/metrics`` is a point-in-time scrape and the dual-window burn engine
+collapses to an instantaneous verdict without a live watch loop.  The
+``HistoryRecorder`` fixes that the same way the WAL makes consensus
+replayable: it samples the node's own metrics ``Registry`` exposition
+on a cadence, keeps a bounded in-memory tail, and (when given a root)
+appends delta-compressed records to atomically-rotated segments under
+``<root>/history/`` so the series survive the process.
+
+Record codec (one JSON object per line, ``sort_keys`` so same state ->
+same bytes):
+
+  full   {"w": <wall ns>, "f": {"name{labels}": value, ...}}
+  delta  {"w": <wall ns>, "d": {changed...}, "x": [removed...]}
+
+Each segment opens with a full record and is therefore self-contained;
+``decode_lines`` stops at the first malformed line, so a torn tail
+(crash mid-append) yields the valid prefix and never poisons a reader
+— the PR 3 WAL-robustness idiom.  Segments seal via ``os.replace``
+(atomic on POSIX) from ``seg-<w>.jsonl.open`` to ``seg-<w>.jsonl``;
+retention is ``keep_segments`` sealed files.
+
+Query surface (all served from records, local or fetched):
+
+- ``records(since, until)`` — raw ``(w_ns, state)`` points,
+- ``series(metric)`` — one value per point, summed across labelsets,
+- ``rate(metric)`` — per-second deltas with counter-reset clamping,
+- ``quantiles(metric)`` — histogram quantiles over time, re-read from
+  recorded bucket series via the shared ``promparse`` machinery,
+- ``window_text(seconds)`` — the last-N-minutes window the flight
+  recorder embeds next to the journal tail (``history.jsonl``),
+- ``export(metric, since)`` — the ``/debug/pprof/history`` payload
+  (codec lines for backfill, points+rate for one metric),
+- ``drift_probe()`` — current-window counter rates vs the trailing
+  recorded baseline as a robust z-score, the ``metric_drift`` health
+  detector's input.
+
+Env-gated per the sink idiom (PR 2): ``TM_TPU_HISTORY`` (default ON)
+routes to ``NOP`` when off, so every call site costs one attribute
+load + branch; ``from_env()`` is the only place the environment is
+read.  The monotonic clock is injectable (``clock=``) and wall stamps
+flow through ``utils/clock.wall_ns()``, so simnet records in virtual
+time, byte-reproducibly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from collections import deque
+
+from tendermint_tpu.utils import clock as _clockmod
+from tendermint_tpu.utils import promparse
+
+_log = logging.getLogger(__name__)
+
+ENV_FLAG = "TM_TPU_HISTORY"
+
+DEFAULT_INTERVAL_S = 10.0
+#: records per segment — 360 x 10 s = one hour per segment by default
+DEFAULT_SEGMENT_POINTS = 360
+#: sealed segments kept — 24 x 1 h = a day of flight data
+DEFAULT_KEEP_SEGMENTS = 24
+#: labelset cap per record; past it new series fold into a drop counter
+DEFAULT_MAX_SERIES = 4096
+#: in-memory tail — 720 x 10 s = two hours, the drift/bundle horizon
+DEFAULT_TAIL_POINTS = 720
+
+#: drift probe shape: rate windows of this many points ...
+DRIFT_WINDOW_POINTS = 6
+#: ... and at least this many baseline windows behind the current one
+DRIFT_MIN_BASELINES = 3
+DRIFT_MAX_BASELINES = 12
+DRIFT_MAX_SERIES = 64
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def series_key(name: str, labels: dict) -> str:
+    """One exposition left-hand side per (name, sorted labels) — the
+    record's state key; ``render_state`` turns it straight back into a
+    line ``parse_exposition`` accepts."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def base_name(key: str) -> str:
+    return key.partition("{")[0]
+
+
+def render_state(state: dict) -> str:
+    """State dict -> exposition 0.0.4 text (inverse of the sampling
+    parse; feeds promparse for quantile/fold reads)."""
+    return "\n".join(f"{k} {state[k]:g}" for k in sorted(state)) + "\n"
+
+
+def encode_records(records) -> list:
+    """``[(w_ns, state)]`` -> codec lines: one full record, then
+    deltas.  ``sort_keys`` + compact separators keep the bytes a pure
+    function of the data."""
+    lines = []
+    prev = None
+    for w, state in records:
+        if prev is None:
+            doc = {"w": int(w), "f": {k: state[k] for k in sorted(state)}}
+        else:
+            changed = {k: v for k, v in sorted(state.items())
+                       if prev.get(k) != v}
+            removed = sorted(k for k in prev if k not in state)
+            doc = {"w": int(w), "d": changed}
+            if removed:
+                doc["x"] = removed
+        lines.append(json.dumps(doc, sort_keys=True, separators=(",", ":")))
+        prev = state
+    return lines
+
+
+def decode_lines(lines) -> list:
+    """Codec lines -> ``[(w_ns, state)]``.  Stops at the first
+    malformed or out-of-protocol line (torn tail after a crash, a
+    delta with no preceding full record) and returns the valid prefix
+    — never raises."""
+    out = []
+    cur: dict | None = None
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+            w = int(doc["w"])
+            if "f" in doc:
+                cur = {str(k): float(v) for k, v in doc["f"].items()}
+            elif "d" in doc:
+                if cur is None:
+                    break
+                cur = dict(cur)
+                for k, v in doc["d"].items():
+                    cur[str(k)] = float(v)
+                for k in doc.get("x", ()):
+                    cur.pop(str(k), None)
+            else:
+                break
+        except (ValueError, TypeError, KeyError):
+            break
+        out.append((w, cur))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# point math (shared by the recorder and the CLI's fetched-range path)
+# ---------------------------------------------------------------------------
+
+def _match(key: str, metric: str) -> bool:
+    return key == metric or key.startswith(metric + "{")
+
+
+def read_dir(dirpath: str) -> list:
+    """Decode every segment under a `<root>/history/` directory into
+    `[(w_ns, state)]` — the read-only path the CLI uses against a live
+    (or dead) node's home without constructing a recorder.  Torn tails
+    and unreadable files degrade to their valid prefix / absence."""
+    try:
+        names = sorted(
+            (fn for fn in os.listdir(dirpath)
+             if fn.startswith("seg-")
+             and (fn.endswith(".jsonl") or fn.endswith(".jsonl.open"))),
+            key=lambda fn: int(fn.split("-", 1)[1].split(".", 1)[0]))
+    except (OSError, ValueError):
+        return []
+    recs = []
+    for fn in names:
+        try:
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as fh:
+                recs.extend(decode_lines(fh))
+        except OSError:
+            continue
+    return recs
+
+
+def metric_names_of(records) -> list:
+    """Sorted base metric names appearing anywhere in `records`."""
+    names = set()
+    for _w, state in records:
+        names.update(base_name(k) for k in state)
+    return sorted(names)
+
+
+def points_for(records, metric: str) -> list:
+    """``[(w_ns, value)]`` for one metric, summed across labelsets."""
+    out = []
+    for w, state in records:
+        vals = [v for k, v in state.items() if _match(k, metric)]
+        if vals:
+            out.append((w, sum(vals)))
+    return out
+
+
+def rate_points(points) -> list:
+    """Per-second rates from successive counter points; a negative
+    delta is a counter reset and clamps to the new value."""
+    out = []
+    for (w0, v0), (w1, v1) in zip(points, points[1:]):
+        dt = (w1 - w0) / 1e9
+        if dt <= 0:
+            continue
+        dv = v1 - v0
+        if dv < 0:
+            dv = v1
+        out.append((w1, dv / dt))
+    return out
+
+
+def quantile_points(records, metric: str,
+                    quantiles: tuple = (0.5, 0.95)) -> list:
+    """Histogram quantiles over time: each point folds the recorded
+    ``_bucket``/``_sum``/``_count`` series as deltas from the first
+    record in range (so the distribution covers the queried range, not
+    the process lifetime), rendered back through ``promparse``.
+    Returns ``[{"w": ns, "count": ..., "mean_s": ..., "pNN_s": ...}]``;
+    points where the window has no observations yet are skipped."""
+    if not records:
+        return []
+    prefixes = (metric + "_bucket", metric + "_sum", metric + "_count")
+
+    def hist_part(state):
+        return {k: v for k, v in state.items()
+                if base_name(k) in prefixes}
+
+    first = hist_part(records[0][1])
+    out = []
+    for w, state in records[1:]:
+        delta = {k: max(0.0, v - first.get(k, 0.0))
+                 for k, v in hist_part(state).items()}
+        by_name = promparse.index_samples(
+            promparse.parse_exposition(render_state(delta)))
+        cell = promparse.hist_summary(by_name, metric, quantiles=quantiles)
+        if cell:
+            out.append({"w": w, **cell})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+# ---------------------------------------------------------------------------
+
+class HistoryRecorder:
+    """One node's flight-data recorder.  ``enabled`` is True so the
+    one-branch guard at call sites passes; ``NOP`` is the disabled
+    twin.  ``sample()`` takes one scrape of ``source`` (the bound
+    ``Registry.expose``) into the tail and, in directory mode, the
+    open segment; the background thread is just a loop over it.  With
+    no ``root`` the recorder is memory-only (the simnet mode: nothing
+    on disk, retention = tail length)."""
+
+    enabled = True
+
+    def __init__(self, node: str = "", root: str = "", source=None,
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 segment_points: int = DEFAULT_SEGMENT_POINTS,
+                 keep_segments: int = DEFAULT_KEEP_SEGMENTS,
+                 max_series: int = DEFAULT_MAX_SERIES,
+                 tail_points: int = DEFAULT_TAIL_POINTS,
+                 clock=time.monotonic):
+        self.node = node
+        self.dir = os.path.join(root, "history") if root else ""
+        self.source = source
+        self.interval_s = max(0.05, float(interval_s))
+        self.segment_points = max(2, int(segment_points))
+        self.keep_segments = max(1, int(keep_segments))
+        self.max_series = max(16, int(max_series))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tail: deque = deque(maxlen=max(2, int(tail_points)))
+        self._extras: dict[str, float] = {}
+        self.samples = 0
+        self.dropped_series = 0
+        self.errors = 0
+        self.bytes_written = 0
+        self.segments_sealed = 0
+        self.overhead_s = 0.0
+        self._fh = None
+        self._seg_path = ""
+        self._seg_lines = 0
+        self._prev_disk: dict | None = None
+        self._drift_cache: tuple | None = None   # (last_w, result)
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        if self.dir:
+            self._recover_open_segment()
+
+    # -- sampling -------------------------------------------------------
+
+    def sample(self) -> int:
+        """One scrape of ``source`` into the tail (and the open
+        segment in directory mode).  Returns the number of series
+        recorded.  Public: the runner's virtual-time ticker, tests and
+        the ``history-overhead`` bench stage call it directly."""
+        src = self.source
+        if src is None:
+            return 0
+        t0 = time.perf_counter()
+        try:
+            text = src()
+        except Exception as e:  # noqa: BLE001 — recorder survives
+            with self._lock:
+                self.errors += 1
+            _log.warning("history sample failed: %r", e)
+            return 0
+        # tight inline parse (the 50us/sample bench budget): the
+        # exposition lhs `name{labels}` IS the series key for any
+        # stable-ordered source (the registry renders deterministically),
+        # so the generic promparse tuple/labels-dict allocations are
+        # pure overhead here.  Replay paths (evaluate_history, the
+        # quantile reader) still round-trip through promparse.
+        state: dict[str, float] = {}
+        dropped = 0
+        for line in text.splitlines():
+            if not line or line[0] == "#":
+                continue
+            key, _, value = line.rpartition(" ")
+            try:
+                state[key] = float(value)
+            except ValueError:
+                continue
+        if len(state) > self.max_series:
+            # cap enforced after the loop (rare path): insertion order
+            # means the first max_series distinct series win, same as
+            # an inline check without a len() per line
+            for k in list(state)[self.max_series:]:
+                del state[k]
+                dropped += 1
+        w = _clockmod.wall_ns()
+        with self._lock:
+            state.update(self._extras)
+            self._tail.append((w, state))
+            self.samples += 1
+            self.dropped_series += dropped
+            if self.dir:
+                try:
+                    self._append_disk(w, state)
+                except OSError as e:
+                    self.errors += 1
+                    _log.warning("history append failed: %r", e)
+            self.overhead_s += time.perf_counter() - t0
+        return len(state)
+
+    def record(self, name: str, value: float) -> None:
+        """Record a node-level fact the registry does not expose (the
+        fleet sampler's serving bit, injected test series).  Sticky
+        gauge semantics: the value rides every subsequent sample as
+        ``tendermint_node_<name>`` until overwritten."""
+        with self._lock:
+            self._extras[f"tendermint_node_{name}"] = float(value)
+
+    # -- disk segments --------------------------------------------------
+
+    def _recover_open_segment(self) -> None:
+        """Seal any ``.open`` segment a previous process left behind —
+        its readable prefix is flight data; the torn tail (if any) is
+        dropped by every reader."""
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            for fn in sorted(os.listdir(self.dir)):
+                if fn.startswith("seg-") and fn.endswith(".jsonl.open"):
+                    os.replace(os.path.join(self.dir, fn),
+                               os.path.join(self.dir, fn[:-len(".open")]))
+        except OSError as e:
+            _log.warning("history recover failed: %r", e)
+
+    def _append_disk(self, w: int, state: dict) -> None:
+        if self._fh is None:
+            self._seg_path = os.path.join(self.dir, f"seg-{w}.jsonl.open")
+            self._fh = open(self._seg_path, "a", encoding="utf-8")
+            self._seg_lines = 0
+            self._prev_disk = None
+        if self._prev_disk is None:
+            doc = {"w": int(w), "f": {k: state[k] for k in sorted(state)}}
+        else:
+            prev = self._prev_disk
+            # no pre-sort: json.dumps(sort_keys=True) below is the
+            # (single) canonical ordering pass
+            changed = {k: v for k, v in state.items()
+                       if prev.get(k) != v}
+            removed = sorted(k for k in prev if k not in state)
+            doc = {"w": int(w), "d": changed}
+            if removed:
+                doc["x"] = removed
+        line = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+        self._fh.write(line + "\n")
+        self._fh.flush()
+        self.bytes_written += len(line) + 1
+        self._seg_lines += 1
+        self._prev_disk = state
+        if self._seg_lines >= self.segment_points:
+            self._seal_locked()
+
+    def _seal_locked(self) -> None:
+        if self._fh is None:
+            return
+        self._fh.close()
+        os.replace(self._seg_path, self._seg_path[:-len(".open")])
+        self._fh = None
+        self._seg_path = ""
+        self._seg_lines = 0
+        self._prev_disk = None
+        self.segments_sealed += 1
+        sealed = sorted(fn for fn in os.listdir(self.dir)
+                        if fn.startswith("seg-") and fn.endswith(".jsonl"))
+        for fn in sealed[:-self.keep_segments]:
+            try:
+                os.remove(os.path.join(self.dir, fn))
+            except OSError:
+                pass
+
+    # -- queries --------------------------------------------------------
+
+    def records(self, since_w: int = 0, until_w: int | None = None) -> list:
+        """``[(w_ns, state)]`` in ``[since_w, until_w]``.  Directory
+        mode reads the segments (longer retention than the tail);
+        memory mode reads the tail."""
+        if self.dir:
+            recs = self._read_disk()
+        else:
+            with self._lock:
+                recs = list(self._tail)
+        return [(w, s) for w, s in recs
+                if w >= since_w and (until_w is None or w <= until_w)]
+
+    def _read_disk(self) -> list:
+        return read_dir(self.dir)
+
+    def series(self, metric: str, since_w: int = 0,
+               until_w: int | None = None) -> list:
+        return points_for(self.records(since_w, until_w), metric)
+
+    def rate(self, metric: str, since_w: int = 0,
+             until_w: int | None = None) -> list:
+        return rate_points(self.series(metric, since_w, until_w))
+
+    def quantiles(self, metric: str, quantiles: tuple = (0.5, 0.95),
+                  since_w: int = 0, until_w: int | None = None) -> list:
+        return quantile_points(self.records(since_w, until_w), metric,
+                               quantiles=quantiles)
+
+    def metric_names(self) -> list:
+        return metric_names_of(self.records())
+
+    def window_text(self, seconds: float = 900.0) -> str:
+        """The last-``seconds`` window as codec lines — what the
+        flight recorder embeds as ``history.jsonl`` next to the
+        journal tail."""
+        with self._lock:
+            recs = list(self._tail)
+        if not recs:
+            return ""
+        cut = recs[-1][0] - int(seconds * 1e9)
+        recs = [(w, s) for w, s in recs if w >= cut]
+        return "\n".join(encode_records(recs)) + "\n"
+
+    def export(self, metric: str = "", since_w: int = 0) -> dict:
+        """The ``/debug/pprof/history`` payload.  Without ``metric``:
+        codec lines for the whole range (the fleet scraper's backfill
+        food — ``decode_lines`` on the other side).  With ``metric``:
+        decoded points + rates for one series."""
+        recs = self.records(since_w)
+        out = {"enabled": True, "node": self.node, "points": len(recs),
+               "interval_s": self.interval_s}
+        if recs:
+            out["first_w"] = recs[0][0]
+            out["last_w"] = recs[-1][0]
+        if metric:
+            pts = points_for(recs, metric)
+            out["metric"] = metric
+            out["series"] = [[w, v] for w, v in pts]
+            out["rate"] = [[w, r] for w, r in rate_points(pts)]
+        else:
+            out["lines"] = encode_records(recs)
+        return out
+
+    # -- drift ----------------------------------------------------------
+
+    def drift_probe(self) -> dict:
+        """The ``metric_drift`` detector's probe: per counter series,
+        the newest fixed-width rate window vs the median of the
+        trailing baseline windows as a robust z-score (MAD-scaled,
+        floored so quiet series cannot divide by zero).  Reports the
+        worst series as ``{"history_drift": {...}}``; ``{}`` while the
+        tail is too short.  Cached per tail head — the health ticker
+        may call far more often than the sampler appends."""
+        with self._lock:
+            recs = list(self._tail)
+        if len(recs) < DRIFT_WINDOW_POINTS * (DRIFT_MIN_BASELINES + 1) + 1:
+            return {}
+        head_w = recs[-1][0]
+        cached = self._drift_cache
+        if cached is not None and cached[0] == head_w:
+            return cached[1]
+        worst = None
+        latest = recs[-1][1]
+        counters = sorted(k for k in latest
+                          if base_name(k).endswith("_total"))[:DRIFT_MAX_SERIES]
+        # window boundaries, newest first, every DRIFT_WINDOW_POINTS
+        bounds = list(range(len(recs) - 1, -1, -DRIFT_WINDOW_POINTS))
+        n_win = min(len(bounds) - 1, DRIFT_MAX_BASELINES + 1)
+        for key in counters:
+            rates = []
+            for i in range(n_win):
+                hi, lo = bounds[i], bounds[i + 1]
+                (w0, s0), (w1, s1) = recs[lo], recs[hi]
+                dt = (w1 - w0) / 1e9
+                dv = s1.get(key, 0.0) - s0.get(key, 0.0)
+                if dt <= 0 or dv < 0:     # gap or counter reset: skip window
+                    rates.append(None)
+                else:
+                    rates.append(dv / dt)
+            cur = rates[0]
+            base = [r for r in rates[1:] if r is not None]
+            if cur is None or len(base) < DRIFT_MIN_BASELINES:
+                continue
+            base.sort()
+            med = base[len(base) // 2]
+            mad = sorted(abs(r - med) for r in base)[len(base) // 2]
+            scale = 1.4826 * mad + 0.05 * med + 0.1
+            z = abs(cur - med) / scale
+            if worst is None or z > worst["z"]:
+                worst = {"z": round(z, 2), "series": key,
+                         "current_per_s": round(cur, 4),
+                         "baseline_per_s": round(med, 4),
+                         "windows": len(base)}
+        out = {"history_drift": worst} if worst else {}
+        with self._lock:
+            self._drift_cache = (head_w, out)
+        return out
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn the sampling daemon thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.sample()
+                except Exception as e:  # noqa: BLE001 — recorder survives
+                    _log.warning("history sample failed: %r", e)
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True,
+            name=f"history-{self.node or 'node'}")
+        self._thread.start()
+
+    def stop(self, timeout: float = 1.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._thread = None
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._seal_locked()
+                except OSError as e:
+                    _log.warning("history seal failed: %r", e)
+
+    # -- views ----------------------------------------------------------
+
+    def sample_counts(self) -> list:
+        """[(labels, value)] rows for tendermint_history_samples_total."""
+        with self._lock:
+            return [({}, float(self.samples))] if self.samples else []
+
+    def byte_counts(self) -> list:
+        """[(labels, value)] rows for tendermint_history_bytes_total."""
+        with self._lock:
+            return [({}, float(self.bytes_written))] \
+                if self.bytes_written else []
+
+    def status_block(self) -> dict:
+        """Compact block for RPC `status` / the history CLI."""
+        with self._lock:
+            return {
+                "enabled": True,
+                "node": self.node,
+                "interval_s": self.interval_s,
+                "running": (self._thread is not None
+                            and self._thread.is_alive()),
+                "samples": self.samples,
+                "tail_points": len(self._tail),
+                "errors": self.errors,
+                "dropped_series": self.dropped_series,
+                "bytes_written": self.bytes_written,
+                "segments_sealed": self.segments_sealed,
+                "overhead_s": round(self.overhead_s, 6),
+                "dir": self.dir,
+            }
+
+    def report(self) -> dict:
+        """Deterministic-by-construction summary — the simnet
+        verdict's per-node history input (no wall overhead, no thread
+        state: same records -> same report)."""
+        with self._lock:
+            recs = list(self._tail)
+        out = {"enabled": True, "node": self.node, "points": len(recs),
+               "samples": self.samples}
+        if recs:
+            out["first_w"] = recs[0][0]
+            out["last_w"] = recs[-1][0]
+            out["series"] = len(recs[-1][1])
+        drift = self.drift_probe().get("history_drift")
+        if drift:
+            out["drift"] = drift
+        return out
+
+
+# ---------------------------------------------------------------------------
+# NOP twin + env gate
+# ---------------------------------------------------------------------------
+
+class _NopHistory:
+    """Disabled recorder: `.enabled` is False and every (never-taken)
+    path is a no-op, so a call site costs one attribute load + branch."""
+
+    enabled = False
+
+    def sample(self) -> int:
+        return 0
+
+    def record(self, name: str, value: float) -> None:
+        pass
+
+    def records(self, since_w: int = 0, until_w: int | None = None) -> list:
+        return []
+
+    def series(self, metric: str, since_w: int = 0,
+               until_w: int | None = None) -> list:
+        return []
+
+    def rate(self, metric: str, since_w: int = 0,
+             until_w: int | None = None) -> list:
+        return []
+
+    def quantiles(self, metric: str, quantiles: tuple = (0.5, 0.95),
+                  since_w: int = 0, until_w: int | None = None) -> list:
+        return []
+
+    def metric_names(self) -> list:
+        return []
+
+    def window_text(self, seconds: float = 900.0) -> str:
+        return ""
+
+    def export(self, metric: str = "", since_w: int = 0) -> dict:
+        return {"enabled": False, "points": 0}
+
+    def drift_probe(self) -> dict:
+        return {}
+
+    def start(self) -> None:
+        pass
+
+    def stop(self, timeout: float = 1.0) -> None:
+        pass
+
+    def sample_counts(self) -> list:
+        return []
+
+    def byte_counts(self) -> list:
+        return []
+
+    def status_block(self) -> dict:
+        return {"enabled": False}
+
+    def report(self) -> dict:
+        return {"enabled": False}
+
+
+NOP = _NopHistory()
+
+
+def from_env(node: str = "", root: str = "", source=None,
+             clock=None,
+             interval_s: float | None = None
+             ) -> "HistoryRecorder | _NopHistory":
+    """Build a recorder per TM_TPU_HISTORY (default ON), or return the
+    NOP singleton when disabled.  ``root`` hosts the on-disk segments
+    (``<root>/history/``); no root = memory-only (the simnet mode).
+    ``clock`` overrides the monotonic clock; wall stamps always flow
+    through the clock seam.  ``interval_s`` is the caller's cadence
+    default (simnet passes its test scale); the env knob still wins."""
+    raw = os.environ.get(ENV_FLAG, "1").lower()
+    if raw in ("0", "false", "off"):
+        return NOP
+    base_interval = DEFAULT_INTERVAL_S if interval_s is None else interval_s
+    try:
+        interval_s = float(os.environ.get("TM_TPU_HISTORY_INTERVAL_S",
+                                          base_interval))
+    except ValueError:
+        interval_s = base_interval
+    try:
+        segment_points = int(os.environ.get("TM_TPU_HISTORY_SEGMENT_POINTS",
+                                            DEFAULT_SEGMENT_POINTS))
+    except ValueError:
+        segment_points = DEFAULT_SEGMENT_POINTS
+    try:
+        keep_segments = int(os.environ.get("TM_TPU_HISTORY_KEEP",
+                                           DEFAULT_KEEP_SEGMENTS))
+    except ValueError:
+        keep_segments = DEFAULT_KEEP_SEGMENTS
+    try:
+        max_series = int(os.environ.get("TM_TPU_HISTORY_MAX_SERIES",
+                                        DEFAULT_MAX_SERIES))
+    except ValueError:
+        max_series = DEFAULT_MAX_SERIES
+    return HistoryRecorder(
+        node=node,
+        root=root,
+        source=source,
+        interval_s=interval_s,
+        segment_points=segment_points,
+        keep_segments=keep_segments,
+        max_series=max_series,
+        clock=clock if clock is not None else time.monotonic,
+    )

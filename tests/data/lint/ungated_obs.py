@@ -5,12 +5,14 @@ from tendermint_tpu.utils import devmon
 
 
 class Site:
-    def __init__(self, journal, lifecycle, health, remediate, prof):
+    def __init__(self, journal, lifecycle, health, remediate, prof,
+                 history):
         self.journal = journal
         self.lifecycle = lifecycle
         self.health = health
         self.remediate = remediate
         self.prof = prof
+        self.history = history
         self.replay_mode = False
 
     def flush_ungated(self, n, rung):
@@ -53,6 +55,15 @@ class Site:
     def prof_capture_ungated_upper(self, PROF):
         PROF.capture(1.0)  # LINT: ungated-observability
 
+    def history_sample_ungated(self):
+        self.history.sample()  # LINT: ungated-observability
+
+    def history_record_ungated(self):
+        self.history.record("serving", 1.0)  # LINT: ungated-observability
+
+    def history_record_ungated_upper(self, HISTORY):
+        HISTORY.record("serving", 0.0)  # LINT: ungated-observability
+
     def act_gated(self, tr):
         if self.remediate.enabled:
             self.remediate.act(tr)
@@ -91,6 +102,15 @@ class Site:
     def capture_other_receiver(self, image):
         # camera capture is not a profiler sink: no finding
         return image.capture()
+
+    def history_sample_gated(self):
+        if self.history.enabled:
+            self.history.sample()
+
+    def history_record_early_exit(self):
+        if not self.history.enabled:
+            return
+        self.history.record("serving", 1.0)
 
     def stamp_gated(self, key):
         if self.lifecycle.enabled:

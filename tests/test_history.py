@@ -1,0 +1,809 @@
+"""Metric history (ISSUE 19): the on-node flight-data recorder
+(utils/history.py), retrospective SLO burn over recorded series
+(fleet/slo.evaluate_history), drift detection against the node's own
+baseline, and the CLI / live-node / simnet surfaces.
+
+Layers under test:
+
+  * codec: full+delta lines, torn-tail robustness (valid prefix, never
+    raise), delta-without-full rejection;
+  * recorder: memory tail, sticky `record()` extras, rate with
+    counter-reset clamp, quantiles-over-time, the series cap;
+  * disk: segment seal/rotate via os.replace, `.open` crash recovery,
+    retention pruning, read_dir (the CLI's dead-node path);
+  * drift: down-drift -> CRITICAL through MetricDriftDetector,
+    up-drift capped at WARN (recovery bursts must not page);
+  * retro burn: the SAME dual-window trajectory the live engine pin
+    (test_fleet.test_burn_engine_dual_window_rule) walks, replayed
+    from records — ok -> burning -> warn, plus staleness = down;
+  * CLI exit contract: 0 data / 1 empty / 2 usage / 3 unreachable;
+  * live node: /debug/pprof/history + metrics families + CLI + the
+    fleet backfill path (`--once` verdict sourced from history);
+  * simnet: a virtual partition scenario fails its SLO gate through
+    the retrospective path and metric_drift fires excused; history
+    off -> the retro checks skip (no-data); same seed twice ->
+    byte-identical history-derived verdict JSON.
+"""
+
+import asyncio
+import contextlib
+import io
+import json
+import os
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.utils import clock as clockmod
+from tendermint_tpu.utils import history as tmhistory
+from tendermint_tpu.utils.health import (
+    CRITICAL,
+    OK,
+    WARN,
+    MetricDriftDetector,
+)
+from tendermint_tpu.utils.history import (
+    HistoryRecorder,
+    decode_lines,
+    encode_records,
+    quantile_points,
+    rate_points,
+    read_dir,
+    series_key,
+)
+
+
+# ---------------------------------------------------------------------------
+# helpers: a hand-cranked clock on the seam
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock(clockmod.Clock):
+    """Deterministic wall/monotonic pair for recorder stamps."""
+
+    def __init__(self, t0: float = 1_000.0):
+        self.t = t0
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+    def wall_ns(self) -> int:
+        return int(self.t * 1e9)
+
+    def wall(self) -> float:
+        return self.t
+
+    def monotonic(self) -> float:
+        return self.t
+
+
+@pytest.fixture
+def fake_clock():
+    clk = _FakeClock()
+    token = clockmod.install(clk)
+    try:
+        yield clk
+    finally:
+        clockmod.restore(token)
+
+
+def _counter_source(box: dict):
+    """An exposition source reading a mutable counter/gauge box."""
+
+    def src() -> str:
+        return (f"tendermint_test_ops_total {box['ops']}\n"
+                f"tendermint_test_height {box['height']}\n")
+
+    return src
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+
+def test_codec_roundtrip_with_deltas_and_removal():
+    recs = [
+        (100, {"a_total": 1.0, "g": 5.0}),
+        (200, {"a_total": 3.0, "g": 5.0}),          # only a_total changed
+        (300, {"a_total": 3.0}),                     # g removed
+    ]
+    lines = encode_records(recs)
+    assert json.loads(lines[0]).get("f")             # first is a full record
+    assert "d" in json.loads(lines[1])               # rest are deltas
+    assert json.loads(lines[2]).get("x") == ["g"]
+    assert decode_lines(lines) == recs
+    # byte-determinism: same records, same lines
+    assert encode_records(recs) == lines
+
+
+def test_codec_torn_tail_and_bad_lines_yield_valid_prefix():
+    recs = [(100, {"a": 1.0}), (200, {"a": 2.0}), (300, {"a": 3.0})]
+    lines = encode_records(recs)
+    torn = lines[:2] + [lines[2][: len(lines[2]) // 2]]   # mid-json crash
+    assert decode_lines(torn) == recs[:2]
+    assert decode_lines(lines[:1] + ["not json"] + lines[1:]) == recs[:1]
+    # a delta with no preceding full record is out of protocol: nothing
+    assert decode_lines(lines[1:]) == []
+    assert decode_lines([]) == []
+
+
+def test_rate_points_clamps_counter_reset():
+    pts = [(0, 10.0), (int(1e9), 20.0), (int(2e9), 2.0), (int(3e9), 4.0)]
+    rates = rate_points(pts)
+    # 10/s, then the reset clamps to the new value (2/s), then 2/s
+    assert [r for _w, r in rates] == [10.0, 2.0, 2.0]
+    # zero/negative dt windows are skipped, not divided by
+    assert rate_points([(5, 1.0), (5, 2.0)]) == []
+
+
+# ---------------------------------------------------------------------------
+# recorder: memory mode
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_memory_mode_series_rate_and_sticky_extras(fake_clock):
+    box = {"ops": 0.0, "height": 0.0}
+    rec = HistoryRecorder(node="n0", source=_counter_source(box),
+                          interval_s=1.0)
+    assert rec.enabled
+    for i in range(5):
+        box["ops"] = 10.0 * (i + 1)
+        box["height"] = float(i)
+        if i >= 2:
+            rec.record("serving", 1.0)   # sticky from the 3rd sample on
+        rec.sample()
+        fake_clock.advance(1.0)
+    recs = rec.records()
+    assert len(recs) == 5 and rec.samples == 5
+    assert recs[0][0] == int(1_000.0 * 1e9)          # seam stamps, not wall
+    # sticky extra rides every sample after record()
+    assert "tendermint_node_serving" not in recs[1][1]
+    assert recs[2][1]["tendermint_node_serving"] == 1.0
+    assert recs[4][1]["tendermint_node_serving"] == 1.0
+    assert rec.series("tendermint_test_ops_total")[-1] == (recs[-1][0], 50.0)
+    assert [r for _w, r in rec.rate("tendermint_test_ops_total")] == [10.0] * 4
+    assert rec.metric_names() == ["tendermint_node_serving",
+                                  "tendermint_test_height",
+                                  "tendermint_test_ops_total"]
+    # range queries honor [since, until]
+    mid = recs[2][0]
+    assert len(rec.records(since_w=mid)) == 3
+    assert len(rec.records(until_w=mid)) == 3
+    # deterministic report: no wall overhead, no thread state
+    rep = rec.report()
+    assert rep["points"] == 5 and rep["enabled"] and rep["node"] == "n0"
+    assert rep["first_w"] == recs[0][0] and rep["last_w"] == recs[-1][0]
+
+
+def test_recorder_survives_broken_source_and_caps_series(fake_clock):
+    calls = {"n": 0}
+
+    def src():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("scrape exploded")
+        return "\n".join(f"tendermint_s{i} {i}" for i in range(40)) + "\n"
+
+    rec = HistoryRecorder(node="n0", source=src, max_series=16)
+    assert rec.sample() == 16                       # floor(16) keeps first 16
+    assert rec.sample() == 0                        # error swallowed, counted
+    assert rec.errors == 1 and rec.samples == 1
+    assert rec.dropped_series == 24
+    # comments and malformed lines are skipped, not recorded
+    rec2 = HistoryRecorder(node="n1", source=lambda: (
+        "# HELP x y\n# TYPE x gauge\nx 1\nbad line here nan-ish value x\n"))
+    assert rec2.sample() == 1
+    assert rec2.records()[0][1] == {"x": 1.0}
+    # no source at all: a no-op, not a crash
+    assert HistoryRecorder(node="n2").sample() == 0
+
+
+def test_quantiles_over_time_fold_bucket_deltas(fake_clock):
+    key = series_key("tendermint_rpc_seconds_bucket", {"le": "0.1"})
+    assert key == 'tendermint_rpc_seconds_bucket{le="0.1"}'
+    box = {"fast": 0.0, "all": 0.0, "sum": 0.0}
+
+    def src():
+        return (
+            f'tendermint_rpc_seconds_bucket{{le="0.1"}} {box["fast"]}\n'
+            f'tendermint_rpc_seconds_bucket{{le="1"}} {box["all"]}\n'
+            f'tendermint_rpc_seconds_bucket{{le="+Inf"}} {box["all"]}\n'
+            f'tendermint_rpc_seconds_sum {box["sum"]}\n'
+            f'tendermint_rpc_seconds_count {box["all"]}\n'
+        )
+
+    rec = HistoryRecorder(node="n0", source=src)
+    rec.sample()
+    fake_clock.advance(10.0)
+    box.update(fast=9.0, all=10.0, sum=2.0)
+    rec.sample()
+    pts = rec.quantiles("tendermint_rpc_seconds")
+    assert len(pts) == 1
+    cell = pts[0]
+    # the window's distribution: 10 obs, 9 under 100ms
+    assert cell["count"] == 10
+    assert cell["p50_s"] <= 0.1
+    # module-level reader agrees (the CLI path)
+    assert quantile_points(rec.records(), "tendermint_rpc_seconds") == pts
+
+
+# ---------------------------------------------------------------------------
+# recorder: disk segments
+# ---------------------------------------------------------------------------
+
+
+def _disk_recorder(root, box, **kw):
+    kw.setdefault("segment_points", 4)
+    kw.setdefault("keep_segments", 2)
+    return HistoryRecorder(node="n0", root=str(root),
+                           source=_counter_source(box), **kw)
+
+
+def test_disk_segments_seal_rotate_and_prune(tmp_path, fake_clock):
+    box = {"ops": 0.0, "height": 0.0}
+    rec = _disk_recorder(tmp_path, box)
+    for i in range(14):
+        box["ops"] = float(i)
+        rec.sample()
+        fake_clock.advance(1.0)
+    names = sorted(os.listdir(tmp_path / "history"))
+    sealed = [n for n in names if n.endswith(".jsonl")]
+    # 3 seals at 4/8/12 samples, pruned to keep_segments=2, plus the
+    # open tail holding the last 2 samples
+    assert len(sealed) == 2 and rec.segments_sealed == 3
+    assert sum(1 for n in names if n.endswith(".jsonl.open")) == 1
+    # disk reads skip the pruned first segment: samples 5..14 remain
+    recs = rec.records()
+    assert len(recs) == 10
+    assert recs[0][1]["tendermint_test_ops_total"] == 4.0
+    assert rec.bytes_written > 0
+    # stop() seals the open tail (and the seal prunes again: the two
+    # newest segments survive — samples 9..14)
+    rec.stop()
+    names = sorted(os.listdir(tmp_path / "history"))
+    assert all(n.endswith(".jsonl") for n in names)
+    cold = read_dir(str(tmp_path / "history"))
+    assert len(cold) == 6
+    assert cold[0][1]["tendermint_test_ops_total"] == 8.0
+
+
+def test_open_segment_recovery_and_torn_tail(tmp_path, fake_clock):
+    box = {"ops": 0.0, "height": 0.0}
+    rec = _disk_recorder(tmp_path, box, segment_points=100)
+    for i in range(3):
+        box["ops"] = float(i)
+        rec.sample()
+        fake_clock.advance(1.0)
+    # simulate a crash: the .open segment is left behind, torn mid-line
+    [open_seg] = [n for n in os.listdir(tmp_path / "history")
+                  if n.endswith(".jsonl.open")]
+    p = tmp_path / "history" / open_seg
+    p.write_bytes(p.read_bytes()[:-7])              # tear the last record
+    rec2 = _disk_recorder(tmp_path, box, segment_points=100)
+    # recovery sealed the orphan; the readable prefix survives
+    assert not any(n.endswith(".open")
+                   for n in os.listdir(tmp_path / "history"))
+    assert len(rec2.records()) == 2
+    # read_dir on a missing dir is empty, never raises
+    assert read_dir(str(tmp_path / "nope")) == []
+
+
+# ---------------------------------------------------------------------------
+# from_env gate
+# ---------------------------------------------------------------------------
+
+
+def test_from_env_gate_and_knobs(monkeypatch):
+    monkeypatch.setenv(tmhistory.ENV_FLAG, "0")
+    assert tmhistory.from_env(node="x") is tmhistory.NOP
+    assert not tmhistory.NOP.enabled
+    assert tmhistory.NOP.sample() == 0 and tmhistory.NOP.records() == []
+    assert tmhistory.NOP.export() == {"enabled": False, "points": 0}
+    assert tmhistory.NOP.report() == {"enabled": False}
+
+    monkeypatch.delenv(tmhistory.ENV_FLAG, raising=False)
+    rec = tmhistory.from_env(node="x")              # default ON
+    assert rec.enabled and rec.interval_s == tmhistory.DEFAULT_INTERVAL_S
+    # the caller's cadence default holds until the env knob overrides it
+    assert tmhistory.from_env(node="x", interval_s=0.25).interval_s == 0.25
+    monkeypatch.setenv("TM_TPU_HISTORY_INTERVAL_S", "2.5")
+    assert tmhistory.from_env(node="x", interval_s=0.25).interval_s == 2.5
+    monkeypatch.setenv("TM_TPU_HISTORY_INTERVAL_S", "bogus")
+    assert tmhistory.from_env(node="x", interval_s=0.25).interval_s == 0.25
+
+
+# ---------------------------------------------------------------------------
+# drift: probe + detector severity asymmetry
+# ---------------------------------------------------------------------------
+
+
+def _drifted_recorder(fake_clock, tail_rate: float):
+    """30 samples at 10 ops/s, then 7 at `tail_rate` — the current
+    drift window (last 6 intervals) sees the changed rate."""
+    box = {"ops": 0.0, "height": 0.0}
+    rec = HistoryRecorder(node="n0", source=_counter_source(box))
+    v = 0.0
+    for _ in range(30):
+        v += 10.0
+        box["ops"] = v
+        rec.sample()
+        fake_clock.advance(1.0)
+    for _ in range(7):
+        v += tail_rate
+        box["ops"] = v
+        rec.sample()
+        fake_clock.advance(1.0)
+    return rec
+
+
+def test_drift_probe_down_drift_goes_critical(fake_clock):
+    rec = _drifted_recorder(fake_clock, tail_rate=0.0)
+    d = rec.drift_probe()["history_drift"]
+    assert d["series"] == "tendermint_test_ops_total"
+    assert d["current_per_s"] == 0.0
+    assert d["baseline_per_s"] == pytest.approx(10.0)
+    assert d["z"] >= 8.0 and d["windows"] >= tmhistory.DRIFT_MIN_BASELINES
+    det = MetricDriftDetector()
+    level, detail = det.observe({"history_drift": d})
+    assert level == CRITICAL and "tendermint_test_ops_total" in detail
+    # the probe is cached per tail head: same head, same object out
+    assert rec.drift_probe()["history_drift"] is d
+
+
+def test_drift_up_burst_is_not_an_alarm(fake_clock):
+    rec = _drifted_recorder(fake_clock, tail_rate=200.0)
+    d = rec.drift_probe()["history_drift"]
+    assert d["current_per_s"] > d["baseline_per_s"] and d["z"] >= 8.0
+    det = MetricDriftDetector()
+    level, _ = det.observe({"history_drift": d})
+    assert level == OK          # upward = catch-up/load, never an alarm
+    # a down-drift in the warn band (4 <= z < 8) warns without paging
+    mild = dict(d, current_per_s=d["baseline_per_s"] * 0.5, z=5.0)
+    level, detail = det.observe({"history_drift": mild})
+    assert level == WARN and "baseline" in detail
+    # steady rate: z ~ 0, under every threshold -> detector stays OK
+    steady = _drifted_recorder(fake_clock, tail_rate=10.0)
+    sd = steady.drift_probe()["history_drift"]
+    assert sd["z"] < 4.0
+    assert det.observe({"history_drift": sd}) == (OK, "")
+    short = HistoryRecorder(node="s", source=_counter_source(
+        {"ops": 1.0, "height": 0.0}))
+    short.sample()
+    assert short.drift_probe() == {}
+    assert MetricDriftDetector().observe({}) == (OK, "")
+
+
+# ---------------------------------------------------------------------------
+# retrospective SLO burn: the dual-window trajectory from records
+# ---------------------------------------------------------------------------
+
+
+def _avail_objective():
+    from tendermint_tpu.fleet import Objective
+
+    obj = Objective(name="a", kind="availability", min=0.9, target=0.99,
+                    fast_window_s=10.0, slow_window_s=100.0,
+                    fast_burn=14.4, slow_burn=6.0)
+    obj.validate()
+    return obj
+
+
+def _serving_records(flags, t0=1_000.0, gap_s=1.0):
+    return [(int((t0 + i * gap_s) * 1e9),
+             {"tendermint_node_serving": 1.0 if up else 0.0,
+              "tendermint_consensus_height": float(i)})
+            for i, up in enumerate(flags)]
+
+
+def test_evaluate_history_replays_dual_window_trajectory():
+    """The retro path must walk the SAME ok -> burning -> warn arc the
+    live engine pin (test_burn_engine_dual_window_rule) walks: 90s
+    good, a 10s outage saturating the fast window, then a recovery
+    that clears fast while slow stays elevated."""
+    from tendermint_tpu.fleet import evaluate_history
+
+    objs = [_avail_objective()]
+    flags = [True] * 90 + [False] * 10 + [True] * 12
+    recs = _serving_records(flags)
+
+    v = evaluate_history(objs, {"n0": recs[:90]})
+    assert (v["state"], v["ok"], v["source"]) == ("ok", True, "history")
+    assert v["points"] == 90 and v["nodes"] == ["n0"]
+    assert v["span_s"] == pytest.approx(89.0)
+
+    v = evaluate_history(objs, {"n0": recs[:100]})
+    assert (v["state"], v["exit_code"]) == ("burning", 2)
+    burn = v["objectives"][0]
+    # the fast window is (almost) all-bad; both rates over threshold
+    assert burn["burn_fast"] >= 14.4 and burn["burn_slow"] >= 6.0
+
+    v = evaluate_history(objs, {"n0": recs})
+    assert (v["state"], v["exit_code"]) == ("warn", 1)
+    warm = v["objectives"][0]
+    assert warm["burn_fast"] == 0.0 and warm["burn_slow"] >= 6.0
+
+    # deterministic by construction: same records, same verdict bytes
+    a = json.dumps(evaluate_history(objs, {"n0": recs}), sort_keys=True)
+    b = json.dumps(evaluate_history(objs, {"n0": recs}), sort_keys=True)
+    assert a == b
+
+
+def test_evaluate_history_staleness_marks_silent_nodes_down():
+    from tendermint_tpu.fleet import evaluate_history
+
+    objs = [_avail_objective()]
+    n0 = _serving_records([True] * 60)
+    n1 = _serving_records([True] * 20)       # stops reporting at t=1020
+    v = evaluate_history(objs, {"n0": n0, "n1": n1})
+    # past n1's 2.5x-median-gap horizon the fleet is 1/2 available:
+    # under the 0.9 floor long enough to end not-ok
+    assert not v["ok"] and v["nodes"] == ["n0", "n1"]
+    # both healthy the whole way: clean
+    n1_full = _serving_records([True] * 60)
+    assert evaluate_history(objs, {"n0": n0, "n1": n1_full})["ok"]
+
+
+def test_evaluate_history_empty_is_no_data():
+    from tendermint_tpu.fleet import evaluate_history
+
+    v = evaluate_history([_avail_objective()], {})
+    assert (v["state"], v["exit_code"], v["points"]) == ("no-data", 0, 0)
+    assert v["ok"] and v["source"] == "history"
+    v = evaluate_history([_avail_objective()], {"n0": []})
+    assert v["points"] == 0 and v["ok"]
+
+
+def test_evaluate_history_bin_cap_keeps_newest():
+    from tendermint_tpu.fleet import evaluate_history
+
+    recs = _serving_records([True] * 50)
+    v = evaluate_history([_avail_objective()], {"n0": recs}, max_bins=10)
+    assert v["points"] == 10
+    assert v["span_s"] == pytest.approx(9.0)
+
+
+# ---------------------------------------------------------------------------
+# CLI exit contract (cheap paths; the live test below covers remote)
+# ---------------------------------------------------------------------------
+
+
+def _cli(**kw):
+    from tendermint_tpu.cli.history import run_history
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = run_history(**kw)
+    return rc, buf.getvalue()
+
+
+def test_history_cli_local_home_and_exit_codes(tmp_path, fake_clock):
+    box = {"ops": 0.0, "height": 0.0}
+    rec = _disk_recorder(tmp_path, box, segment_points=100)
+    for i in range(6):
+        box["ops"] = 5.0 * i
+        rec.sample()
+        fake_clock.advance(1.0)
+    rec.stop()
+
+    rc, out = _cli(home=str(tmp_path), as_json=True)
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["points"] == 6
+    assert "tendermint_test_ops_total" in doc["metrics"]
+
+    rc, out = _cli(home=str(tmp_path),
+                   metric="tendermint_test_ops_total", rate=True,
+                   as_json=True)
+    assert rc == 0
+    doc = json.loads(out)
+    assert [r for _w, r in doc["rate"]] == [5.0] * 5
+
+    # text render: header + sparkline (no crash, bounded width)
+    rc, out = _cli(home=str(tmp_path), metric="tendermint_test_ops_total",
+                   width=20)
+    assert rc == 0 and "history —" in out
+    rc, out = _cli(home=str(tmp_path), list_metrics=True)
+    assert rc == 0 and "tendermint_test_height" in out
+
+    # 1: readable home but nothing recorded / unknown metric
+    empty = tmp_path / "fresh"
+    empty.mkdir()
+    assert _cli(home=str(empty))[0] == 1
+    assert _cli(home=str(tmp_path), metric="tendermint_nope")[0] == 1
+    # 2: usage errors
+    assert _cli()[0] == 2
+    assert _cli(home=str(tmp_path), rate=True)[0] == 2
+    # 3: unreachable remote
+    assert _cli(pprof_addr="http://127.0.0.1:1", timeout=0.3)[0] == 3
+
+
+# ---------------------------------------------------------------------------
+# live node: endpoint, metrics, CLI, fleet backfill
+# ---------------------------------------------------------------------------
+
+
+def test_live_node_history_surfaces(tmp_path, monkeypatch):
+    """ISSUE 19 live acceptance: a single-node run records history on
+    its real cadence; /debug/pprof/history serves the range and the
+    per-metric decode; the metric families are typed; the CLI reads
+    both remote and (after stop) the on-disk segments; and `fleet
+    --once` pre-feeds its burn engine from the recorded history —
+    `slo.source == "history"` at the preserved exit codes."""
+    from tendermint_tpu.cli.fleet import run_fleet
+    from tendermint_tpu.cli.history import run_history
+    from tendermint_tpu.config import test_config as make_test_config
+    from tendermint_tpu.crypto.batch import set_default_backend
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    set_default_backend("cpu")
+    monkeypatch.delenv("TM_TPU_HISTORY", raising=False)
+    monkeypatch.setenv("TM_TPU_HISTORY_INTERVAL_S", "0.2")
+
+    slo_path = tmp_path / "slo.json"
+    slo_path.write_text(json.dumps({"objective": [
+        {"name": "availability", "kind": "availability", "min": 0.5,
+         "fast_window_s": 5.0, "slow_window_s": 30.0},
+    ]}))
+
+    async def run():
+        key = priv_key_from_seed(b"\x91" * 32)
+        gen = GenesisDoc(
+            chain_id="history-chain",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        home = str(tmp_path / "node")
+        cfg = make_test_config(home)
+        cfg.base.moniker = "h0"
+        cfg.base.fast_sync = False
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "tcp://127.0.0.1:0"
+        cfg.rpc.pprof_laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = key
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        try:
+            assert node.history.enabled
+            assert node.history.interval_s == 0.2
+            assert node.health.history is node.history
+            await node.wait_for_height(2, timeout=30)
+            # let a few samples land on the 0.2s cadence
+            for _ in range(100):
+                if node.history.samples >= 4:
+                    break
+                await asyncio.sleep(0.1)
+            assert node.history.samples >= 4
+            mh, mp = node.metrics.addr
+            rpc = f"http://{node.rpc_addr[0]}:{node.rpc_addr[1]}"
+            ph, pp = node.pprof_addr
+            pprof = f"http://{ph}:{pp}"
+
+            def get(url):
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    return r.read().decode()
+
+            # -- the range endpoint: codec lines that decode
+            doc = json.loads(await asyncio.to_thread(
+                get, f"{pprof}/debug/pprof/history"))
+            assert doc["enabled"] and doc["node"] == "h0"
+            assert doc["points"] >= 4
+            recs = decode_lines(doc["lines"])
+            assert len(recs) == doc["points"]
+            assert recs[0][0] == doc["first_w"]
+            assert "tendermint_consensus_height" in recs[-1][1]
+
+            # -- per-metric decode with a real rate
+            doc = json.loads(await asyncio.to_thread(
+                get, f"{pprof}/debug/pprof/history"
+                     "?metric=tendermint_consensus_height&since=0"))
+            assert doc["metric"] == "tendermint_consensus_height"
+            assert doc["series"] and doc["rate"]
+            assert doc["series"][-1][1] >= 2        # height reached
+            # the index advertises the route; bad since is a 400
+            idx = await asyncio.to_thread(get, f"{pprof}/debug/pprof")
+            assert "/debug/pprof/history" in idx
+            with pytest.raises(urllib.error.HTTPError):
+                await asyncio.to_thread(
+                    get, f"{pprof}/debug/pprof/history?since=xyz")
+
+            # -- metrics: the recorder's own families are typed + flowing
+            mtext = await asyncio.to_thread(get, f"http://{mh}:{mp}/metrics")
+            assert ("# TYPE tendermint_history_samples_total counter"
+                    in mtext)
+            assert ("# TYPE tendermint_history_bytes_total counter"
+                    in mtext)
+            assert "tendermint_history_samples_total " in mtext
+
+            # -- CLI remote read
+            rc = await asyncio.to_thread(
+                lambda: run_history(pprof, as_json=True))
+            assert rc == 0
+            rc = await asyncio.to_thread(
+                lambda: run_history(
+                    pprof, metric="tendermint_consensus_height",
+                    rate=True, as_json=True))
+            assert rc == 0
+
+            # -- fleet --once: the burn verdict is sourced from history
+            spec = f"h0={rpc},http://{mh}:{mp},{pprof}"
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = await asyncio.to_thread(
+                    lambda: run_fleet([spec], slo_path=str(slo_path),
+                                      once=True, as_json=True, timeout=5.0))
+            fdoc = json.loads(buf.getvalue())
+            assert rc == 0, fdoc["slo"]
+            assert fdoc["slo"]["source"] == "history"
+            assert fdoc["slo"]["history"]["points"] >= 4
+            assert fdoc["slo"]["history"]["nodes"] == ["h0"]
+            assert fdoc["slo"]["objectives"][0]["state"] == "ok"
+        finally:
+            await node.stop()
+
+        # -- after stop the segments are sealed; the CLI reads the home
+        hdir = os.path.join(home, "history")
+        assert any(n.endswith(".jsonl") for n in os.listdir(hdir))
+        assert not any(n.endswith(".open") for n in os.listdir(hdir))
+        rc = run_history(home=home, as_json=True)
+        assert rc == 0
+
+    asyncio.run(run())
+
+
+def test_live_node_history_disabled_is_nop(tmp_path, monkeypatch):
+    """TM_TPU_HISTORY=0: the node carries the NOP singleton, the route
+    answers enabled=false, nothing lands on disk."""
+    from tendermint_tpu.config import test_config as make_test_config
+    from tendermint_tpu.crypto.batch import set_default_backend
+    from tendermint_tpu.crypto.keys import priv_key_from_seed
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    set_default_backend("cpu")
+    monkeypatch.setenv("TM_TPU_HISTORY", "0")
+
+    async def run():
+        key = priv_key_from_seed(b"\x92" * 32)
+        gen = GenesisDoc(
+            chain_id="history-off",
+            genesis_time_ns=1_700_000_000 * 10**9,
+            validators=[GenesisValidator(pub_key=key.pub_key(), power=10)],
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.base.fast_sync = False
+        cfg.rpc.pprof_laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg, genesis=gen)
+        node.priv_validator.priv_key = key
+        node.consensus.priv_validator = node.priv_validator
+        await node.start()
+        try:
+            assert node.history is tmhistory.NOP
+            await node.wait_for_height(2, timeout=30)
+            ph, pp = node.pprof_addr
+
+            def get(url):
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    return r.read().decode()
+
+            doc = json.loads(await asyncio.to_thread(
+                get, f"http://{ph}:{pp}/debug/pprof/history"))
+            assert doc == {"enabled": False, "points": 0}
+        finally:
+            await node.stop()
+        assert not os.path.exists(os.path.join(str(tmp_path), "history"))
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# simnet: retro SLO gate, drift oracle, determinism
+# ---------------------------------------------------------------------------
+
+
+def _retro_scenario(seed=17):
+    from tendermint_tpu.simnet.scenario import FaultOp, Scenario
+
+    sc = Scenario(
+        name="retro-slo", seed=seed, validators=4, target_height=40,
+        max_runtime_s=30.0, time="virtual", load_rate=5.0,
+        max_rounds=500, expect_min_height=2,
+        slo_objectives=[{"name": "availability", "kind": "availability",
+                         "min": 0.8, "fast_window_s": 5.0,
+                         "slow_window_s": 30.0}],
+        expect_slo="violated",
+        faults=[FaultOp(op="partition", at_height=2, nodes=[2, 3])])
+    sc.validate()
+    return sc
+
+
+def _run_sim(sc, root):
+    from tendermint_tpu.simnet.harness import run_scenario
+
+    return run_scenario(sc, str(root))
+
+
+def _history_bytes(rep):
+    return json.dumps({"history": rep["history"],
+                       "slo_history": rep["fleet"]["slo_history"]},
+                      sort_keys=True).encode()
+
+
+def test_simnet_retro_slo_gate_fails_through_history(tmp_path):
+    """ISSUE 19 simnet acceptance: a half-fleet partition must fail the
+    SLO gate through the RETROSPECTIVE path — the recorded per-node
+    serving series replayed through the true dual-window engine agrees
+    with the live sampler's verdict — and two same-seed virtual runs
+    produce byte-identical history-derived verdict JSON."""
+    rep = _run_sim(_retro_scenario(), tmp_path / "a")
+    assert rep["ok"], rep["violations"]
+    live = rep["fleet"]["slo"]
+    retro = rep["fleet"]["slo_history"]
+    assert live["state"] == "burning" and not live["ok"]
+    assert retro["source"] == "history"
+    assert retro["state"] == "burning" and not retro["ok"]
+    assert retro["points"] >= 20 and retro["nodes"] == [
+        "node0", "node1", "node2", "node3"]
+    # the verdict's history block carries every recorder's flight data
+    per_node = rep["history"]["per_node"]
+    assert set(per_node) == {"node0", "node1", "node2", "node3"}
+    assert all(b["enabled"] and b["points"] >= 20
+               for b in per_node.values())
+    # determinism: same seed, different root -> same history bytes
+    rep2 = _run_sim(_retro_scenario(), tmp_path / "b")
+    assert rep2["ok"], rep2["violations"]
+    assert _history_bytes(rep) == _history_bytes(rep2)
+
+
+def test_simnet_retro_slo_skips_without_history(tmp_path, monkeypatch):
+    """TM_TPU_HISTORY=0: recorders are the NOP singleton, the retro
+    verdict degrades to no-data (points 0) and the slo_history
+    invariant SKIPS — the gate still passes on the live sampler."""
+    monkeypatch.setenv("TM_TPU_HISTORY", "0")
+    rep = _run_sim(_retro_scenario(), tmp_path)
+    assert rep["ok"], rep["violations"]
+    assert rep["fleet"]["slo"]["state"] == "burning"
+    retro = rep["fleet"]["slo_history"]
+    assert retro["points"] == 0 and retro["state"] == "no-data"
+    assert all(b == {"enabled": False}
+               for b in rep["history"]["per_node"].values())
+
+
+def _drift_scenario(expect_health):
+    from tendermint_tpu.simnet.scenario import FaultOp, Scenario
+
+    sc = Scenario(
+        name="drift-oracle", seed=11, validators=4, target_height=30,
+        max_runtime_s=40.0, time="virtual", load_rate=5.0,
+        expect_health=list(expect_health),
+        faults=[FaultOp(op="partition", at_s=8.0, nodes=[3]),
+                FaultOp(op="heal", at_s=16.0)])
+    sc.validate()
+    return sc
+
+
+def test_simnet_metric_drift_fires_excused_and_is_load_bearing(tmp_path):
+    """A minority partition collapses the stalled node's commit-counter
+    rate against its own recorded baseline: metric_drift goes critical
+    INSIDE the declared window (excused), and a scenario that does not
+    name the detector in expect_health is rejected — the drift wiring
+    is load-bearing, not decorative."""
+    good = _run_sim(_drift_scenario(["height_stall", "metric_drift"]),
+                    tmp_path / "good")
+    assert good["ok"], good["violations"]
+    fired = [n for n, h in good["health"]["per_node"].items()
+             if "metric_drift" in h.get("critical_detectors", ())]
+    assert "node3" in fired, good["health"]["per_node"]
+    assert all(h["unexcused_criticals"] == 0
+               for h in good["health"]["per_node"].values())
+    # the verdict's history block surfaces the worst drift
+    assert good["history"]["worst_drift"]["series"]
+    # same seeded run, detector not excused -> health violation
+    bad = _run_sim(_drift_scenario(["height_stall"]), tmp_path / "bad")
+    assert not bad["ok"]
+    details = [v["detail"] for v in bad["violations"]
+               if v["invariant"] == "health"]
+    assert any("metric_drift" in d for d in details), bad["violations"]
