@@ -945,6 +945,62 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             _partial["history_overhead_error"] = str(e)[-300:]
 
+        # Race-sanitizer overhead (ISSUE 20): the tmsan cost contract,
+        # measured BEFORE the device stages like the other
+        # observability gates — an instrumented class left behind with
+        # the checker OFF costs one predictable branch per attribute
+        # access (the promise that lets instrument() stay wired into
+        # long-lived classes), and one ENABLED access (ident + held-set
+        # + lockset fold under the checker mutex) stays under a stated
+        # budget so sanitized test suites remain usable.
+        _stage_set("racecheck-overhead")
+        try:
+            from tendermint_tpu.utils import racecheck as _rc
+
+            class _Probe:
+                def __init__(self):
+                    self.x = 0
+
+            assert not _rc.CHECKER._active, (
+                "race sanitizer left active before the bench stage")
+            _rc.instrument(_Probe)
+            N_EV = 20_000
+
+            def _spin(n: int) -> float:
+                obj = _Probe()
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    obj.x = obj.x + 1  # one tracked read + one write
+                return (time.perf_counter() - t0) / (2 * n)
+
+            _spin(1_000)  # warm the wrapper path
+            disabled_ns = min(_spin(N_EV) for _ in range(3)) * 1e9
+
+            _rc.install()
+            try:
+                _spin(1_000)
+                enabled_us = min(_spin(5_000) for _ in range(3)) * 1e6
+                races = len(_rc.violations())
+            finally:
+                _rc.reset()
+                _rc.uninstall()
+            _rc.uninstrument(_Probe)
+            budget_us = 25.0  # per tracked access, single-thread
+            _partial.update({
+                "racecheck_disabled_ns_per_attr": round(disabled_ns, 1),
+                "racecheck_enabled_us_per_attr": round(enabled_us, 3),
+                "racecheck_budget_us_per_attr": budget_us,
+                "racecheck_within_budget": bool(enabled_us <= budget_us),
+            })
+            assert races == 0, "single-thread probe raced?"
+            assert enabled_us <= budget_us, (
+                f"racecheck {enabled_us:.2f}us/access exceeds {budget_us}us")
+            assert disabled_ns <= 5_000, (
+                f"disabled racecheck branch costs {disabled_ns:.0f}ns "
+                "per access — the NOP contract regressed")
+        except Exception as e:  # noqa: BLE001
+            _partial["racecheck_overhead_error"] = str(e)[-300:]
+
         if platform == "cpu":
             # XLA-CPU device path: diagnostic only (trend tracking), at a
             # reduced batch; NOTHING here — including the import and the

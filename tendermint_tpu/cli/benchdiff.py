@@ -103,6 +103,7 @@ _CLASS_RULES = (
     (re.compile(r"(_ns_per_event|_us_per_event|_ns_per_flush"
                 r"|_us_per_flush|_ns_per_stamp|_us_per_stamp"
                 r"|_ns_per_sample|_us_per_sample"
+                r"|_ns_per_attr|_us_per_attr"
                 r"|_ns_per_transition|_us_per_transition)$"),
      "latency", "lower"),
     (re.compile(r"(_seconds|_s)$"), "timing", "lower"),

@@ -155,12 +155,23 @@ class VerifiedSigCache:
     re-verified on every appearance, so a corrupted signature cannot be
     cached as valid no matter what races occur."""
 
-    def __init__(self, maxsize: int):
-        self.maxsize = maxsize
+    def __init__(self, maxsize: int | None = None):
+        # None = resolve TM_TPU_VERIFY_CACHE at every probe, so a value
+        # set AFTER the process-wide service was built still takes
+        # effect (the construction-time capture was half of the
+        # order-dependent test_multinode flake — the pinned-threshold
+        # half lives in crypto/batch.py)
+        self._pinned_maxsize = maxsize
         self._d: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+
+    @property
+    def maxsize(self) -> int:
+        if self._pinned_maxsize is not None:
+            return self._pinned_maxsize
+        return _env_int("TM_TPU_VERIFY_CACHE", DEFAULT_CACHE_SIZE)
 
     @staticmethod
     def key(pub: bytes, msg: bytes, sig: bytes) -> tuple:
@@ -168,7 +179,7 @@ class VerifiedSigCache:
 
     def get(self, key) -> bool:
         if self.maxsize <= 0:
-            self.misses += 1
+            self.misses += 1  # tmsan: shared=diagnostic counter on the disabled-cache path; tolerates lost updates
             return False
         with self._lock:
             if key in self._d:
@@ -199,12 +210,10 @@ class VerifyService:
     def __init__(self, *, linger_ms: float | None = None,
                  cache_size: int | None = None,
                  cpu_threshold: int | None = None):
-        self.linger_s = (linger_ms if linger_ms is not None
-                         else _env_float("TM_TPU_LINGER_MS",
-                                         DEFAULT_LINGER_MS)) / 1e3
-        self.cache = VerifiedSigCache(
-            cache_size if cache_size is not None
-            else _env_int("TM_TPU_VERIFY_CACHE", DEFAULT_CACHE_SIZE))
+        # linger/cache sizing resolve their env knobs lazily when not
+        # pinned by a ctor arg — see VerifiedSigCache.maxsize
+        self._pinned_linger_ms = linger_ms
+        self.cache = VerifiedSigCache(cache_size)
         self._cv = threading.Condition()
         self._queue: deque[_Request] = deque()
         self._worker: threading.Thread | None = None
@@ -221,7 +230,7 @@ class VerifyService:
         }
         # last (path, reason) the router chose — tests assert the
         # routing DECISION (pinned vs sharded), not just the verdicts
-        self.last_route: tuple[str, str] | None = None
+        self.last_route: tuple[str, str] | None = None  # tmsan: shared=atomic tuple rebind, last-write-wins diagnostic
         # the threshold/readiness arbitration reuses JAXBatchVerifier's
         # lazy measurement machinery; on a jax-less box every flush
         # routes to the host path
@@ -243,6 +252,12 @@ class VerifyService:
                 _sp.start_background_warm("verify-service-start")
             except Exception:  # noqa: BLE001 — warm is best-effort
                 pass
+
+    @property
+    def linger_s(self) -> float:
+        ms = (self._pinned_linger_ms if self._pinned_linger_ms is not None
+              else _env_float("TM_TPU_LINGER_MS", DEFAULT_LINGER_MS))
+        return ms / 1e3
 
     # -- submission (caller side; never blocks) -----------------------
 
@@ -390,7 +405,7 @@ class VerifyService:
         counters could never answer)."""
         t0 = time.perf_counter()
         path, reason = self._route(reqs, inflight)
-        self.last_route = (path, reason)
+        self.last_route = (path, reason)  # tmsan: shared=atomic tuple rebind, last-write-wins diagnostic
         if _trace.enabled():
             _trace.record("verify.flush", t0, time.perf_counter() - t0,
                           path=path, reason=reason, n=len(reqs))

@@ -229,7 +229,7 @@ class VerifyCoalescer:
             return self._verify_fn
         from tendermint_tpu.types.validator import batch_verify_commits
 
-        self._verify_fn = batch_verify_commits
+        self._verify_fn = batch_verify_commits  # tmsan: shared=idempotent lazy bind; racing writers store the same callable
         return self._verify_fn
 
     def _flush(self, batch: list[_Entry]) -> None:

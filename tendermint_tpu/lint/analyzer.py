@@ -1,6 +1,6 @@
 """AST analyzer behind `tendermint-tpu lint`.
 
-Seven rules, each motivated by a shipped bug or a hot-path invariant:
+Eleven rules, each motivated by a shipped bug or a hot-path invariant:
 
   import-time-env          Module-level `os.environ` reads freeze config
                            before tests/operators can set it (the PR 3
@@ -32,6 +32,23 @@ Seven rules, each motivated by a shipped bug or a hot-path invariant:
   metric-name-conformance  Counter series must end `_total`, gauges must
                            not, duplicate metric names, and unbounded
                            ("high-cardinality") label names.
+  unguarded-shared-mutation  `self.X = ...` outside __init__ and outside
+                           a `with <lock>:` block in classes that spawn
+                           threads or are registered thread-shared —
+                           the static half of utils/racecheck's lockset
+                           sanitizer (same bug class, caught at lint
+                           time; `# tmsan: shared=REASON` justifies).
+  blocking-call-in-async   time.sleep / Lock.acquire / socket reads in
+                           `async def` — stalls the event loop, and the
+                           simnet's virtual clock rides the loop.
+  thread-lifecycle         Thread() without an explicit daemon= — the
+                           lifecycle (daemonize, or stop/join seam)
+                           must be a decision, not a default.
+  env-knob-registry        literal TM_TPU_* environ read whose name is
+                           missing from the utils/knobs registry — the
+                           docs/observability.md env table is generated
+                           from that registry, so an unregistered knob
+                           is an undocumented knob.
 
 Suppressions: ``# tmlint: disable=RULE[,RULE...]`` (or ``disable=all``)
 on the flagged line or on a comment line directly above it;
@@ -83,6 +100,23 @@ RULES: dict[str, str] = {
     "metric-name-conformance":
         "counter not ending _total, gauge/histogram ending _total, "
         "duplicate metric name, or high-cardinality label name",
+    "unguarded-shared-mutation":
+        "bare `self.X = ...` outside `__init__` and outside a "
+        "`with self._lock:` block in a class that spawns threads or is "
+        "registered thread-shared (racecheck.SHARED_CLASSES) — guard it "
+        "or justify with `# tmsan: shared=REASON`",
+    "blocking-call-in-async":
+        "blocking call (time.sleep, Lock.acquire, socket recv/accept/"
+        "sendall/connect) inside `async def` — stalls the event loop "
+        "(and the virtual clock: vclock ticks ride the loop)",
+    "thread-lifecycle":
+        "threading.Thread(...) without an explicit daemon= — an "
+        "implicit non-daemon thread with no stop/join seam hangs "
+        "interpreter shutdown; decide the lifecycle explicitly",
+    "env-knob-registry":
+        "literal TM_TPU_* environ read of a name missing from the "
+        "utils/knobs registry — register it (name, default, doc line) "
+        "so the generated docs/observability.md table stays complete",
 }
 
 #: top-level packages that must never be imported eagerly (the minimal
@@ -147,6 +181,36 @@ _HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _SUPPRESS_RE = re.compile(r"#\s*tmlint:\s*disable=([A-Za-z\-, ]+)")
 _SUPPRESS_FILE_RE = re.compile(r"#\s*tmlint:\s*disable-file=([A-Za-z\-, ]+)")
 
+#: the runtime sanitizer's allowlist comment doubles as this linter's
+#: suppression for unguarded-shared-mutation (one justification, both
+#: halves honor it)
+_TMSAN_RE = re.compile(r"#\s*tmsan:\s*shared=\S")
+_KNOB_NAME_RE = re.compile(r"TM_TPU_[A-Z0-9_]+")
+
+#: receiver names that look like a mutex/condition (the
+#: `with self._lock:` convention family)
+_LOCKISH_RE = re.compile(r"lock|mtx|mutex|cond|(^|_)cv($|_)", re.IGNORECASE)
+
+#: socket methods that block the calling thread
+_BLOCKING_SOCK_METHODS = {"recv", "recvfrom", "recv_into", "accept",
+                          "sendall", "connect"}
+
+#: methods that run before any thread can be spawned on the instance
+_CTOR_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _shared_class_names() -> frozenset[str]:
+    """Class names the runtime sanitizer registers as thread-shared —
+    imported from the one registry so the static and dynamic halves
+    never drift."""
+    from tendermint_tpu.utils.racecheck import SHARED_CLASS_NAMES
+    return SHARED_CLASS_NAMES
+
+
+def _known_knobs() -> frozenset[str]:
+    from tendermint_tpu.utils.knobs import KNOWN
+    return KNOWN
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -182,12 +246,19 @@ class FileContext:
         self.obs_definition = (
             path.name in OBSERVABILITY_DEF_FILES
             or f"{path.parent.name}/{path.name}" in OBSERVABILITY_DEF_FILES)
+        # the knob registry itself defines the names; its own literal
+        # reads are the implementation, not call sites
+        self.is_knob_registry = (
+            f"{path.parent.name}/{path.name}" == "utils/knobs.py")
         self._line_suppressions: dict[int, set[str]] = {}
         self._file_suppressions: set[str] = set()
+        self._tmsan_lines: set[int] = set()
         self._scan_suppressions(source)
 
     def _scan_suppressions(self, source: str) -> None:
         for i, line in enumerate(source.splitlines(), start=1):
+            if _TMSAN_RE.search(line):
+                self._tmsan_lines.add(i)
             m = _SUPPRESS_FILE_RE.search(line)
             if m:
                 self._file_suppressions.update(_parse_rule_list(m.group(1)))
@@ -209,6 +280,11 @@ class FileContext:
             return True
         rules = self._line_suppressions.get(line, ())
         return rule in rules or "all" in rules
+
+    def tmsan_allowed(self, line: int) -> bool:
+        """`# tmsan: shared=REASON` on the flagged line: the runtime
+        allowlist justification suppresses the static rule too."""
+        return line in self._tmsan_lines
 
 
 def _parse_rule_list(raw: str) -> set[str]:
@@ -319,6 +395,33 @@ class _St:
     gated: bool = False       # inside an `if ...enabled...:` guard
     optguard: bool = False    # inside try/except-ImportError or TYPE_CHECKING
     in_jit: bool = False      # inside a function handed to jax.jit
+    in_async: bool = False    # inside an `async def` body
+    in_await: bool = False    # directly under an `await` expression
+    shared_cls: str = ""      # enclosing thread-shared class name, or ""
+    in_ctor: bool = False     # inside __init__/__new__/__post_init__
+    locked: bool = False      # inside a `with <lock-ish>:` block
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """`self._lock` / `self._cv` / `_REG_LOCK` / `state.mtx` — a context
+    expression that names a mutex by convention."""
+    if isinstance(expr, ast.Attribute):
+        return bool(_LOCKISH_RE.search(expr.attr))
+    if isinstance(expr, ast.Name):
+        return bool(_LOCKISH_RE.search(expr.id))
+    if isinstance(expr, ast.Call):
+        return _is_lockish(expr.func)
+    return False
+
+
+def _class_spawns_thread(node: ast.ClassDef) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if (isinstance(f, ast.Name) and f.id == "Thread") or \
+                    (isinstance(f, ast.Attribute) and f.attr == "Thread"):
+                return True
+    return False
 
 
 def _test_mentions_enabled(test: ast.AST) -> bool:
@@ -413,17 +516,53 @@ class _Walker:
             for dflt in list(args.defaults) + [d for d in args.kw_defaults if d]:
                 self._walk(dflt, st)
             in_jit = st.in_jit or node.name in self.jit_targets
+            # a method directly in a thread-shared class body: __init__
+            # et al. run before the object escapes to other threads, so
+            # their writes are construction, not shared mutation.  A def
+            # nested inside a function (closure, thread target) executes
+            # later — never construction, and any `with lock:` held at
+            # definition time is not held at call time.
+            in_ctor = (not st.runtime and bool(st.shared_cls)
+                       and node.name in _CTOR_METHODS)
+            # `*_locked` suffix is the repo convention for "caller holds
+            # the instance lock" — the static rule honors it; lockcheck/
+            # racecheck verify it at runtime
             self._walk_body(node.body, dataclasses.replace(
-                st, runtime=True, gated=False, in_jit=in_jit))
+                st, runtime=True, gated=False, in_jit=in_jit,
+                in_async=isinstance(node, ast.AsyncFunctionDef),
+                in_ctor=in_ctor, locked=node.name.endswith("_locked"),
+                in_await=False))
             return
         if isinstance(node, ast.Lambda):
-            self._walk(node.body, dataclasses.replace(st, runtime=True))
+            self._walk(node.body, dataclasses.replace(
+                st, runtime=True, locked=False, in_ctor=False))
             return
         if isinstance(node, ast.ClassDef):
             for dec in node.decorator_list:
                 self._walk(dec, st)
-            self._walk_body(node.body, st)  # class body runs at import
+            shared = node.name if (node.name in _shared_class_names()
+                                   or _class_spawns_thread(node)) else ""
+            self._walk_body(node.body, dataclasses.replace(
+                st, shared_cls=shared))  # class body runs at import
             return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            locked = st.locked
+            for item in node.items:
+                self._walk(item.context_expr, st)
+                if item.optional_vars is not None:
+                    self._walk(item.optional_vars, st)
+                if _is_lockish(item.context_expr):
+                    locked = True
+            self._walk_body(node.body, dataclasses.replace(
+                st, locked=locked))
+            return
+        if isinstance(node, ast.Await):
+            # `await lock.acquire()` on an asyncio primitive yields, it
+            # does not block the loop — exempt the awaited call
+            self._walk(node.value, dataclasses.replace(st, in_await=True))
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._check_shared_mutation(node, st)
         if isinstance(node, ast.If):
             if _is_type_checking(node.test):
                 self._walk_body(node.body, dataclasses.replace(
@@ -455,6 +594,8 @@ class _Walker:
             self._check_env_subscript(node, st)
         elif isinstance(node, ast.Compare):
             self._check_env_compare(node, st)
+        elif isinstance(node, ast.Constant):
+            self._check_knob_literal(node)
         for child in ast.iter_child_nodes(node):
             self._walk(child, st)
 
@@ -508,6 +649,26 @@ class _Walker:
                 self._report(node, "import-time-env",
                              self._env_read_msg("`in os.environ` check"))
 
+    # -- rule: env-knob-registry ----------------------------------------
+
+    def _check_knob_literal(self, node: ast.Constant) -> None:
+        """Any whole-string literal that *is* a TM_TPU_* name must be a
+        registered knob.  This catches the read sites
+        (os.environ.get/getenv/[...]/ `in os.environ`) and the
+        ``ENV_FLAG = "TM_TPU_X"`` constant idiom with one check — the
+        name appears as an exact string literal exactly once either
+        way.  Prose mentions inside longer strings do not match."""
+        if self.ctx.is_knob_registry:
+            return
+        v = node.value
+        if isinstance(v, str) and _KNOB_NAME_RE.fullmatch(v) \
+                and v not in _known_knobs():
+            self._report(
+                node, "env-knob-registry",
+                f"env knob {v!r} is not registered in utils/knobs.py — "
+                "add a Knob(name, default, doc, subsystem) entry so the "
+                "generated table in docs/observability.md stays complete")
+
     def _check_env_call(self, node: ast.Call, st: _St) -> None:
         if st.runtime:
             return
@@ -521,11 +682,91 @@ class _Walker:
                 self._report(node, "import-time-env",
                              self._env_read_msg("os.getenv()"))
 
+    # -- rule: unguarded-shared-mutation ---------------------------------
+
+    def _check_shared_mutation(self, node: ast.stmt, st: _St) -> None:
+        """`self.X = ...` rebind in a method of a thread-shared class,
+        outside __init__ and outside a `with <lock>:` block.  Container
+        mutation (self.d[k] = v) is out of scope — the attribute binding
+        itself does not change; the runtime sanitizer owns that
+        granularity.  `async def` bodies are exempt: coroutine methods
+        of one object interleave on one event loop at awaits — loop
+        confinement, not locksets, is their discipline."""
+        if not (st.shared_cls and st.runtime) or st.in_ctor or st.locked \
+                or st.in_async:
+            return
+        if isinstance(node, ast.AugAssign):
+            targets: list[ast.expr] = [node.target]
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is None:
+                return  # bare annotation, no write
+            targets = [node.target]
+        else:
+            targets = list(node.targets)
+        flat: list[ast.expr] = []
+        for t in targets:
+            flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t])
+        for t in flat:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                if self.ctx.tmsan_allowed(getattr(node, "lineno", 1)):
+                    continue
+                self._report(
+                    node, "unguarded-shared-mutation",
+                    f"write to self.{t.attr} in thread-shared class "
+                    f"{st.shared_cls} outside __init__ and outside a "
+                    "`with <lock>:` block — take the instance lock, or "
+                    "annotate the line `# tmsan: shared=REASON` with the "
+                    "invariant that makes the unlocked write safe")
+
     # -- rules on calls --------------------------------------------------
 
     def _check_call(self, node: ast.Call, st: _St) -> None:
         self._check_env_call(node, st)
         func = node.func
+
+        # thread-lifecycle: every Thread() must pin daemon= explicitly
+        # so shutdown semantics are a decision, not an accident
+        is_thread_ctor = (
+            (isinstance(func, ast.Name) and func.id == "Thread")
+            or (isinstance(func, ast.Attribute) and func.attr == "Thread"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("threading", "_threading")))
+        if is_thread_ctor:
+            kw_names = {k.arg for k in node.keywords}
+            if "daemon" not in kw_names and None not in kw_names:
+                self._report(
+                    node, "thread-lifecycle",
+                    "Thread(...) without an explicit daemon= — decide "
+                    "shutdown semantics at the spawn site (daemon=True "
+                    "for samplers, daemon=False + join() for writers)")
+
+        # blocking-call-in-async
+        if st.in_async and isinstance(func, ast.Attribute):
+            recv = func.value
+            recv_name = recv.attr if isinstance(recv, ast.Attribute) \
+                else (recv.id if isinstance(recv, ast.Name) else "")
+            if recv_name == "time" and func.attr == "sleep":
+                self._report(
+                    node, "blocking-call-in-async",
+                    "time.sleep() inside `async def` stalls the event "
+                    "loop — use `await asyncio.sleep()`")
+            elif func.attr == "acquire" and not st.in_await \
+                    and _LOCKISH_RE.search(recv_name):
+                self._report(
+                    node, "blocking-call-in-async",
+                    f"{recv_name}.acquire() inside `async def` without "
+                    "await — a threading lock blocks the loop; use an "
+                    "asyncio primitive or run_in_executor")
+            elif func.attr in _BLOCKING_SOCK_METHODS and not st.in_await \
+                    and re.search(r"sock|conn", recv_name, re.IGNORECASE):
+                self._report(
+                    node, "blocking-call-in-async",
+                    f"blocking socket call {recv_name}.{func.attr}() "
+                    "inside `async def` — use the loop's sock_* "
+                    "coroutines or a stream reader/writer")
 
         # ungated-observability
         if not self.ctx.obs_definition and isinstance(func, ast.Attribute):

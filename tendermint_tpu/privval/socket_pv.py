@@ -185,13 +185,13 @@ class SignerClient:
     def start(self) -> tuple[str, int]:
         """Start the I/O thread and listen; returns the bound address."""
         self._thread.start()
-        self.addr = self._submit(self._listen())
+        self.addr = self._submit(self._listen())  # tmsan: shared=owner-thread setup before the address escapes
         return self.addr
 
     def wait_for_signer(self, timeout: float = 30.0) -> None:
         """Block until a signer dials in and the pubkey is primed."""
         self._submit(self._wait_connected(timeout), timeout=timeout + 5)
-        self._cached_pub = self._submit(self._get_pub_key())
+        self._cached_pub = self._submit(self._get_pub_key())  # tmsan: shared=owner-thread prime; loop side only reads
 
     def close(self) -> None:
         if not self._thread.is_alive():

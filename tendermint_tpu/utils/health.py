@@ -819,16 +819,18 @@ class HealthMonitor:
         directly; the background thread is just a loop over it."""
         now = self._clock()
         s: dict = {"t": now}
+        new_errors = 0
         for pname, probe in self.probes.items():
             try:
                 got = probe()
                 if got:
                     s.update(got)
             except Exception as e:  # noqa: BLE001 — dead probe != dead node
-                self.probe_errors += 1
+                new_errors += 1
                 s.setdefault("probe_errors", {})[pname] = repr(e)
         fired: list[tuple[Detector, dict]] = []
         with self._lock:
+            self.probe_errors += new_errors
             if self._extras:
                 s.update(self._extras)
                 self._extras = {}
@@ -917,8 +919,9 @@ class HealthMonitor:
                 except Exception as e:  # noqa: BLE001 — watchdog survives
                     _log.warning("health sample failed: %r", e)
 
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name=f"health-{self.node or 'node'}")
+        self._thread = threading.Thread(  # tmsan: shared=owner-thread lifecycle handle; sampler never reads _thread
+            target=loop, daemon=True,
+            name=f"health-{self.node or 'node'}")
         self._thread.start()
 
     def stop(self, timeout: float = 1.0) -> None:
@@ -926,7 +929,7 @@ class HealthMonitor:
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout)
-        self._thread = None
+        self._thread = None  # tmsan: shared=owner-thread lifecycle handle; sampler never reads _thread
 
     # -- views ----------------------------------------------------------
 

@@ -349,7 +349,7 @@ class HistoryRecorder:
             self.dropped_series += dropped
             if self.dir:
                 try:
-                    self._append_disk(w, state)
+                    self._append_disk_locked(w, state)
                 except OSError as e:
                     self.errors += 1
                     _log.warning("history append failed: %r", e)
@@ -379,7 +379,7 @@ class HistoryRecorder:
         except OSError as e:
             _log.warning("history recover failed: %r", e)
 
-    def _append_disk(self, w: int, state: dict) -> None:
+    def _append_disk_locked(self, w: int, state: dict) -> None:
         if self._fh is None:
             self._seg_path = os.path.join(self.dir, f"seg-{w}.jsonl.open")
             self._fh = open(self._seg_path, "a", encoding="utf-8")
@@ -501,10 +501,10 @@ class HistoryRecorder:
         may call far more often than the sampler appends."""
         with self._lock:
             recs = list(self._tail)
+            cached = self._drift_cache
         if len(recs) < DRIFT_WINDOW_POINTS * (DRIFT_MIN_BASELINES + 1) + 1:
             return {}
         head_w = recs[-1][0]
-        cached = self._drift_cache
         if cached is not None and cached[0] == head_w:
             return cached[1]
         worst = None
@@ -559,7 +559,7 @@ class HistoryRecorder:
                 except Exception as e:  # noqa: BLE001 — recorder survives
                     _log.warning("history sample failed: %r", e)
 
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # tmsan: shared=owner-thread lifecycle handle; sampler never reads _thread
             target=loop, daemon=True,
             name=f"history-{self.node or 'node'}")
         self._thread.start()
@@ -569,7 +569,7 @@ class HistoryRecorder:
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout)
-        self._thread = None
+        self._thread = None  # tmsan: shared=owner-thread lifecycle handle; sampler never reads _thread
         with self._lock:
             if self._fh is not None:
                 try:
@@ -615,8 +615,9 @@ class HistoryRecorder:
         state: same records -> same report)."""
         with self._lock:
             recs = list(self._tail)
+            n_samples = self.samples
         out = {"enabled": True, "node": self.node, "points": len(recs),
-               "samples": self.samples}
+               "samples": n_samples}
         if recs:
             out["first_w"] = recs[0][0]
             out["last_w"] = recs[-1][0]

@@ -234,6 +234,28 @@ violations = CHECKER.violations
 check = CHECKER.check
 
 
+def current_held() -> tuple[str, ...]:
+    """Creation-sites of the locks held by the calling thread, outermost
+    first.  Empty when the checker is inactive or nothing is held.  This
+    is the bridge the racecheck sanitizer uses to compute candidate
+    locksets: a shared-field access is considered guarded by exactly the
+    sites returned here at the moment of the access."""
+    if not CHECKER._active:
+        return ()
+    return tuple(CHECKER._held())
+
+
+def wrap_existing(lock, site: str) -> "_CheckedLock":
+    """Wrap an already-created lock so its acquisitions feed the held-set
+    and order graph.  install() only sees locks created *after* it runs;
+    module-level locks (devmon's STATS lock, shape_plan's registry lock)
+    predate any test fixture, so racecheck re-binds them through this at
+    instrument time.  Idempotent on already-wrapped locks."""
+    if isinstance(lock, _CheckedLock):
+        return lock
+    return _CheckedLock(lock, CHECKER, site)
+
+
 def maybe_install_from_env() -> bool:
     """Install when TM_TPU_LOCKCHECK is set truthy; returns whether the
     checker is installed.  Call early (conftest) — only locks created

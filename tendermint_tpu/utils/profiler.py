@@ -454,7 +454,7 @@ class Profiler:
                 os.makedirs(self.device_dir, exist_ok=True)
                 with jax.profiler.trace(self.device_dir):
                     time.sleep(self.device_capture_s)
-                self.device_captures += 1
+                self.device_captures += 1  # tmsan: shared=diagnostic counter; captures serialized by the trigger min-interval
                 _log.info("device capture (%s) -> %s", reason,
                           self.device_dir)
             except Exception as e:  # noqa: BLE001 — forensics never fatal
@@ -479,8 +479,8 @@ class Profiler:
                 except Exception as e:  # noqa: BLE001 — sampler survives
                     _log.warning("profile sample failed: %r", e)
 
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name=f"prof-{self.node or 'node'}")
+        self._thread = threading.Thread(  # tmsan: shared=owner-thread lifecycle handle; sampler never reads _thread
+            target=loop, daemon=True, name=f"prof-{self.node or 'node'}")
         self._thread.start()
 
     def stop(self, timeout: float = 1.0) -> None:
@@ -488,7 +488,7 @@ class Profiler:
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout)
-        self._thread = None
+        self._thread = None  # tmsan: shared=owner-thread lifecycle handle; sampler never reads _thread
 
     # -- views ----------------------------------------------------------
 
@@ -555,8 +555,10 @@ class Profiler:
         by_sub = out["by_subsystem"]
         out["top_subsystem"] = (max(sorted(by_sub), key=by_sub.get)
                                 if by_sub else None)
-        if self._last_trigger_reason:
-            out["last_trigger"] = self._last_trigger_reason
+        with self._lock:
+            reason = self._last_trigger_reason
+        if reason:
+            out["last_trigger"] = reason
         return out
 
 

@@ -1,0 +1,445 @@
+"""tmsan: Eraser-style lockset race sanitizer for the threaded node.
+
+lockcheck (PR 5) catches lock-order inversions — deadlocks between locks
+that both exist.  It says nothing about the dual failure: shared state
+touched with NO lock at all.  Every concurrency bug shipped so far (the
+PR 11 remediation transition race, PR 15's liveness bugs, the
+order-dependent multinode flake) was unguarded shared state found at
+runtime by accident.  This module finds that class mechanically, the way
+the reference's Go CI rides `-race`.
+
+Algorithm — the classic Eraser lockset state machine, per (object,
+field):
+
+  VIRGIN --first access--> EXCLUSIVE   (one thread; covers __init__
+                                        writes before any spawn)
+  EXCLUSIVE --2nd-thread read--> SHARED          (refine, never report)
+  EXCLUSIVE --2nd-thread write--> SHARED-MODIFIED
+  SHARED --any write--> SHARED-MODIFIED
+
+On entering SHARED the candidate lockset C(v) is initialised to the
+locks the accessing thread holds *right now* (per lockcheck's held-set);
+every later access from any thread refines C(v) by intersection.  A
+field in SHARED-MODIFIED whose lockset goes empty is a race: no single
+lock consistently guarded a field written from >= 2 threads.  The report
+carries compact creation-site stacks for BOTH conflicting accesses.
+
+Instrumentation is a class patch (:func:`instrument`, usable as a
+decorator): ``__setattr__``/``__getattribute__`` are wrapped so every
+instance-field write and read funnels through the checker.  One branch
+(``_active``) when the checker is not installed, so instrumented classes
+left behind cost a single predictable comparison — bench.py pins this.
+
+Known-benign fields are allowlisted in source::
+
+    self.last_route = route  # tmsan: shared=last-write-wins diagnostic
+
+The comment is scanned from the class source at instrument() time (and
+doubles as a suppression for tmlint's static `unguarded-shared-mutation`
+rule).  Allowlisted races still appear in :func:`report` under
+``"allowed"`` — visible, just not fatal.
+
+Opt-in, two ways (mirrors lockcheck):
+  * TM_TPU_RACECHECK=1 + :func:`maybe_install_from_env` (tests/conftest
+    calls it: the whole suite runs sanitized);
+  * :func:`install` + :func:`instrument_defaults` directly — the
+    async_verify/multinode/health/history/remediate test modules do this
+    from autouse fixtures and assert :func:`check` clean at teardown.
+
+Honest limits:
+  * granularity is the attribute *binding* — mutating a dict/list held
+    in a field (``self.stats["n"] += 1``) is invisible; the containers
+    that matter in-tree are mutated under locks the lockset DOES see;
+  * locks created before lockcheck installed are invisible, which would
+    make properly-guarded fields look naked — instrument_defaults()
+    re-binds the known module-level locks (devmon, shape_plan, batch)
+    through lockcheck.wrap_existing so their holders count;
+  * object identity is ``id()`` — a recycled id could merge two
+    objects' histories; tests are short-lived, accepted;
+  * reads of names defined on the class (methods, properties, class
+    defaults) are skipped for speed — writes are always tracked, so
+    write/write races on shadowed defaults still report.
+"""
+
+from __future__ import annotations
+
+import _thread
+import inspect
+import os
+import re
+import sys
+import threading
+
+from tendermint_tpu.utils import lockcheck as _lockcheck
+
+ENV_FLAG = "TM_TPU_RACECHECK"
+
+#: comment grammar shared with tmlint's unguarded-shared-mutation rule
+_ALLOW_RE = re.compile(r"self\.(\w+)[^#\n]*#\s*tmsan:\s*shared=([^\n]+)")
+
+#: the thread-shared classes instrument_defaults() patches.  tmlint's
+#: unguarded-shared-mutation rule treats these names as thread-shared
+#: even when the class body spawns no thread itself.
+SHARED_CLASSES: tuple[tuple[str, str], ...] = (
+    ("tendermint_tpu.crypto.async_verify", "VerifyService"),
+    ("tendermint_tpu.crypto.async_verify", "VerifiedSigCache"),
+    ("tendermint_tpu.utils.health", "HealthMonitor"),
+    ("tendermint_tpu.utils.remediate", "RemediationController"),
+    ("tendermint_tpu.utils.history", "HistoryRecorder"),
+    ("tendermint_tpu.utils.profiler", "Profiler"),
+    ("tendermint_tpu.p2p.backoff", "DialBackoff"),
+    ("tendermint_tpu.consensus.peer_state", "PeerState"),
+    ("tendermint_tpu.consensus.peer_state", "PeerRoundState"),
+    ("tendermint_tpu.utils.devmon", "DeviceStats"),
+    ("tendermint_tpu.utils.devmon", "CompileTracker"),
+    ("tendermint_tpu.ops.shape_plan", "AotEntry"),
+)
+
+SHARED_CLASS_NAMES = frozenset(name for _, name in SHARED_CLASSES)
+
+#: module-level locks created at import time — invisible to lockcheck's
+#: factory patch, so instrument_defaults() re-binds them wrapped.
+_MODULE_LOCKS: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("tendermint_tpu.utils.devmon", ()),            # instance locks, below
+    ("tendermint_tpu.ops.shape_plan",
+     ("_ACTIVE_LOCK", "_REG_LOCK", "_BG_LOCK")),
+    ("tendermint_tpu.crypto.batch", ("_MEASURE_LOCK", "_FLAG_LOCK")),
+)
+
+
+class RaceError(AssertionError):
+    """Raised by check() when unallowlisted races were recorded."""
+
+
+def _stack(limit: int = 14) -> tuple[str, ...]:
+    """Compact file:line:func frames of the caller, racecheck elided."""
+    frames: list[str] = []
+    f = sys._getframe(1)
+    while f is not None and len(frames) < limit:
+        co = f.f_code
+        base = os.path.basename(co.co_filename)
+        if base != "racecheck.py":
+            frames.append(f"{base}:{f.f_lineno}:{co.co_name}")
+        f = f.f_back
+    return tuple(frames)
+
+
+class _FieldState:
+    __slots__ = ("owner", "cls", "field", "shared", "modified", "lockset",
+                 "last_write", "last_read", "threads", "reported")
+
+    def __init__(self, owner: int, cls: str, field: str):
+        self.owner = owner
+        self.cls = cls
+        self.field = field
+        self.shared = False
+        self.modified = False
+        self.lockset: frozenset[str] = frozenset()
+        self.last_write: tuple | None = None   # (ident, name, op, stack)
+        self.last_read: tuple | None = None
+        self.threads: dict[int, str] = {}
+        self.reported = False
+
+
+class Race:
+    __slots__ = ("cls", "field", "threads", "access", "other", "reason")
+
+    def __init__(self, cls, field, threads, access, other, reason=None):
+        self.cls = cls
+        self.field = field
+        self.threads = threads
+        self.access = access           # (thread-name, op, stack)
+        self.other = other             # (thread-name, op, stack) | None
+        self.reason = reason           # allowlist justification | None
+
+    def describe(self) -> str:
+        name, op, stack = self.access
+        lines = [f"race on {self.cls}.{self.field}: {op} from thread "
+                 f"{name!r} with empty lockset (threads: "
+                 f"{', '.join(sorted(self.threads))})",
+                 "  this access:"]
+        lines += [f"    {fr}" for fr in stack[:8]]
+        if self.other is not None:
+            oname, oop, ostack = self.other
+            lines.append(f"  conflicting {oop} from thread {oname!r}:")
+            lines += [f"    {fr}" for fr in ostack[:8]]
+        return "\n".join(lines)
+
+    def as_dict(self) -> dict:
+        d = {"class": self.cls, "field": self.field,
+             "threads": sorted(self.threads),
+             "access": {"thread": self.access[0], "op": self.access[1],
+                        "stack": list(self.access[2])}}
+        if self.other is not None:
+            d["other"] = {"thread": self.other[0], "op": self.other[1],
+                          "stack": list(self.other[2])}
+        if self.reason is not None:
+            d["reason"] = self.reason
+        return d
+
+
+class RaceChecker:
+    """Process-wide lockset state over instrumented classes."""
+
+    def __init__(self):
+        # raw C lock: must never route through lockcheck's factory patch
+        # (the checker's own mutex is bookkeeping, not program state)
+        self._mtx = _thread.allocate_lock()
+        self._state: dict[tuple[int, str], _FieldState] = {}
+        self._violations: list[Race] = []
+        self._allowed: list[Race] = []
+        self._allow: dict[tuple[str | None, str], str] = {}
+        self._instrumented: dict[type, tuple] = {}
+        self._active = False
+        self._depth = 0
+
+    # -- core: one attribute access -------------------------------------
+
+    def _note(self, obj, field: str, op: str) -> None:
+        t = _thread.get_ident()
+        held = _lockcheck.current_held()
+        key = (id(obj), field)
+        with self._mtx:
+            st = self._state.get(key)
+            if st is None:
+                st = _FieldState(t, type(obj).__name__, field)
+                self._state[key] = st
+                if op == "write":
+                    st.last_write = self._access(t, op)
+                    st.threads[t] = st.last_write[1]
+                return
+            if not st.shared:
+                if t == st.owner:
+                    # exclusive fast path: reads free, writes keep the
+                    # most recent stack for a future report's far side
+                    if op == "write":
+                        st.last_write = self._access(t, op)
+                        st.threads[t] = st.last_write[1]
+                    return
+                st.shared = True
+                st.lockset = frozenset(held)
+            else:
+                st.lockset = st.lockset & frozenset(held)
+            if op == "write":
+                st.modified = True
+            acc = self._access(t, op)
+            # snapshot the far side BEFORE this access overwrites it, so
+            # a report carries the conflicting thread's stack
+            prev = (st.last_write, st.last_read)
+            if op == "write":
+                st.last_write = acc
+            elif st.last_read is None or st.last_read[0] != t:
+                st.last_read = acc
+            st.threads[t] = acc[1]
+            if st.modified and not st.lockset and not st.reported:
+                st.reported = True
+                self._report(st, acc, prev)
+
+    def _access(self, ident: int, op: str) -> tuple:
+        return (ident, threading.current_thread().name, op, _stack())
+
+    def _report(self, st: _FieldState, acc: tuple, prev: tuple) -> None:
+        other = None
+        for cand in prev:
+            if cand is not None and cand[0] != acc[0]:
+                other = (cand[1], cand[2], cand[3])
+                break
+        race = Race(st.cls, st.field,
+                    [st.threads.get(i, f"tid-{i}") for i in st.threads],
+                    (acc[1], acc[2], acc[3]), other)
+        reason = (self._allow.get((st.cls, st.field))
+                  or self._allow.get((None, st.field)))
+        if reason is not None:
+            race.reason = reason
+            self._allowed.append(race)
+        else:
+            self._violations.append(race)
+
+    # -- allowlist ------------------------------------------------------
+
+    def allow(self, field: str, reason: str, cls: str | None = None) -> None:
+        """Programmatic allowlist entry; cls=None matches any class."""
+        with self._mtx:
+            self._allow[(cls, field)] = reason
+
+    def _scan_allowlist(self, cls: type) -> None:
+        try:
+            src = inspect.getsource(cls)
+        except (OSError, TypeError):
+            return
+        for m in _ALLOW_RE.finditer(src):
+            self._allow[(cls.__name__, m.group(1))] = m.group(2).strip()
+
+    # -- instrumentation ------------------------------------------------
+
+    def instrument(self, cls: type) -> type:
+        """Patch cls so attribute traffic funnels through the checker.
+        Usable as a class decorator.  Idempotent.  Costs one branch per
+        access while the checker is not installed."""
+        if cls in self._instrumented:
+            return cls
+        self._scan_allowlist(cls)
+        had_set = "__setattr__" in cls.__dict__
+        had_get = "__getattribute__" in cls.__dict__
+        orig_set = cls.__setattr__
+        orig_get = cls.__getattribute__
+        # names resolvable on the class (methods, properties, defaults)
+        # are skipped on the read path; writes always count
+        skip = frozenset(dir(cls))
+        chk = self
+
+        def __setattr__(self_, name, value, _o=orig_set, _c=chk):
+            if _c._active and not name.startswith("__"):
+                _c._note(self_, name, "write")
+            _o(self_, name, value)
+
+        def __getattribute__(self_, name, _o=orig_get, _c=chk, _s=skip):
+            if _c._active and name not in _s and not name.startswith("__"):
+                _c._note(self_, name, "read")
+            return _o(self_, name)
+
+        cls.__setattr__ = __setattr__
+        cls.__getattribute__ = __getattribute__
+        self._instrumented[cls] = (had_set, orig_set, had_get, orig_get)
+        return cls
+
+    def uninstrument(self, cls: type) -> None:
+        entry = self._instrumented.pop(cls, None)
+        if entry is None:
+            return
+        had_set, orig_set, had_get, orig_get = entry
+        if had_set:
+            cls.__setattr__ = orig_set
+        else:
+            del cls.__setattr__
+        if had_get:
+            cls.__getattribute__ = orig_get
+        else:
+            del cls.__getattribute__
+
+    def uninstrument_all(self) -> None:
+        for cls in list(self._instrumented):
+            self.uninstrument(cls)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def install(self) -> None:
+        """Activate checking.  Refcounted; the first install resets
+        state and installs lockcheck (locksets need the held-set)."""
+        with self._mtx:
+            self._depth += 1
+            if self._depth > 1:
+                return
+            self._state = {}
+            self._violations = []
+            self._allowed = []
+        _lockcheck.install()
+        self._active = True
+
+    def uninstall(self) -> None:
+        with self._mtx:
+            if self._depth == 0:
+                return
+            self._depth -= 1
+            if self._depth:
+                return
+        self._active = False
+        _lockcheck.uninstall()
+        self.uninstrument_all()
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._state = {}
+            self._violations = []
+            self._allowed = []
+
+    # -- results --------------------------------------------------------
+
+    def violations(self) -> list[Race]:
+        with self._mtx:
+            return list(self._violations)
+
+    def report(self) -> dict:
+        """Machine-readable summary of everything observed."""
+        with self._mtx:
+            return {
+                "violations": [r.as_dict() for r in self._violations],
+                "allowed": [r.as_dict() for r in self._allowed],
+                "fields_tracked": len(self._state),
+                "active": self._active,
+            }
+
+    def check(self) -> None:
+        vs = self.violations()
+        if vs:
+            raise RaceError(
+                f"{len(vs)} unguarded shared-state race(s):\n"
+                + "\n".join(v.describe() for v in vs))
+
+
+#: process-wide checker — one lockset universe, like lockcheck's graph
+CHECKER = RaceChecker()
+
+install = CHECKER.install
+uninstall = CHECKER.uninstall
+reset = CHECKER.reset
+instrument = CHECKER.instrument
+uninstrument = CHECKER.uninstrument
+allow = CHECKER.allow
+violations = CHECKER.violations
+report = CHECKER.report
+check = CHECKER.check
+
+
+def instrument_defaults() -> list[type]:
+    """Instrument the registered thread-shared classes and re-bind the
+    known module-level locks through lockcheck so pre-existing guards
+    count toward locksets.  Safe to call repeatedly."""
+    import importlib
+
+    out: list[type] = []
+    for mod_name, cls_name in SHARED_CLASSES:
+        mod = importlib.import_module(mod_name)
+        cls = getattr(mod, cls_name, None)
+        if cls is not None:
+            CHECKER.instrument(cls)
+            out.append(cls)
+    for mod_name, lock_names in _MODULE_LOCKS:
+        mod = importlib.import_module(mod_name)
+        base = mod_name.rsplit(".", 1)[-1]
+        for ln in lock_names:
+            lk = getattr(mod, ln, None)
+            if lk is not None:
+                setattr(mod, ln, _lockcheck.wrap_existing(
+                    lk, f"{base}.py:{ln}"))
+    # devmon's singletons carry instance locks created at import
+    devmon = importlib.import_module("tendermint_tpu.utils.devmon")
+    for sing in (getattr(devmon, "STATS", None),
+                 getattr(devmon, "TRACKER", None)):
+        lk = getattr(sing, "_lock", None)
+        if lk is not None:
+            sing._lock = _lockcheck.wrap_existing(
+                lk, f"devmon.py:{type(sing).__name__}._lock")
+    # the process-wide verify service may predate install (built by an
+    # unsanitized suite earlier in the session): its cache lock is then
+    # raw — invisible to the held-set — and the properly-guarded
+    # hit/miss counters would look naked.  Re-bind it wrapped; a cache
+    # built while installed is already a _CheckedLock (idempotent).
+    av = importlib.import_module("tendermint_tpu.crypto.async_verify")
+    svc = getattr(av, "_SERVICE", None)
+    cache = getattr(svc, "cache", None) if svc is not None else None
+    lk = getattr(cache, "_lock", None) if cache is not None else None
+    if lk is not None:
+        cache._lock = _lockcheck.wrap_existing(
+            lk, "async_verify.py:VerifiedSigCache._lock")
+    return out
+
+
+def maybe_install_from_env() -> bool:
+    """Install + instrument the default set when TM_TPU_RACECHECK is
+    truthy; returns whether the sanitizer is active."""
+    if os.environ.get(ENV_FLAG, "0") not in ("", "0"):
+        install()
+        instrument_defaults()
+        return True
+    return False

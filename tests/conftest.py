@@ -34,3 +34,11 @@ jaxcache.enable(jax)
 from tendermint_tpu.utils import lockcheck  # noqa: E402
 
 lockcheck.maybe_install_from_env()
+
+# opt-in lockset race sanitizing the same way: TM_TPU_RACECHECK=1
+# instruments the registered thread-shared classes for the whole suite
+# (utils/racecheck; the async_verify/multinode/health/history/remediate
+# modules install it per-test regardless).
+from tendermint_tpu.utils import racecheck  # noqa: E402
+
+racecheck.maybe_install_from_env()

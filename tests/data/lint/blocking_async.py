@@ -1,0 +1,38 @@
+"""Fixture: blocking-call-in-async — event-loop stalls (time.sleep,
+un-awaited lock acquire, raw socket calls) inside `async def`, against
+the clean awaited/sync variants."""
+
+import asyncio
+import threading
+import time
+
+
+class Client:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._mtx = threading.Lock()
+
+    async def slow(self):
+        time.sleep(0.1)  # LINT: blocking-call-in-async
+        await asyncio.sleep(0.1)   # yields: clean
+
+    async def locked(self):
+        self._mtx.acquire()  # LINT: blocking-call-in-async
+        acquired = await self._lock.acquire()   # awaited: clean
+        return acquired
+
+    async def raw_io(self, sock, conn):
+        sock.recv(4096)  # LINT: blocking-call-in-async
+        conn.sendall(b"x")  # LINT: blocking-call-in-async
+        sock.accept()  # LINT: blocking-call-in-async
+        loop = asyncio.get_running_loop()
+        await loop.sock_recv(sock, 4096)        # loop coroutine: clean
+
+    async def suppressed(self):
+        time.sleep(0)  # tmlint: disable=blocking-call-in-async
+
+
+def sync_path(sock):
+    """Blocking calls are the whole point off the loop."""
+    time.sleep(0.01)
+    return sock.recv(1)

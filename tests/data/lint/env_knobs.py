@@ -1,0 +1,23 @@
+"""Fixture: env-knob-registry — every whole-string TM_TPU_* literal
+must name a knob registered in utils/knobs.py; prose mentions and
+prefix filters do not match."""
+
+import os
+
+ENV_FLAG = "TM_TPU_UNDOCUMENTED"  # LINT: env-knob-registry
+KNOWN_FLAG = "TM_TPU_LOCKCHECK"        # registered: clean
+
+
+def read_knobs(env):
+    a = os.environ.get("TM_TPU_BOGUS_KNOB", "0")  # LINT: env-knob-registry
+    b = os.getenv("TM_TPU_NOT_REGISTERED")  # LINT: env-knob-registry
+    c = os.environ["TM_TPU_ALSO_MISSING"]  # LINT: env-knob-registry
+    d = "TM_TPU_FAKE_FLAG" in os.environ  # LINT: env-knob-registry
+    e = os.environ.get("TM_TPU_TRACE", "0")       # registered: clean
+    hint = "set TM_TPU_MADE_UP=1 to enable"       # prose: clean
+    mine = [k for k in env if k.startswith("TM_TPU_")]   # prefix: clean
+    return a, b, c, d, e, hint, mine
+
+
+def read_suppressed():
+    return os.getenv("TM_TPU_ESCAPE_HATCH")  # tmlint: disable=env-knob-registry

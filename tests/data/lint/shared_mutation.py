@@ -1,0 +1,78 @@
+"""Fixture: unguarded-shared-mutation — unlocked attribute rebinds in
+thread-shared classes (name-listed in racecheck or Thread-spawning),
+against the sanctioned patterns: ctor writes, `with lock:` blocks,
+`*_locked` helpers, tmsan annotations, async bodies."""
+
+import threading
+
+
+class Sampler:  # Thread-spawning => thread-shared
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None   # ctor write: object not yet shared
+        self.samples = 0
+        self.errors = 0
+        self.tags = {}
+
+    def start(self):
+        def loop():
+            self.samples += 1  # LINT: unguarded-shared-mutation
+
+        self._thread = threading.Thread(target=loop, daemon=True)  # LINT: unguarded-shared-mutation
+        self._thread.start()
+
+    def record(self, n):
+        self.samples = n  # LINT: unguarded-shared-mutation
+        self.errors += 1  # LINT: unguarded-shared-mutation
+        self.a, self.b = n, n  # LINT: unguarded-shared-mutation
+
+    def record_locked_properly(self, n):
+        with self._lock:
+            self.samples = n   # lock held: clean
+            self._reset_locked()
+
+    def _reset_locked(self):
+        self.samples = 0       # `*_locked` suffix: caller holds the lock
+
+    def deferred(self):
+        with self._lock:
+            def later():
+                # the lock is held at DEFINITION time, not call time
+                self.errors = 0  # LINT: unguarded-shared-mutation
+            return later
+
+    def annotated(self):
+        self.samples += 1  # tmsan: shared=diagnostic counter; tolerates lost updates
+
+    def suppressed(self):
+        self.samples = -1  # tmlint: disable=unguarded-shared-mutation
+
+    def container(self, k, v):
+        self.tags[k] = v       # container mutation: out of static scope
+
+
+class DialBackoff:  # racecheck-listed name => thread-shared
+    def __init__(self):
+        self.until = 0.0
+
+    def bump(self, t):
+        self.until = t  # LINT: unguarded-shared-mutation
+
+
+class PlainConfig:  # neither listed nor Thread-spawning: not shared
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v):
+        self.value = v
+
+
+class LoopSide:
+    """async methods interleave on one event loop — exempt."""
+
+    def __init__(self):
+        self._conn = None
+        self._t = threading.Thread(target=lambda: None, daemon=True)
+
+    async def on_conn(self, conn):
+        self._conn = conn      # loop-confined: clean

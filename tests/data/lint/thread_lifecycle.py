@@ -1,0 +1,26 @@
+"""Fixture: thread-lifecycle — Thread() spawns must pin daemon=
+explicitly so shutdown semantics are a decision, not an accident."""
+
+import threading
+from threading import Thread
+
+
+def work():
+    pass
+
+
+def spawn_bad():
+    t = threading.Thread(target=work)  # LINT: thread-lifecycle
+    u = Thread(target=work, name="w")  # LINT: thread-lifecycle
+    return t, u
+
+
+def spawn_good(kw):
+    a = threading.Thread(target=work, daemon=True)
+    b = Thread(target=work, daemon=False, name="writer")
+    c = threading.Thread(**kw)         # splat may carry daemon=
+    return a, b, c
+
+
+def spawn_suppressed():
+    return Thread(target=work)  # tmlint: disable=thread-lifecycle

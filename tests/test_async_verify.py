@@ -48,6 +48,24 @@ def lock_order_checked():
         lockcheck.uninstall()
 
 
+@pytest.fixture(autouse=True)
+def race_sanitized():
+    """And under the lockset race sanitizer (utils/racecheck): the
+    service's worker-thread/caller handoffs are exactly where an
+    unguarded shared field would hide (last_route was the live
+    example — now allowlisted as a deliberate last-write-wins)."""
+    from tendermint_tpu.utils import racecheck
+
+    racecheck.install()
+    racecheck.reset()
+    racecheck.instrument_defaults()
+    try:
+        yield
+        racecheck.check()
+    finally:
+        racecheck.uninstall()
+
+
 @pytest.fixture
 def svc():
     s = av.reset_service(linger_ms=1.0)
@@ -233,6 +251,36 @@ def test_env_knob_parsing(monkeypatch):
     assert s.linger_s == pytest.approx(av.DEFAULT_LINGER_MS / 1e3)
     assert s.cache.maxsize == 0  # negative clamps to disabled
     s.close()
+
+
+def test_env_knobs_set_after_construction_take_effect(monkeypatch):
+    """The service half of the order-dependent test_multinode flake: a
+    singleton built by an earlier test captured TM_TPU_VERIFY_CACHE /
+    TM_TPU_LINGER_MS at construction and silently overrode a later
+    test's monkeypatched env.  Unpinned knobs now resolve lazily, so a
+    stale instance honors the current environment; ctor args still
+    pin."""
+    monkeypatch.delenv("TM_TPU_VERIFY_CACHE", raising=False)
+    monkeypatch.delenv("TM_TPU_LINGER_MS", raising=False)
+    s = av.VerifyService()                  # built under the default env
+    try:
+        assert s.cache.maxsize == av.DEFAULT_CACHE_SIZE
+        monkeypatch.setenv("TM_TPU_VERIFY_CACHE", "0")
+        monkeypatch.setenv("TM_TPU_LINGER_MS", "4.0")
+        assert s.cache.maxsize == 0         # late env takes effect...
+        key = av.VerifiedSigCache.key(b"p", b"m", b"s")
+        s.cache.put(key)
+        assert not s.cache.get(key)         # ...and disables the cache
+        assert s.linger_s == pytest.approx(4e-3)
+    finally:
+        s.close()
+    pinned = av.VerifyService(linger_ms=1.0, cache_size=4)
+    try:
+        monkeypatch.setenv("TM_TPU_VERIFY_CACHE", "99")
+        assert pinned.cache.maxsize == 4    # explicit pin beats env
+        assert pinned.linger_s == pytest.approx(1e-3)
+    finally:
+        pinned.close()
 
 
 def test_routed_surfaces_share_the_service(svc):

@@ -79,16 +79,44 @@ def test_cpu_threshold_env_override_wins(monkeypatch):
 
 
 def test_cpu_threshold_malformed_env_defers(monkeypatch):
+    """Malformed env defers to lazy measurement with a warning.  The
+    env is parsed at RESOLUTION (first cpu_threshold read), not at
+    construction, and the warning fires once per distinct raw value."""
     from tendermint_tpu.crypto import batch
 
     monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "not-a-number")
+    monkeypatch.setattr(batch, "_ENV_THRESHOLD_MEMO", None)
     import warnings
 
+    v = batch.JAXBatchVerifier()
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
-        v = batch.JAXBatchVerifier()
-    assert v.cpu_threshold is None  # deferred to lazy measurement
-    assert any("TM_TPU_CPU_THRESHOLD" in str(x.message) for x in w)
+        assert v.cpu_threshold is None  # deferred to lazy measurement
+        assert v.cpu_threshold is None  # memoized: no second warning
+    assert sum("TM_TPU_CPU_THRESHOLD" in str(x.message) for x in w) == 1
+
+
+def test_cpu_threshold_env_set_after_construction_wins(monkeypatch):
+    """The root cause of the order-dependent test_multinode flake: a
+    verifier (or the process-wide service singleton) built BEFORE a
+    test monkeypatched TM_TPU_CPU_THRESHOLD kept the construction-time
+    value.  The env pin is now re-read at every resolution, so a stale
+    instance honors the current environment; an explicit ctor pin
+    still wins over the env."""
+    from tendermint_tpu.crypto import batch
+
+    monkeypatch.delenv("TM_TPU_CPU_THRESHOLD", raising=False)
+    monkeypatch.setattr(batch, "_ENV_THRESHOLD_MEMO", None)
+    v = batch.JAXBatchVerifier()          # built under the default env
+    monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "2")
+    assert v.cpu_threshold == 2           # late env takes effect
+    assert v._resolved_threshold(3) == 2  # ...and routes dispatch
+    monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "auto")
+    assert v.cpu_threshold is None        # back to lazy measurement
+
+    pinned = batch.JAXBatchVerifier(cpu_threshold=8)
+    monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "2")
+    assert pinned.cpu_threshold == 8      # explicit pin beats env
 
 
 def test_cpu_threshold_lazy_resolution(monkeypatch):

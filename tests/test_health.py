@@ -32,6 +32,24 @@ from tendermint_tpu.utils.health import (
 )
 
 
+@pytest.fixture(autouse=True)
+def race_sanitized():
+    """Run under the lockset race sanitizer (utils/racecheck): the
+    monitor's sampler thread vs. main-thread views is exactly the
+    shape it checks (the unlocked probe_errors increment was the
+    live example)."""
+    from tendermint_tpu.utils import racecheck
+
+    racecheck.install()
+    racecheck.reset()
+    racecheck.instrument_defaults()
+    try:
+        yield
+        racecheck.check()
+    finally:
+        racecheck.uninstall()
+
+
 def feed(det, samples):
     """Drive a detector over [(t, fields)] and return the level trace."""
     levels = []
@@ -299,13 +317,15 @@ def test_monitor_thread_start_stop():
     mon.start()
     mon.start()     # idempotent
     deadline = 50
-    while mon.samples == 0 and deadline:
+    # read through the locked view: `mon.samples` is written under
+    # _lock by the sampler thread (racecheck flags the bare read)
+    while mon.status_block()["samples"] == 0 and deadline:
         deadline -= 1
         import time as _t
 
         _t.sleep(0.02)
     mon.stop()
-    assert mon.samples >= 1
+    assert mon.status_block()["samples"] >= 1
 
 
 def test_env_gating(monkeypatch):

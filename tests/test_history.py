@@ -53,6 +53,24 @@ from tendermint_tpu.utils.history import (
 )
 
 
+@pytest.fixture(autouse=True)
+def race_sanitized():
+    """Run under the lockset race sanitizer (utils/racecheck): the
+    recorder's sampler thread vs. main-thread views is exactly the
+    shape it checks (the unlocked report()/drift-cache reads were
+    the live examples)."""
+    from tendermint_tpu.utils import racecheck
+
+    racecheck.install()
+    racecheck.reset()
+    racecheck.instrument_defaults()
+    try:
+        yield
+        racecheck.check()
+    finally:
+        racecheck.uninstall()
+
+
 # ---------------------------------------------------------------------------
 # helpers: a hand-cranked clock on the seam
 # ---------------------------------------------------------------------------
@@ -571,12 +589,14 @@ def test_live_node_history_surfaces(tmp_path, monkeypatch):
             assert node.history.interval_s == 0.2
             assert node.health.history is node.history
             await node.wait_for_height(2, timeout=30)
-            # let a few samples land on the 0.2s cadence
+            # let a few samples land on the 0.2s cadence; read through
+            # the locked stats() view — `samples` is written under
+            # _lock by the sampler thread (racecheck flags a bare read)
             for _ in range(100):
-                if node.history.samples >= 4:
+                if node.history.status_block()["samples"] >= 4:
                     break
                 await asyncio.sleep(0.1)
-            assert node.history.samples >= 4
+            assert node.history.status_block()["samples"] >= 4
             mh, mp = node.metrics.addr
             rpc = f"http://{node.rpc_addr[0]}:{node.rpc_addr[1]}"
             ph, pp = node.pprof_addr

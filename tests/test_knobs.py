@@ -1,0 +1,75 @@
+"""The env-knob registry (utils/knobs.py): the generated table in
+docs/observability.md must match the renderer byte-for-byte, the
+registry must cover every TM_TPU_* literal in the tree, and the
+checked read path must reject unregistered names."""
+
+import re
+from pathlib import Path
+
+import pytest
+
+from tendermint_tpu.utils import knobs
+
+REPO = Path(__file__).parent.parent
+DOC = REPO / "docs" / "observability.md"
+
+#: doc-example placeholder, quoted in docstrings that explain the rule
+_PLACEHOLDER = {"TM_TPU_X"}
+
+
+def test_doc_table_matches_registry():
+    """docs/observability.md embeds render_table() between the
+    knobs:begin/knobs:end markers; edits to either side without
+    regenerating fail here with the drift."""
+    text = DOC.read_text()
+    m = re.search(r"<!-- knobs:begin -->\n(.*?)<!-- knobs:end -->",
+                  text, re.DOTALL)
+    assert m, "knobs:begin/knobs:end markers missing from the doc"
+    assert m.group(1) == knobs.render_table(), (
+        "docs/observability.md knob table drifted from "
+        "knobs.render_table() — regenerate the block")
+
+
+def test_registry_covers_every_literal_in_the_tree():
+    """Grep-level backstop behind the AST lint rule: every quoted
+    whole-name TM_TPU_* literal in the package and bench.py names a
+    registered knob."""
+    seen: dict[str, str] = {}
+    files = list((REPO / "tendermint_tpu").rglob("*.py"))
+    files.append(REPO / "bench.py")
+    for p in files:
+        for m in re.finditer(r"""["'](TM_TPU_[A-Z0-9_]+)["']""",
+                             p.read_text()):
+            seen.setdefault(m.group(1), str(p.relative_to(REPO)))
+    unregistered = {n: p for n, p in seen.items()
+                    if n not in knobs.KNOWN and n not in _PLACEHOLDER}
+    assert not unregistered, (
+        f"TM_TPU_* literals not registered in utils/knobs.py: "
+        f"{unregistered}")
+
+
+def test_every_knob_is_documented_and_grouped():
+    assert len(knobs.KNOBS) == len(knobs.KNOWN), "duplicate knob names"
+    for k in knobs.KNOBS:
+        assert k.name.startswith("TM_TPU_")
+        assert k.doc, f"{k.name} has no doc line"
+        assert k.subsystem in knobs.SUBSYSTEM_ORDER, (
+            f"{k.name} subsystem {k.subsystem!r} not in SUBSYSTEM_ORDER")
+
+
+def test_checked_read_path(monkeypatch):
+    monkeypatch.delenv("TM_TPU_VERIFY_CACHE", raising=False)
+    assert knobs.read("TM_TPU_VERIFY_CACHE") == "65536"
+    monkeypatch.setenv("TM_TPU_VERIFY_CACHE", "128")
+    assert knobs.read("TM_TPU_VERIFY_CACHE") == "128"
+    with pytest.raises(KeyError, match="TM_TPU_MADE_UP"):
+        knobs.read("TM_TPU_MADE_UP")
+
+
+def test_render_table_shape():
+    table = knobs.render_table()
+    lines = table.splitlines()
+    assert lines[0].startswith("| Knob ")
+    assert len(lines) == 2 + len(knobs.KNOBS)
+    # unset defaults render as prose, set ones as code
+    assert "| unset |" in table and "| `65536` |" in table

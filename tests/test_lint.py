@@ -69,6 +69,10 @@ def test_package_root_is_the_real_tree():
     ("host_sync.py", "host-sync-in-jit"),
     ("metrics_bad.py", "metric-name-conformance"),
     ("simnet/harness.py", "unpluggable-clock"),
+    ("shared_mutation.py", "unguarded-shared-mutation"),
+    ("blocking_async.py", "blocking-call-in-async"),
+    ("thread_lifecycle.py", "thread-lifecycle"),
+    ("env_knobs.py", "env-knob-registry"),
 ])
 def test_rule_fixture(fixture, rule):
     path = FIXTURES / fixture

@@ -41,25 +41,24 @@ def cpu_backend():
 
 @pytest.fixture(autouse=True)
 def isolated_device_path_state():
-    """Fix for the order-dependent device-path flake: the async verify
-    service singleton captures TM_TPU_CPU_THRESHOLD at construction, so
-    when ANY earlier test (test_dispatch_model, test_evidence, ...) had
-    instantiated it, this module's jax-backend test — which pins the
-    threshold to 2 via monkeypatch.setenv — kept verifying through a
-    service built with the default 64-sig floor and the device path
-    never ran.  Dropping the singleton on both sides makes each test
-    build its own from its own env, so suite ordering no longer matters.
-    The warmup started-flag is reset too: a stale failed warmup from a
-    monkeypatched earlier test would otherwise latch the host path
-    forever (_DEVICE_READY itself is left alone — a genuinely warm
-    device staying warm is correct and saves a re-warm)."""
-    from tendermint_tpu.crypto import async_verify as av
+    """The order-dependent device-path flake is root-caused and FIXED:
+    the service singleton used to capture TM_TPU_CPU_THRESHOLD and
+    TM_TPU_VERIFY_CACHE at construction, so a singleton built by ANY
+    earlier test (test_dispatch_model, test_evidence, ...) silently
+    overrode this module's monkeypatched env and the device path never
+    ran.  Unpinned knobs now resolve lazily per flush/probe
+    (crypto/batch._env_cpu_threshold, VerifiedSigCache.maxsize), with
+    failing-before regressions in test_dispatch_model/test_async_verify
+    — a stale singleton honors the current env, so this fixture no
+    longer drops it.  What remains is the warmup started-latch reset: a
+    stale FAILED warmup from a monkeypatched earlier test would
+    otherwise latch the host path forever (_DEVICE_READY itself is left
+    alone — a genuinely warm device staying warm is correct and saves a
+    re-warm)."""
     from tendermint_tpu.crypto import batch as cbatch
 
-    av.clear_service()
     cbatch._WARMUP_STARTED = False
     yield
-    av.clear_service()
     cbatch._WARMUP_STARTED = False
 
 
@@ -76,6 +75,24 @@ def lock_order_checked():
         lockcheck.check()
     finally:
         lockcheck.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def race_sanitized():
+    """The same runs, under the lockset race sanitizer
+    (utils/racecheck): any field of the registered thread-shared
+    classes written from >= 2 threads with no consistent lock fails
+    the test with both access stacks."""
+    from tendermint_tpu.utils import racecheck
+
+    racecheck.install()
+    racecheck.reset()
+    racecheck.instrument_defaults()
+    try:
+        yield
+        racecheck.check()
+    finally:
+        racecheck.uninstall()
 
 
 class _PV:
@@ -197,9 +214,18 @@ def test_four_node_net_on_jax_backend(monkeypatch):
     from tendermint_tpu.ops import ed25519_jax
     from tendermint_tpu.parallel import sharding
 
-    calls = {"device": 0, "sharded": 0}
+    # Count every device entry point the router can choose: the sync
+    # routes (verify_batch / verify_batch_sharded) AND the PR 16
+    # pipelined enqueue, whose host-prep (prepare_batch) runs exactly
+    # once per device-routed flush, pinned or sharded.  Counting only
+    # the sync routes made this test order-dependent a second way: run
+    # alone it passed via the warmup's verify_batch call, but after any
+    # suite that had already set _DEVICE_READY the warmup never ran and
+    # the (executing!) pipelined path was invisible to the counters.
+    calls = {"device": 0, "sharded": 0, "pipelined": 0}
     real_vb = ed25519_jax.verify_batch
     real_sh = sharding.verify_batch_sharded
+    real_prep = ed25519_jax.prepare_batch
 
     def count_vb(*a, **k):
         calls["device"] += 1
@@ -209,8 +235,13 @@ def test_four_node_net_on_jax_backend(monkeypatch):
         calls["sharded"] += 1
         return real_sh(*a, **k)
 
+    def count_prep(*a, **k):
+        calls["pipelined"] += 1
+        return real_prep(*a, **k)
+
     monkeypatch.setattr(ed25519_jax, "verify_batch", count_vb)
     monkeypatch.setattr(sharding, "verify_batch_sharded", count_sh)
+    monkeypatch.setattr(ed25519_jax, "prepare_batch", count_prep)
     # batches of ≥2 sigs hit the device; singletons take the CPU fallback
     monkeypatch.setenv("TM_TPU_CPU_THRESHOLD", "2")
     # the verified-sig LRU must sit this test out: the single-vote
@@ -233,7 +264,7 @@ def test_four_node_net_on_jax_backend(monkeypatch):
         for h in range(1, 3):
             hashes = {n.block_store.load_block(h).hash() for n in nodes}
             assert len(hashes) == 1, f"fork at height {h}"
-        assert calls["device"] + calls["sharded"] > 0, (
+        assert calls["device"] + calls["sharded"] + calls["pipelined"] > 0, (
             "jax backend was configured but the device path never ran"
         )
 

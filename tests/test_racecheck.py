@@ -1,0 +1,350 @@
+"""The lockset race sanitizer (utils/racecheck): seeded two-thread
+races are detected with BOTH access stacks, consistently-locked access
+stays clean, the `# tmsan: shared=` allowlist is honored, lockcheck's
+held-set feeds candidate locksets (intersection semantics), and the
+disabled instrumentation costs a pinned near-NOP.
+
+The seeded classes live in THIS file on purpose: the allowlist scan
+reads class source via inspect.getsource, so exec'd/stdin classes
+cannot carry tmsan annotations.  The unlocked/locked counter pair is a
+failing-before/clean-after reproduction of the shipped hazard pattern —
+health.py's `probe_errors += 1` off-lock (fixed this PR) and the PR 11
+remediation transition race were exactly this shape.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.utils import lockcheck, racecheck
+
+
+@pytest.fixture(autouse=True)
+def sanitizer():
+    """Install for the test, and ALWAYS drain seeded violations before
+    handing back: under TM_TPU_RACECHECK=1 the conftest keeps a
+    session-wide install alive (refcounted), and a leaked seeded race
+    would fail some unrelated suite's check()."""
+    racecheck.install()
+    racecheck.reset()
+    try:
+        yield
+    finally:
+        racecheck.reset()
+        racecheck.uninstall()
+
+
+# -- seeded classes (file-based: the allowlist scan needs real source) --
+
+
+class UnlockedCounter:
+    """The health.py `probe_errors += 1` hazard, reproduced: a counter
+    bumped from two threads with no lock.  Must be flagged."""
+
+    def __init__(self):
+        self.n = 0
+
+    def bump(self, iters=1):
+        for _ in range(iters):
+            self.n += 1
+
+
+class LockedCounter:
+    """The clean-after shape of the same hazard: every access to the
+    shared field holds one consistent lock."""
+
+    def __init__(self, lock=None):
+        self._lock = lock if lock is not None else threading.Lock()
+        self.n = 0
+
+    def bump(self, iters=1):
+        for _ in range(iters):
+            with self._lock:
+                self.n += 1
+
+    def value(self):
+        with self._lock:
+            return self.n
+
+
+class SplitLockCounter:
+    """Each thread dutifully locks — a DIFFERENT lock.  The candidate
+    lockset intersects to empty: still a race, and the case a naive
+    'was any lock held' checker misses."""
+
+    def __init__(self):
+        self.n = 0
+
+    def bump(self, lock):
+        with lock:
+            self.n += 1
+
+
+class Gauge:
+    """Writer/reader pair with no lock: read/write race."""
+
+    def __init__(self):
+        self.level = 0
+
+    def set_level(self, v):
+        self.level = v
+
+    def read_level(self):
+        return self.level
+
+
+class Telemetry:
+    """Deliberately lossy diagnostic counter, annotated in source the
+    same way async_verify's last_route is."""
+
+    def __init__(self):
+        self.hits = 0
+
+    def record(self):
+        self.hits += 1  # tmsan: shared=test fixture: lossy diagnostic counter
+
+
+def _run_threads(*fns):
+    ths = [threading.Thread(target=f, name=f"racer-{i}")
+           for i, f in enumerate(fns)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+
+
+def _race_for(field):
+    for r in racecheck.violations():
+        if r.field == field:
+            return r
+    return None
+
+
+# -- detection -------------------------------------------------------
+
+
+def test_write_write_race_detected_with_both_stacks():
+    racecheck.instrument(UnlockedCounter)
+    obj = UnlockedCounter()
+    obj.bump()                     # owner-side write: the report's far side
+    _run_threads(lambda: obj.bump(50))
+
+    race = _race_for("n")
+    assert race is not None, "two-thread unlocked write went undetected"
+    assert race.cls == "UnlockedCounter"
+    assert len(set(race.threads)) >= 2
+    d = race.as_dict()
+    assert d["access"]["op"] == "write"
+    assert d["other"]["op"] == "write"
+    # both conflicting accesses carry a usable creation stack into THIS
+    # file's racing line — the whole point of keeping the far side
+    assert any("test_racecheck.py" in fr and "bump" in fr
+               for fr in d["access"]["stack"]), d["access"]["stack"]
+    assert any("test_racecheck.py" in fr and "bump" in fr
+               for fr in d["other"]["stack"]), d["other"]["stack"]
+    assert d["access"]["thread"] != d["other"]["thread"]
+    # and the human rendering shows both sides
+    text = race.describe()
+    assert "race on UnlockedCounter.n" in text
+    assert "conflicting write" in text
+
+    with pytest.raises(racecheck.RaceError, match="UnlockedCounter.n"):
+        racecheck.check()
+    racecheck.reset()
+
+
+def test_read_write_race_detected():
+    racecheck.instrument(Gauge)
+    g = Gauge()
+    g.set_level(1)
+    _run_threads(lambda: [g.read_level() for _ in range(20)])
+    g.set_level(2)                 # post-sharing write closes the race
+
+    race = _race_for("level")
+    assert race is not None, "unlocked writer/reader pair went undetected"
+    d = race.as_dict()
+    ops = {d["access"]["op"], d["other"]["op"]}
+    assert ops == {"read", "write"}, d
+    assert any("read_level" in fr for fr in
+               (d["other"]["stack"] if d["other"]["op"] == "read"
+                else d["access"]["stack"]))
+    racecheck.reset()
+
+
+def test_lock_protected_access_stays_clean():
+    racecheck.instrument(LockedCounter)
+    obj = LockedCounter()          # lock created post-install: tracked
+    _run_threads(lambda: obj.bump(50), lambda: obj.bump(50))
+    assert obj.value() == 100
+    assert racecheck.violations() == []
+    racecheck.check()              # no raise
+
+
+def test_inconsistent_locks_are_still_a_race():
+    """Held-locks feed locksets — and it is the INTERSECTION across
+    accesses that must stay nonempty, not per-access lockedness."""
+    racecheck.instrument(SplitLockCounter)
+    obj = SplitLockCounter()
+    # locksets are keyed by lock CREATION SITE (file:line) — these two
+    # must sit on distinct lines or they alias to one lockset entry
+    la = threading.Lock()
+    lb = threading.Lock()
+    _run_threads(lambda: [obj.bump(la) for _ in range(20)],
+                 lambda: [obj.bump(lb) for _ in range(20)])
+    assert _race_for("n") is not None, (
+        "per-thread locks intersected to a nonempty lockset?")
+    racecheck.reset()
+
+
+# -- lockcheck interop -----------------------------------------------
+
+
+def test_install_activates_lockcheck_held_set():
+    """racecheck.install() auto-installs lockcheck; locks created after
+    that feed current_held(), which is what locksets are made of."""
+    lk = threading.Lock()
+    assert lockcheck.current_held() == ()
+    with lk:
+        held = lockcheck.current_held()
+    assert len(held) == 1 and "test_racecheck.py" in held[0], held
+    assert lockcheck.current_held() == ()
+
+
+def test_wrap_existing_brings_preinstall_lock_into_locksets():
+    """A lock that predates install() is invisible to the factory patch
+    and would make properly-guarded fields look naked.  wrap_existing
+    (what instrument_defaults does for devmon/shape_plan/batch locks)
+    re-binds it into the held-set: guarded access stays clean."""
+    import _thread
+
+    raw = _thread.allocate_lock()  # never routed through the factory
+    wrapped = lockcheck.wrap_existing(raw, "test_racecheck.py:preexisting")
+    with wrapped:
+        assert "test_racecheck.py:preexisting" in lockcheck.current_held()
+
+    racecheck.instrument(LockedCounter)
+    obj = LockedCounter(lock=wrapped)
+    _run_threads(lambda: obj.bump(30), lambda: obj.bump(30))
+    assert racecheck.violations() == []
+
+
+def test_instrument_defaults_covers_registered_classes():
+    classes = racecheck.instrument_defaults()
+    names = {c.__name__ for c in classes}
+    assert {"VerifyService", "HealthMonitor",
+            "RemediationController"} <= names
+
+
+# -- allowlist -------------------------------------------------------
+
+
+def test_source_allowlist_comment_honored():
+    racecheck.instrument(Telemetry)
+    t = Telemetry()
+    t.record()
+    _run_threads(lambda: [t.record() for _ in range(20)])
+
+    racecheck.check()              # allowlisted: not fatal
+    rep = racecheck.report()
+    assert rep["violations"] == []
+    allowed = [a for a in rep["allowed"]
+               if a["class"] == "Telemetry" and a["field"] == "hits"]
+    assert allowed, "allowlisted race vanished from the report"
+    assert "lossy diagnostic counter" in allowed[0]["reason"]
+    racecheck.reset()
+
+
+def test_programmatic_allow():
+    racecheck.instrument(UnlockedCounter)
+    racecheck.allow("n", "test: tolerated lost updates",
+                    cls="UnlockedCounter")
+    try:
+        obj = UnlockedCounter()
+        _run_threads(lambda: obj.bump(20), lambda: obj.bump(20))
+        racecheck.check()
+        rep = racecheck.report()
+        assert any(a["field"] == "n" for a in rep["allowed"])
+    finally:
+        # scrub the entry so the class stays seeded for other tests
+        with racecheck.CHECKER._mtx:
+            racecheck.CHECKER._allow.pop(("UnlockedCounter", "n"), None)
+        racecheck.reset()
+
+
+# -- report shape ----------------------------------------------------
+
+
+def test_report_is_machine_readable():
+    racecheck.instrument(UnlockedCounter)
+    obj = UnlockedCounter()
+    obj.bump()
+    _run_threads(lambda: obj.bump(10))
+    rep = racecheck.report()
+    assert rep["active"] is True
+    assert rep["fields_tracked"] >= 1
+    (v,) = [v for v in rep["violations"] if v["class"] == "UnlockedCounter"]
+    assert set(v) >= {"class", "field", "threads", "access", "other"}
+    assert isinstance(v["access"]["stack"], list) and v["access"]["stack"]
+    import json
+
+    json.dumps(rep)                # actually serializable
+    racecheck.reset()
+
+
+# -- instrumentation mechanics & disabled cost ------------------------
+
+
+class _Plain:
+    def __init__(self):
+        self.x = 0
+
+
+class _Patched:
+    def __init__(self):
+        self.x = 0
+
+
+def test_instrument_idempotent_and_reversible():
+    racecheck.instrument(_Patched)
+    racecheck.instrument(_Patched)           # second call: no-op
+    assert "__setattr__" in _Patched.__dict__
+    racecheck.uninstrument(_Patched)
+    assert "__setattr__" not in _Patched.__dict__
+    assert "__getattribute__" not in _Patched.__dict__
+    racecheck.uninstrument(_Patched)         # already clean: no-op
+
+
+def test_disabled_instrumentation_is_a_pinned_nop():
+    """Instrumented classes left behind with the checker OFF must cost
+    one predictable branch — the contract that lets instrument() stay
+    wired into long-lived classes.  Bench-style pin: the per-access
+    overhead is bounded absolutely, and no state is recorded."""
+    racecheck.uninstall()                    # balance the fixture install
+    try:
+        if racecheck.CHECKER._active:        # env-installed suite-wide
+            pytest.skip("TM_TPU_RACECHECK active: disabled branch "
+                        "not measurable")
+        racecheck.instrument(_Patched)
+        tracked0 = racecheck.report()["fields_tracked"]
+
+        def spin(obj, n=20_000):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                obj.x = obj.x + 1
+            return (time.perf_counter() - t0) / (2 * n)  # 1 read + 1 write
+
+        spin(_Patched(), 1000)               # warm both paths
+        spin(_Plain(), 1000)
+        per_access = min(spin(_Patched()) for _ in range(3))
+        baseline = min(spin(_Plain()) for _ in range(3))
+
+        assert per_access < 10e-6, (
+            f"disabled racecheck access costs {per_access * 1e9:.0f}ns "
+            "per attr — the NOP branch regressed")
+        # no lockset state may accumulate while inactive
+        assert racecheck.report()["fields_tracked"] == tracked0
+        assert baseline <= per_access        # sanity: wrapper isn't free
+    finally:
+        racecheck.uninstrument(_Patched)
+        racecheck.install()                  # hand the fixture its depth back

@@ -38,6 +38,24 @@ from tendermint_tpu.utils.health import (
 )
 
 
+@pytest.fixture(autouse=True)
+def race_sanitized():
+    """Run under the lockset race sanitizer (utils/racecheck): the
+    PR 11 remediation transition race is this module's bug class —
+    the controller's all-mutations-hold-_lock invariant is asserted
+    mechanically here instead of by review."""
+    from tendermint_tpu.utils import racecheck
+
+    racecheck.install()
+    racecheck.reset()
+    racecheck.instrument_defaults()
+    try:
+        yield
+        racecheck.check()
+    finally:
+        racecheck.uninstall()
+
+
 def make_mempool(**cfg):
     conns = AppConns(KVStoreApplication())
     return Mempool(MempoolConfig(**cfg), conns.mempool())
